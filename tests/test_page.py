@@ -1,0 +1,61 @@
+import numpy as np
+
+from trino_tpu import BIGINT, DOUBLE, VARCHAR, DecimalType
+from trino_tpu import types as T
+from trino_tpu.page import Column, Page, StringDictionary, pad_capacity, unify_dictionaries
+
+
+def test_pad_capacity():
+    assert pad_capacity(1) == 8
+    assert pad_capacity(8) == 8
+    assert pad_capacity(9) == 16
+    assert pad_capacity(1000) == 1024
+
+
+def test_string_dictionary_sorted_codes():
+    d, codes = StringDictionary.from_strings(["b", "a", "c", "a"])
+    assert list(d.values) == ["a", "b", "c"]
+    assert list(codes) == [1, 0, 2, 0]
+    assert d.encode_one("b") == 1
+    assert d.encode_one("zz") == -1
+
+
+def test_dictionary_union_remap():
+    a = Column.from_numpy(VARCHAR, np.array(["x", "y"], dtype=object))
+    b = Column.from_numpy(VARCHAR, np.array(["y", "z"], dtype=object))
+    a2, b2 = unify_dictionaries(a, b)
+    assert a2.dictionary is b2.dictionary
+    assert list(a2.dictionary.values) == ["x", "y", "z"]
+    assert list(np.asarray(a2.data)[:2]) == [0, 1]
+    assert list(np.asarray(b2.data)[:2]) == [1, 2]
+
+
+def test_page_roundtrip():
+    page = Page.from_arrays(
+        {
+            "k": (BIGINT, np.array([1, 2, 3])),
+            "v": (DOUBLE, np.array([1.5, 2.5, 3.5])),
+            "s": (VARCHAR, np.array(["b", "a", "b"], dtype=object)),
+        }
+    )
+    assert page.capacity == 8
+    assert page.num_rows() == 3
+    rows = page.to_pylist()
+    assert rows == [(1, 1.5, "b"), (2, 2.5, "a"), (3, 3.5, "b")]
+
+
+def test_decimal_rendering():
+    import decimal
+
+    page = Page.from_arrays({"d": (DecimalType(10, 2), np.array([12345, -50]))})
+    assert page.to_pylist() == [
+        (decimal.Decimal("123.45"),),
+        (decimal.Decimal("-0.50"),),
+    ]
+
+
+def test_common_super_type():
+    assert T.common_super_type(T.INTEGER, T.BIGINT) == T.BIGINT
+    assert T.common_super_type(T.BIGINT, T.DOUBLE) == T.DOUBLE
+    d = T.common_super_type(T.DecimalType(10, 2), T.DecimalType(12, 4))
+    assert (d.precision, d.scale) == (12, 4)

@@ -1,0 +1,61 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A brand-new engine with the capabilities of Trino (reference:
+core/trino-main, core/trino-spi of the Java engine), re-designed
+TPU-first:
+
+- Columnar data lives as device-resident struct-of-arrays ``Page``s
+  (JAX arrays + validity masks + host-side string dictionaries) — the
+  analog of the reference's ``io.trino.spi.Page`` / sealed ``Block``
+  hierarchy (SPI/Page.java:31, SPI/block/Block.java:26).
+- Expressions compile to jitted JAX functions per (expression, layout) —
+  the analog of runtime bytecode generation in
+  core/trino-main/.../sql/gen/ExpressionCompiler.java:56.
+- Relational operators are shape-stable XLA/Pallas computations
+  (sort-based segment reduction for aggregation, searchsorted probes for
+  hash joins) rather than per-row loops.
+- Distribution is a stage DAG whose hash/broadcast exchanges lower to
+  ``lax.all_to_all`` / ``all_gather`` over an ICI ``jax.sharding.Mesh``
+  (replacing the reference's HTTP page shuffle,
+  MAIN/operator/DirectExchangeClient.java:56).
+"""
+
+import jax
+
+# SQL semantics need 64-bit integers (BIGINT) and binary64 doubles. The
+# engine owns the process the way a Trino server owns its JVM, so we
+# enable x64 globally at import.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from trino_tpu.types import (  # noqa: E402
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    REAL,
+    SMALLINT,
+    TINYINT,
+    VARCHAR,
+    DecimalType,
+    DataType,
+)
+from trino_tpu.page import Column, Page  # noqa: E402
+
+__all__ = [
+    "BIGINT",
+    "BOOLEAN",
+    "DATE",
+    "DOUBLE",
+    "INTEGER",
+    "REAL",
+    "SMALLINT",
+    "TINYINT",
+    "VARCHAR",
+    "DecimalType",
+    "DataType",
+    "Column",
+    "Page",
+]

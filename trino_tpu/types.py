@@ -1,0 +1,292 @@
+"""SQL type system.
+
+The analog of the reference's ``io.trino.spi.type`` package (82 files,
+SPI/type/): each type knows its device representation (JAX dtype), how
+to compare/hash values, and how to render results. Unlike the
+reference, a type here maps onto a *fixed-width device array* plus
+optional host-side metadata:
+
+- integers / booleans / doubles: the obvious dtypes
+- DECIMAL(p, s), p <= 18: scaled int64 (unscaled value), like the
+  reference's short decimal (SPI/type/DecimalType.java)
+- DATE: int32 days since 1970-01-01 (SPI/type/DateType.java)
+- TIMESTAMP: int64 microseconds since epoch
+- VARCHAR/CHAR: int32 codes into a *sorted* host-side dictionary
+  (lexicographic order preserved, so <, >, ORDER BY work on codes).
+  This replaces the reference's pointer-based VariableWidthBlock
+  (SPI/block/VariableWidthBlock.java), which has no TPU-friendly form.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "DataType",
+    "BooleanType",
+    "IntegerKind",
+    "DoubleType",
+    "RealType",
+    "DecimalType",
+    "VarcharType",
+    "CharType",
+    "DateType",
+    "TimestampType",
+    "UnknownType",
+    "BOOLEAN",
+    "TINYINT",
+    "SMALLINT",
+    "INTEGER",
+    "BIGINT",
+    "DOUBLE",
+    "REAL",
+    "VARCHAR",
+    "DATE",
+    "TIMESTAMP",
+    "UNKNOWN",
+    "parse_date",
+    "format_date",
+]
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def parse_date(s: str) -> int:
+    """'1995-03-15' -> days since epoch."""
+    y, m, d = s.split("-")
+    return (datetime.date(int(y), int(m), int(d)) - EPOCH).days
+
+
+def format_date(days: int) -> str:
+    return (EPOCH + datetime.timedelta(days=int(days))).isoformat()
+
+
+class DataType:
+    """Base of all SQL types."""
+
+    name: str = "?"
+
+    #: numpy dtype of the device representation
+    np_dtype: np.dtype = np.dtype(np.int64)
+
+    #: True when the device value is an ordinal into a dictionary
+    is_dictionary: bool = False
+
+    @property
+    def is_numeric(self) -> bool:
+        return False
+
+    @property
+    def is_integer(self) -> bool:
+        return False
+
+    @property
+    def is_orderable(self) -> bool:
+        return True
+
+    def __repr__(self) -> str:
+        return self.name
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and repr(self) == repr(other)
+
+    def __hash__(self) -> int:
+        return hash(repr(self))
+
+
+class BooleanType(DataType):
+    name = "boolean"
+    np_dtype = np.dtype(np.bool_)
+
+
+@dataclass(frozen=True, repr=False, eq=False)
+class IntegerKind(DataType):
+    """TINYINT/SMALLINT/INTEGER/BIGINT (SPI/type/BigintType.java etc.)."""
+
+    name: str = "bigint"
+    bits: int = 64
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "np_dtype", np.dtype(getattr(np, f"int{self.bits}"))
+        )
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def is_integer(self) -> bool:
+        return True
+
+
+class DoubleType(DataType):
+    name = "double"
+    np_dtype = np.dtype(np.float64)
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+
+class RealType(DataType):
+    name = "real"
+    np_dtype = np.dtype(np.float32)
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+
+@dataclass(frozen=True, eq=False, repr=False)
+class DecimalType(DataType):
+    """Short decimal: unscaled int64 value, precision <= 18.
+
+    The reference's long decimal (Int128, SPI/type/Int128.java) is
+    planned as a two-lane int64 representation; until then precision is
+    capped at 18 and arithmetic widens/rescales within int64.
+    """
+
+    precision: int = 18
+    scale: int = 0
+
+    np_dtype = np.dtype(np.int64)
+
+    def __post_init__(self):
+        if not (0 < self.precision <= 18):
+            raise ValueError(f"unsupported decimal precision {self.precision}")
+        if not (0 <= self.scale <= self.precision):
+            raise ValueError(f"bad decimal scale {self.scale}")
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+
+@dataclass(frozen=True, eq=False, repr=False)
+class VarcharType(DataType):
+    """VARCHAR: int32 dictionary codes; strings live host-side.
+
+    Dictionaries are kept lexicographically sorted so that code order ==
+    string order; comparisons and ORDER BY run on codes entirely
+    on-device. Cross-column string equality/joins remap to a shared
+    dictionary on host first (see page.unify_dictionaries).
+    """
+
+    length: int | None = None
+
+    np_dtype = np.dtype(np.int32)
+    is_dictionary = True
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        if self.length is None:
+            return "varchar"
+        return f"varchar({self.length})"
+
+
+@dataclass(frozen=True, eq=False, repr=False)
+class CharType(VarcharType):
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"char({self.length})"
+
+
+class DateType(DataType):
+    name = "date"
+    np_dtype = np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    name = "timestamp"
+    np_dtype = np.dtype(np.int64)
+
+
+class UnknownType(DataType):
+    """Type of NULL literals before coercion."""
+
+    name = "unknown"
+    np_dtype = np.dtype(np.int8)
+
+
+BOOLEAN = BooleanType()
+TINYINT = IntegerKind("tinyint", 8)
+SMALLINT = IntegerKind("smallint", 16)
+INTEGER = IntegerKind("integer", 32)
+BIGINT = IntegerKind("bigint", 64)
+DOUBLE = DoubleType()
+REAL = RealType()
+VARCHAR = VarcharType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+UNKNOWN = UnknownType()
+
+_BY_NAME = {
+    "boolean": BOOLEAN,
+    "tinyint": TINYINT,
+    "smallint": SMALLINT,
+    "integer": INTEGER,
+    "int": INTEGER,
+    "bigint": BIGINT,
+    "double": DOUBLE,
+    "real": REAL,
+    "varchar": VARCHAR,
+    "date": DATE,
+    "timestamp": TIMESTAMP,
+}
+
+
+def type_from_name(name: str) -> DataType:
+    base = name.strip().lower()
+    if base.startswith("decimal"):
+        inner = base[base.index("(") + 1 : base.rindex(")")]
+        p, s = (int(x) for x in inner.split(","))
+        return DecimalType(p, s)
+    if base.startswith("varchar(") :
+        return VarcharType(int(base[8:-1]))
+    if base.startswith("char("):
+        return CharType(int(base[5:-1]))
+    if base in _BY_NAME:
+        return _BY_NAME[base]
+    raise ValueError(f"unknown type: {name}")
+
+
+def common_super_type(a: DataType, b: DataType) -> DataType:
+    """Least common type for coercion (MAIN/type/TypeCoercion.java analog)."""
+    if a == b:
+        return a
+    if isinstance(a, UnknownType):
+        return b
+    if isinstance(b, UnknownType):
+        return a
+    order = {"tinyint": 0, "smallint": 1, "integer": 2, "bigint": 3}
+    if a.is_integer and b.is_integer:
+        return a if order[a.name] >= order[b.name] else b
+    if isinstance(a, DecimalType) and b.is_integer:
+        return _decimal_int_super(a)
+    if isinstance(b, DecimalType) and a.is_integer:
+        return _decimal_int_super(b)
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        scale = max(a.scale, b.scale)
+        ip = max(a.precision - a.scale, b.precision - b.scale)
+        return DecimalType(min(18, ip + scale), scale)
+    numeric_to_double = (DoubleType, RealType)
+    if isinstance(a, numeric_to_double) and b.is_numeric:
+        return DOUBLE if isinstance(a, DoubleType) or isinstance(b, DoubleType) else REAL
+    if isinstance(b, numeric_to_double) and a.is_numeric:
+        return DOUBLE if isinstance(b, DoubleType) or isinstance(a, DoubleType) else REAL
+    if isinstance(a, VarcharType) and isinstance(b, VarcharType):
+        return VARCHAR
+    raise TypeError(f"no common type for {a} and {b}")
+
+
+def _decimal_int_super(d: DecimalType) -> DecimalType:
+    # bigint as decimal(18,0); keep at least the decimal's scale
+    return DecimalType(min(18, max(d.precision, 18)), d.scale)
