@@ -1,0 +1,86 @@
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.connectors.tpch.generator import SCHEMAS, TpchData
+from trino_tpu.testing.golden import load_tpch_sqlite
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return TpchData(0.01)
+
+
+def test_row_counts(tiny):
+    assert tiny.row_count("region") == 5
+    assert tiny.row_count("nation") == 25
+    assert tiny.row_count("customer") == 1500
+    assert tiny.row_count("orders") == 15000
+    assert tiny.row_count("part") == 2000
+    assert tiny.row_count("partsupp") == 8000
+    assert tiny.row_count("supplier") == 100
+    # ~4 lines per order
+    assert 15000 <= tiny.row_count("lineitem") <= 15000 * 7
+
+
+def test_determinism():
+    a = TpchData(0.01).column("lineitem", "extendedprice")
+    b = TpchData(0.01).column("lineitem", "extendedprice")
+    np.testing.assert_array_equal(a, b)
+
+
+def test_all_columns_generate(tiny):
+    for table, schema in SCHEMAS.items():
+        n = tiny.row_count(table)
+        for col in schema.column_names:
+            arr = tiny.column(table, col)
+            assert len(arr) == n, f"{table}.{col}"
+
+
+def test_referential_integrity(tiny):
+    lok = tiny.column("lineitem", "orderkey")
+    ook = tiny.column("orders", "orderkey")
+    assert set(np.unique(lok)) <= set(ook)
+    ock = tiny.column("orders", "custkey")
+    assert ock.min() >= 1 and ock.max() <= tiny.n_customer
+    assert np.all(ock % 3 != 0)
+    lsk = tiny.column("lineitem", "suppkey")
+    assert lsk.min() >= 1 and lsk.max() <= tiny.n_supplier
+    # lineitem (partkey, suppkey) must exist in partsupp
+    ps = set(zip(tiny.column("partsupp", "partkey").tolist(),
+                 tiny.column("partsupp", "suppkey").tolist()))
+    li = set(zip(tiny.column("lineitem", "partkey")[:500].tolist(),
+                 tiny.column("lineitem", "suppkey")[:500].tolist()))
+    assert li <= ps
+
+
+def test_status_flags_consistent(tiny):
+    sd = tiny.column("lineitem", "shipdate")
+    ls = tiny.column("lineitem", "linestatus")
+    from trino_tpu.connectors.tpch.generator import CURRENT_DATE
+
+    assert np.all((ls == "F") == (sd <= CURRENT_DATE))
+    rf = tiny.column("lineitem", "returnflag")
+    rd = tiny.column("lineitem", "receiptdate")
+    assert np.all((rf == "N") == (rd > CURRENT_DATE))
+
+
+def test_sqlite_golden_loads(tiny):
+    conn = load_tpch_sqlite(tiny, tables=["region", "nation", "supplier"])
+    n = conn.execute("select count(*) from supplier").fetchone()[0]
+    assert n == 100
+    rows = conn.execute(
+        "select n.name, r.name from nation n join region r on n.regionkey = r.regionkey "
+        "where r.name = 'ASIA' order by n.name"
+    ).fetchall()
+    assert [r[0] for r in rows] == ["CHINA", "INDIA", "INDONESIA", "JAPAN", "VIETNAM"]
+
+
+def test_connector_scan_split():
+    c = TpchConnector()
+    cols = c.scan("tiny", "orders", ["orderkey", "totalprice"])
+    assert len(cols["orderkey"]) == 15000
+    splits = c.splits("tiny", "orders", 4)
+    assert sum(s.count for s in splits) == 15000
+    part = c.scan("tiny", "orders", ["orderkey"], splits[1])
+    np.testing.assert_array_equal(part["orderkey"], cols["orderkey"][splits[1].start : splits[1].start + splits[1].count])

@@ -1,0 +1,83 @@
+"""Connector SPI.
+
+The analog of the reference's connector SPI (SPI/connector/, 110
+files): a ``Connector`` exposes metadata (tables, schemas) and a scan
+path. The TPU twist: a scan yields *host numpy columns* (optionally a
+row range of the table, the analog of a ConnectorSplit) which the
+engine marshals to device pages; pruned columns are never produced
+(projection pushdown, the analog of ConnectorMetadata.applyProjection).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from trino_tpu import types as T
+
+__all__ = ["TableSchema", "Connector", "Catalog", "Split"]
+
+
+@dataclass(frozen=True)
+class TableSchema:
+    name: str
+    columns: list[tuple[str, T.DataType]]
+
+    @property
+    def column_names(self) -> list[str]:
+        return [c for c, _ in self.columns]
+
+    def column_type(self, name: str) -> T.DataType:
+        for c, t in self.columns:
+            if c == name:
+                return t
+        raise KeyError(name)
+
+
+@dataclass(frozen=True)
+class Split:
+    """A row range of a table — the unit of source parallelism
+    (SPI/connector/ConnectorSplit.java analog)."""
+
+    table: str
+    start: int
+    count: int
+
+
+class Connector:
+    """Base connector: metadata + split enumeration + column scan."""
+
+    def list_tables(self, schema: str) -> list[str]:
+        raise NotImplementedError
+
+    def table_schema(self, schema: str, table: str) -> TableSchema:
+        raise NotImplementedError
+
+    def row_count(self, schema: str, table: str) -> int:
+        raise NotImplementedError
+
+    def splits(self, schema: str, table: str, target_splits: int) -> list[Split]:
+        n = self.row_count(schema, table)
+        target_splits = max(1, target_splits)
+        per = -(-n // target_splits)
+        out = []
+        start = 0
+        while start < n:
+            c = min(per, n - start)
+            out.append(Split(table, start, c))
+            start += c
+        return out or [Split(table, 0, 0)]
+
+    def scan(
+        self, schema: str, table: str, columns: list[str], split: Split | None = None
+    ) -> dict[str, np.ndarray]:
+        """Produce host arrays for the requested columns (row range)."""
+        raise NotImplementedError
+
+
+@dataclass
+class Catalog:
+    name: str
+    connector: Connector
+    properties: dict = field(default_factory=dict)

@@ -1,0 +1,3 @@
+from trino_tpu.connectors.tpch.connector import TpchConnector
+
+__all__ = ["TpchConnector"]

@@ -1,0 +1,56 @@
+"""TPC-H connector: schemas tiny/sf1/sf10/sf100 of generated tables."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from trino_tpu.connectors.base import Connector, Split, TableSchema
+from trino_tpu.connectors.tpch.generator import SCHEMAS, SCHEMA_SF, TpchData
+
+__all__ = ["TpchConnector"]
+
+
+class TpchConnector(Connector):
+    def __init__(self):
+        self._data: dict[float, TpchData] = {}
+
+    def data(self, schema: str) -> TpchData:
+        sf = self._sf(schema)
+        if sf not in self._data:
+            self._data[sf] = TpchData(sf)
+        return self._data[sf]
+
+    @staticmethod
+    def _sf(schema: str) -> float:
+        if schema in SCHEMA_SF:
+            return SCHEMA_SF[schema]
+        if schema.startswith("sf"):
+            try:
+                return float(schema[2:])
+            except ValueError:
+                pass
+        raise KeyError(f"unknown tpch schema: {schema}")
+
+    def list_schemas(self) -> list[str]:
+        return list(SCHEMA_SF)
+
+    def list_tables(self, schema: str) -> list[str]:
+        return list(SCHEMAS)
+
+    def table_schema(self, schema: str, table: str) -> TableSchema:
+        return SCHEMAS[table]
+
+    def row_count(self, schema: str, table: str) -> int:
+        return self.data(schema).row_count(table)
+
+    def scan(
+        self, schema: str, table: str, columns: list[str], split: Split | None = None
+    ) -> dict[str, np.ndarray]:
+        data = self.data(schema)
+        out = {}
+        for c in columns:
+            arr = data.column(table, c)
+            if split is not None:
+                arr = arr[split.start : split.start + split.count]
+            out[c] = arr
+        return out

@@ -172,7 +172,10 @@ class Page:
         named: dict[str, tuple[T.DataType, np.ndarray]],
         capacity: int | None = None,
     ) -> "Page":
-        n = len(next(iter(named.values()))[1])
+        lengths = {name: len(v[1]) for name, v in named.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"ragged columns: {lengths}")
+        n = next(iter(lengths.values()))
         cap = capacity or pad_capacity(n)
         names, cols = [], []
         for name, (type_, values) in named.items():

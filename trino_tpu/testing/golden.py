@@ -1,0 +1,97 @@
+"""Golden-result harness: sqlite as the reference oracle.
+
+The analog of the reference's H2-based result checking
+(TESTING/QueryAssertions.java, H2QueryRunner): engine results are
+compared against an embedded SQL engine running over the *same*
+generated data. Decimals are loaded into sqlite as REAL (sqlite has no
+decimal type), so decimal aggregates compare with a relative
+tolerance; integers/strings/dates compare exactly.
+"""
+
+from __future__ import annotations
+
+import math
+import sqlite3
+from decimal import Decimal
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connectors.tpch.generator import SCHEMAS, TpchData
+from trino_tpu.types import format_date
+
+__all__ = ["load_tpch_sqlite", "assert_rows_match"]
+
+
+def load_tpch_sqlite(data: TpchData, tables: list[str] | None = None) -> sqlite3.Connection:
+    """Load generated TPC-H tables into an in-memory sqlite database.
+
+    Dates become ISO text (compares correctly lexicographically),
+    decimals become REAL dollars (cents / 100).
+    """
+    conn = sqlite3.connect(":memory:")
+    for name in tables or list(SCHEMAS):
+        schema = SCHEMAS[name]
+        cols = []
+        for col, typ in schema.columns:
+            if isinstance(typ, T.DecimalType) or isinstance(typ, (T.DoubleType, T.RealType)):
+                sql_t = "REAL"
+            elif isinstance(typ, (T.VarcharType, T.DateType)):
+                sql_t = "TEXT"
+            else:
+                sql_t = "INTEGER"
+            cols.append(f"{col} {sql_t}")
+        conn.execute(f"CREATE TABLE {name} ({', '.join(cols)})")
+        arrays = []
+        for col, typ in schema.columns:
+            arr = data.column(name, col)
+            if isinstance(typ, T.DecimalType):
+                arrays.append((arr / 10**typ.scale).tolist())
+            elif isinstance(typ, T.DateType):
+                arrays.append([format_date(d) for d in arr])
+            elif isinstance(typ, T.VarcharType):
+                arrays.append([str(s) for s in arr])
+            else:
+                arrays.append(arr.tolist())
+        placeholders = ",".join("?" * len(schema.columns))
+        conn.executemany(
+            f"INSERT INTO {name} VALUES ({placeholders})", list(zip(*arrays))
+        )
+    conn.commit()
+    return conn
+
+
+def _close(a, b, rel=1e-6) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, Decimal):
+        a = float(a)
+    if isinstance(b, Decimal):
+        b = float(b)
+    if isinstance(a, float) or isinstance(b, float):
+        if isinstance(a, str) or isinstance(b, str):
+            return False
+        return math.isclose(float(a), float(b), rel_tol=rel, abs_tol=1e-9)
+    return a == b
+
+
+def assert_rows_match(actual: list[tuple], expected: list[tuple], ordered: bool = False):
+    assert len(actual) == len(expected), (
+        f"row count mismatch: got {len(actual)}, want {len(expected)}\n"
+        f"got:  {actual[:5]}\nwant: {expected[:5]}"
+    )
+    if not ordered:
+        def keyfn(r):
+            # quantize floats so tolerance-equal rows sort identically
+            return tuple(
+                f"{float(x):.4e}" if isinstance(x, (float, Decimal)) else str(x)
+                for x in r
+            )
+        actual = sorted(actual, key=keyfn)
+        expected = sorted(expected, key=keyfn)
+    for i, (ra, re_) in enumerate(zip(actual, expected)):
+        assert len(ra) == len(re_), f"row {i} arity: {ra} vs {re_}"
+        for j, (va, ve) in enumerate(zip(ra, re_)):
+            assert _close(va, ve), (
+                f"row {i} col {j}: {va!r} != {ve!r}\ngot:  {ra}\nwant: {re_}"
+            )

@@ -91,7 +91,11 @@ class DataType:
         return self.name
 
     def __eq__(self, other) -> bool:
-        return type(self) is type(other) and repr(self) == repr(other)
+        return (
+            type(self) is type(other)
+            and repr(self) == repr(other)
+            and self.np_dtype == other.np_dtype
+        )
 
     def __hash__(self) -> int:
         return hash(repr(self))
@@ -246,8 +250,12 @@ _BY_NAME = {
 def type_from_name(name: str) -> DataType:
     base = name.strip().lower()
     if base.startswith("decimal"):
+        if "(" not in base:
+            return DecimalType(18, 0)
         inner = base[base.index("(") + 1 : base.rindex(")")]
-        p, s = (int(x) for x in inner.split(","))
+        parts = [int(x) for x in inner.split(",")]
+        p = parts[0]
+        s = parts[1] if len(parts) > 1 else 0
         return DecimalType(p, s)
     if base.startswith("varchar(") :
         return VarcharType(int(base[8:-1]))
@@ -288,5 +296,6 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
 
 
 def _decimal_int_super(d: DecimalType) -> DecimalType:
-    # bigint as decimal(18,0); keep at least the decimal's scale
-    return DecimalType(min(18, max(d.precision, 18)), d.scale)
+    # integers widen to decimal(18, s) — bigint is decimal(18,0) here
+    # (precision is capped at 18 until int128 lands)
+    return DecimalType(18, d.scale)
