@@ -1,0 +1,177 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.expr import Call, Cast, ColumnLayout, InputRef, Literal, compile_expr
+from trino_tpu.page import Column, StringDictionary
+
+
+def run(expr, layout=None, **cols):
+    layout = layout or ColumnLayout()
+    env = {}
+    for name, v in cols.items():
+        if isinstance(v, tuple):
+            data, valid = v
+            env[name] = (jnp.asarray(data), jnp.asarray(valid))
+        else:
+            env[name] = (jnp.asarray(v), None)
+    c = compile_expr(expr, layout)
+    data, valid = c.fn(env)
+    return np.asarray(data), (None if valid is None else np.asarray(valid)), c
+
+
+def bigint(name):
+    return InputRef(T.BIGINT, name)
+
+
+def test_add_bigint():
+    e = Call(T.BIGINT, "add", (bigint("a"), bigint("b")))
+    data, valid, _ = run(e, a=np.array([1, 2]), b=np.array([10, 20]))
+    assert list(data) == [11, 22]
+    assert valid is None
+
+
+def test_null_propagation():
+    e = Call(T.BIGINT, "add", (bigint("a"), bigint("b")))
+    data, valid, _ = run(
+        e, a=(np.array([1, 2]), np.array([True, False])), b=np.array([10, 20])
+    )
+    assert list(valid) == [True, False]
+
+
+def test_kleene_and():
+    a = InputRef(T.BOOLEAN, "a")
+    b = InputRef(T.BOOLEAN, "b")
+    e = Call(T.BOOLEAN, "and", (a, b))
+    # a = [T, F, NULL(T), NULL(T)]; b = [NULL(T), NULL(T), F, T]
+    data, valid, _ = run(
+        e,
+        a=(np.array([True, False, True, True]), np.array([True, True, False, False])),
+        b=(np.array([True, True, False, True]), np.array([False, False, True, True])),
+    )
+    # T AND NULL = NULL; F AND NULL = F; NULL AND F = F; NULL AND T = NULL
+    assert list(valid) == [False, True, True, False]
+    assert data[1] == False and data[2] == False  # noqa: E712
+
+
+def test_decimal_multiply_and_divide():
+    d2 = T.DecimalType(15, 2)
+    a = InputRef(d2, "a")
+    b = InputRef(d2, "b")
+    mul = Call(T.DecimalType(18, 4), "multiply", (a, b))
+    data, _, _ = run(mul, a=np.array([150]), b=np.array([250]))  # 1.50 * 2.50
+    assert data[0] == 37500  # 3.7500 at scale 4
+    div = Call(T.DecimalType(18, 2), "divide", (a, b))
+    data, _, _ = run(div, a=np.array([100]), b=np.array([300]))  # 1.00/3.00
+    assert data[0] == 33  # 0.33
+    data, _, _ = run(div, a=np.array([100]), b=np.array([600]))  # 1.00/6.00 = .1666 -> .17
+    assert data[0] == 17
+    data, _, _ = run(div, a=np.array([-100]), b=np.array([600]))  # round half away from zero
+    assert data[0] == -17
+
+
+def test_cast_decimal_to_double():
+    d2 = T.DecimalType(15, 2)
+    e = Cast(T.DOUBLE, InputRef(d2, "a"))
+    data, _, _ = run(e, a=np.array([150]))
+    assert data[0] == 1.5
+
+
+def test_comparison_and_between_style():
+    a = bigint("a")
+    e = Call(T.BOOLEAN, "and", (
+        Call(T.BOOLEAN, "ge", (a, Literal(T.BIGINT, 2))),
+        Call(T.BOOLEAN, "le", (a, Literal(T.BIGINT, 4))),
+    ))
+    data, _, _ = run(e, a=np.array([1, 2, 3, 4, 5]))
+    assert list(data) == [False, True, True, True, False]
+
+
+def test_date_literal_and_extract():
+    d = InputRef(T.DATE, "d")
+    e = Call(T.BOOLEAN, "lt", (d, Literal(T.DATE, "1995-01-01")))
+    data, _, _ = run(e, d=np.array([T.parse_date("1994-12-31"), T.parse_date("1995-01-01")], dtype=np.int32))
+    assert list(data) == [True, False]
+    y = Call(T.BIGINT, "extract_year", (d,))
+    data, _, _ = run(y, d=np.array([T.parse_date("1994-12-31"), T.parse_date("2000-02-29"), T.parse_date("1970-01-01")], dtype=np.int32))
+    assert list(data) == [1994, 2000, 1970]
+
+
+def test_like_over_dictionary():
+    d, codes = StringDictionary.from_strings(
+        ["PROMO ANODIZED TIN", "STANDARD BRUSHED STEEL", "PROMO PLATED COPPER"]
+    )
+    layout = ColumnLayout(types={"t": T.VARCHAR}, dictionaries={"t": d})
+    e = Call(T.BOOLEAN, "like", (InputRef(T.VARCHAR, "t"), Literal(T.VARCHAR, "PROMO%")))
+    data, _, _ = run(e, layout, t=codes)
+    assert list(data) == [True, False, True]
+
+
+def test_string_eq_literal():
+    d, codes = StringDictionary.from_strings(["AIR", "MAIL", "SHIP"])
+    layout = ColumnLayout(types={"m": T.VARCHAR}, dictionaries={"m": d})
+    e = Call(T.BOOLEAN, "eq", (InputRef(T.VARCHAR, "m"), Literal(T.VARCHAR, "MAIL")))
+    data, _, _ = run(e, layout, m=codes)
+    assert list(data) == [False, True, False]
+    # absent literal -> all false
+    e2 = Call(T.BOOLEAN, "eq", (InputRef(T.VARCHAR, "m"), Literal(T.VARCHAR, "TRUCK")))
+    data, _, _ = run(e2, layout, m=codes)
+    assert list(data) == [False, False, False]
+    # range comparison with absent literal: code bound still works
+    e3 = Call(T.BOOLEAN, "lt", (InputRef(T.VARCHAR, "m"), Literal(T.VARCHAR, "B")))
+    data, _, _ = run(e3, layout, m=codes)
+    assert list(data) == [True, False, False]
+
+
+def test_varchar_in():
+    d, codes = StringDictionary.from_strings(["AIR", "MAIL", "SHIP", "TRUCK"])
+    layout = ColumnLayout(types={"m": T.VARCHAR}, dictionaries={"m": d})
+    e = Call(T.BOOLEAN, "in", (
+        InputRef(T.VARCHAR, "m"),
+        Literal(T.VARCHAR, "MAIL"),
+        Literal(T.VARCHAR, "SHIP"),
+    ))
+    data, _, _ = run(e, layout, m=codes)
+    assert list(data) == [False, True, True, False]
+
+
+def test_substr_transform():
+    d, codes = StringDictionary.from_strings(["25-989-741-2988", "13-761-547-5974"])
+    layout = ColumnLayout(types={"p": T.VARCHAR}, dictionaries={"p": d})
+    e = Call(T.VARCHAR, "substr", (
+        InputRef(T.VARCHAR, "p"),
+        Literal(T.BIGINT, 1),
+        Literal(T.BIGINT, 2),
+    ))
+    data, _, c = run(e, layout, p=codes)
+    assert [str(c.dictionary.values[i]) for i in data] == ["25", "13"]
+
+
+def test_case_if_with_strings():
+    d, codes = StringDictionary.from_strings(["URGENT", "LOW", "HIGH"])
+    layout = ColumnLayout(types={"p": T.VARCHAR}, dictionaries={"p": d})
+    e = Call(T.BIGINT, "if", (
+        Call(T.BOOLEAN, "eq", (InputRef(T.VARCHAR, "p"), Literal(T.VARCHAR, "URGENT"))),
+        Literal(T.BIGINT, 1),
+        Literal(T.BIGINT, 0),
+    ))
+    data, _, _ = run(e, layout, p=codes)
+    assert list(data) == [1, 0, 0]
+
+
+def test_int_division_truncates_toward_zero():
+    e = Call(T.BIGINT, "divide", (bigint("a"), bigint("b")))
+    data, _, _ = run(e, a=np.array([7, -7]), b=np.array([2, 2]))
+    assert list(data) == [3, -3]  # SQL truncation, not floor
+
+
+def test_is_null_coalesce():
+    a = bigint("a")
+    e = Call(T.BOOLEAN, "is_null", (a,))
+    data, valid, _ = run(e, a=(np.array([1, 2]), np.array([True, False])))
+    assert list(data) == [False, True]
+    assert valid is None
+    e2 = Call(T.BIGINT, "coalesce", (a, Literal(T.BIGINT, 99)))
+    data, valid, _ = run(e2, a=(np.array([1, 2]), np.array([True, False])))
+    assert list(data) == [1, 99]

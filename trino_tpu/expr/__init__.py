@@ -1,0 +1,20 @@
+from trino_tpu.expr.ir import (
+    AggCall,
+    Call,
+    Cast,
+    InputRef,
+    Literal,
+    RowExpression,
+)
+from trino_tpu.expr.compiler import compile_expr, ColumnLayout
+
+__all__ = [
+    "AggCall",
+    "Call",
+    "Cast",
+    "InputRef",
+    "Literal",
+    "RowExpression",
+    "compile_expr",
+    "ColumnLayout",
+]

@@ -1,0 +1,665 @@
+"""Expression -> jittable column-function compiler.
+
+The analog of the reference's runtime bytecode generation
+(MAIN/sql/gen/ExpressionCompiler.java:56, PageFunctionCompiler.java:102):
+instead of emitting JVM bytecode per query, we trace typed
+RowExpressions into closures over jax.numpy ops. The closure evaluates
+a whole column at once; XLA fuses the resulting elementwise graph into
+the surrounding kernel.
+
+Null semantics: every evaluation returns ``(data, valid)`` where
+``valid`` is a boolean array or None (all valid). Logic ops implement
+SQL three-valued (Kleene) truth tables.
+
+Strings: device data is dictionary codes. String-content functions
+(LIKE, substr, lower, ...) are evaluated *over the dictionary values on
+host at compile time* — a LIKE becomes a boolean lookup table indexed
+by code, a substr becomes a code-remap gather. Each compiles to O(dict)
+host work once plus an O(n) device gather, replacing per-row string
+processing entirely (the dictionary-encode-early strategy from
+SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.expr.ir import Call, Cast, InputRef, Literal, RowExpression
+from trino_tpu.page import StringDictionary
+
+__all__ = ["ColumnLayout", "CompiledExpr", "compile_expr"]
+
+# evaluation environment: name -> (data, valid|None)
+Env = dict[str, tuple[jnp.ndarray, jnp.ndarray | None]]
+
+
+@dataclass
+class ColumnLayout:
+    """Input layout a compilation binds to: types + dictionaries.
+
+    The cache key role of (expression, input layout) mirrors
+    PageFunctionCompiler's cache keyed on RowExpression + channels.
+    """
+
+    types: dict[str, T.DataType] = field(default_factory=dict)
+    dictionaries: dict[str, StringDictionary | None] = field(default_factory=dict)
+
+
+@dataclass
+class CompiledExpr:
+    fn: Callable[[Env], tuple[jnp.ndarray, jnp.ndarray | None]]
+    type: T.DataType
+    dictionary: StringDictionary | None = None  # set when type is varchar
+    is_literal: bool = False
+
+
+def compile_expr(expr: RowExpression, layout: ColumnLayout) -> CompiledExpr:
+    return _Compiler(layout).compile(expr)
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+class _Compiler:
+    def __init__(self, layout: ColumnLayout):
+        self.layout = layout
+
+    def compile(self, expr: RowExpression) -> CompiledExpr:
+        if isinstance(expr, Literal):
+            return self._literal(expr)
+        if isinstance(expr, InputRef):
+            name = expr.name
+            return CompiledExpr(
+                lambda env: env[name],
+                expr.type,
+                self.layout.dictionaries.get(name),
+            )
+        if isinstance(expr, Cast):
+            return self._cast(expr)
+        if isinstance(expr, Call):
+            return self._call(expr)
+        raise NotImplementedError(f"cannot compile {expr!r}")
+
+    # ---- literals --------------------------------------------------------
+    def _literal(self, expr: Literal) -> CompiledExpr:
+        if expr.value is None:
+            dtype = expr.type.np_dtype
+            return CompiledExpr(
+                lambda env: (
+                    jnp.zeros((), dtype=dtype),
+                    jnp.zeros((), dtype=jnp.bool_),
+                ),
+                expr.type,
+            )
+        if isinstance(expr.type, T.VarcharType):
+            d = StringDictionary(np.asarray([str(expr.value)]))
+            return CompiledExpr(
+                lambda env: (jnp.zeros((), dtype=jnp.int32), None),
+                expr.type,
+                d,
+                is_literal=True,
+            )
+        value = _literal_device_value(expr)
+        dtype = expr.type.np_dtype
+        return CompiledExpr(
+            lambda env: (jnp.asarray(value, dtype=dtype), None),
+            expr.type,
+            is_literal=True,
+        )
+
+    # ---- casts -----------------------------------------------------------
+    def _cast(self, expr: Cast) -> CompiledExpr:
+        src = self.compile(expr.arg)
+        s_t, d_t = src.type, expr.type
+        if s_t == d_t:
+            return src
+
+        def wrap(f):
+            def ev(env):
+                data, valid = src.fn(env)
+                return f(data), valid
+
+            return CompiledExpr(ev, d_t)
+
+        if isinstance(d_t, T.DoubleType) or isinstance(d_t, T.RealType):
+            dtype = d_t.np_dtype
+            if isinstance(s_t, T.DecimalType):
+                scale = 10.0 ** s_t.scale
+                return wrap(lambda x: x.astype(dtype) / scale)
+            return wrap(lambda x: x.astype(dtype))
+        if isinstance(d_t, T.DecimalType):
+            if isinstance(s_t, T.DecimalType):
+                if d_t.scale >= s_t.scale:
+                    m = 10 ** (d_t.scale - s_t.scale)
+                    return wrap(lambda x: x * m)
+                m = 10 ** (s_t.scale - d_t.scale)
+                return wrap(lambda x: _div_round_half_up(x, m))
+            if s_t.is_integer:
+                m = 10 ** d_t.scale
+                return wrap(lambda x: x.astype(jnp.int64) * m)
+            if isinstance(s_t, (T.DoubleType, T.RealType)):
+                m = 10.0 ** d_t.scale
+                return wrap(lambda x: jnp.round(x * m).astype(jnp.int64))
+        if d_t.is_integer:
+            dtype = d_t.np_dtype
+            if isinstance(s_t, T.DecimalType):
+                m = 10 ** s_t.scale
+                return wrap(lambda x: _div_round_half_up(x, m).astype(dtype))
+            return wrap(lambda x: x.astype(dtype))
+        if isinstance(d_t, T.VarcharType):
+            raise NotImplementedError(f"cast {s_t} -> varchar not yet supported")
+        raise NotImplementedError(f"cast {s_t} -> {d_t}")
+
+    # ---- calls -----------------------------------------------------------
+    def _call(self, expr: Call) -> CompiledExpr:
+        name = expr.name
+        if name in ("and", "or"):
+            return self._logic(expr)
+        if name == "not":
+            a = self.compile(expr.args[0])
+            return CompiledExpr(
+                lambda env: (lambda d, v: (~d, v))(*a.fn(env)), T.BOOLEAN
+            )
+        if name == "is_null":
+            a = self.compile(expr.args[0])
+
+            def ev_isnull(env):
+                data, valid = a.fn(env)
+                if valid is None:
+                    return jnp.zeros(jnp.shape(data), dtype=jnp.bool_), None
+                return ~valid, None
+
+            return CompiledExpr(ev_isnull, T.BOOLEAN)
+        if name == "if":
+            return self._if(expr)
+        if name == "coalesce":
+            return self._coalesce(expr)
+        if name == "in":
+            return self._in(expr)
+        if name in _STRING_PREDICATES:
+            return self._string_predicate(expr)
+        if name in _STRING_TRANSFORMS:
+            return self._string_transform(expr)
+        if name in ("eq", "ne", "lt", "le", "gt", "ge"):
+            return self._comparison(expr)
+        if name in ("add", "subtract", "multiply", "divide", "modulus"):
+            return self._arith(expr)
+        if name == "negate":
+            a = self.compile(expr.args[0])
+            return CompiledExpr(
+                lambda env: (lambda d, v: (-d, v))(*a.fn(env)), expr.type
+            )
+        if name in _SIMPLE_FNS:
+            return self._simple(expr)
+        raise NotImplementedError(f"function {name} not implemented")
+
+    def _logic(self, expr: Call) -> CompiledExpr:
+        parts = [self.compile(a) for a in expr.args]
+        is_and = expr.name == "and"
+
+        def ev(env):
+            datas, valids = zip(*(p.fn(env) for p in parts))
+            # Kleene: fill nulls with the identity, track "known" rows
+            ident = True if is_and else False
+            filled = [
+                d if v is None else jnp.where(v, d, ident)
+                for d, v in zip(datas, valids)
+            ]
+            out = filled[0]
+            for f in filled[1:]:
+                out = (out & f) if is_and else (out | f)
+            if all(v is None for v in valids):
+                return out, None
+            # null unless every input known, or the result is decided
+            known = None
+            for v in valids:
+                known = _and_valid(known, v)
+            decided = out != ident  # AND: any false decides; OR: any true
+            return out, known | decided if known is not None else None
+
+        return CompiledExpr(ev, T.BOOLEAN)
+
+    def _if(self, expr: Call) -> CompiledExpr:
+        cond, then, els = (self.compile(a) for a in expr.args)
+        out_dict = _merge_result_dicts(expr.type, [then, els])
+
+        def ev(env):
+            c_d, c_v = cond.fn(env)
+            t_d, t_v = then.fn(env)
+            e_d, e_v = els.fn(env)
+            take_then = c_d if c_v is None else (c_d & c_v)
+            if out_dict is not None:
+                t_d = _redict(t_d, then, out_dict)
+                e_d = _redict(e_d, els, out_dict)
+            data = jnp.where(take_then, t_d, e_d)
+            if t_v is None and e_v is None:
+                return data, None
+            t_vv = t_v if t_v is not None else jnp.ones_like(take_then)
+            e_vv = e_v if e_v is not None else jnp.ones_like(take_then)
+            return data, jnp.where(take_then, t_vv, e_vv)
+
+        return CompiledExpr(ev, expr.type, out_dict)
+
+    def _coalesce(self, expr: Call) -> CompiledExpr:
+        parts = [self.compile(a) for a in expr.args]
+        out_dict = _merge_result_dicts(expr.type, parts)
+
+        def ev(env):
+            data, valid = parts[0].fn(env)
+            if out_dict is not None:
+                data = _redict(data, parts[0], out_dict)
+            for p in parts[1:]:
+                if valid is None:
+                    break
+                d, v = p.fn(env)
+                if out_dict is not None:
+                    d = _redict(d, p, out_dict)
+                data = jnp.where(valid, data, d)
+                valid = valid | (v if v is not None else True)
+            return data, valid
+
+        return CompiledExpr(ev, expr.type, out_dict)
+
+    def _in(self, expr: Call) -> CompiledExpr:
+        value = expr.args[0]
+        items = expr.args[1:]
+        a = self.compile(value)
+        if isinstance(value.type, T.VarcharType):
+            # IN over literal strings -> dictionary LUT
+            dict_ = a.dictionary
+            if dict_ is None or not all(isinstance(i, Literal) for i in items):
+                raise NotImplementedError("varchar IN requires literal list")
+            wanted = {str(i.value) for i in items}
+            lut = np.isin(dict_.values, list(wanted))
+            lut_dev = jnp.asarray(lut)
+
+            def ev_str(env):
+                data, valid = a.fn(env)
+                return jnp.take(lut_dev, data, mode="clip"), valid
+
+            return CompiledExpr(ev_str, T.BOOLEAN)
+        compiled_items = [self.compile(i) for i in items]
+
+        def ev(env):
+            data, valid = a.fn(env)
+            out = None
+            for ci in compiled_items:
+                d, v = ci.fn(env)
+                hit = data == d
+                if v is not None:
+                    hit = hit & v
+                out = hit if out is None else out | hit
+            return out, valid
+
+        return CompiledExpr(ev, T.BOOLEAN)
+
+    def _comparison(self, expr: Call) -> CompiledExpr:
+        lhs, rhs = expr.args
+        a = self.compile(lhs)
+        b = self.compile(rhs)
+        if isinstance(lhs.type, T.VarcharType) or isinstance(rhs.type, T.VarcharType):
+            return self._string_comparison(expr, a, b)
+        op = _CMP_OPS[expr.name]
+
+        def ev(env):
+            a_d, a_v = a.fn(env)
+            b_d, b_v = b.fn(env)
+            return op(a_d, b_d), _and_valid(a_v, b_v)
+
+        return CompiledExpr(ev, T.BOOLEAN)
+
+    def _string_comparison(self, expr: Call, a: CompiledExpr, b: CompiledExpr) -> CompiledExpr:
+        op = _CMP_OPS[expr.name]
+        if a.is_literal and not b.is_literal:
+            # normalize literal to the rhs with the mirrored operator
+            mirrored = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+            name = mirrored.get(expr.name, expr.name)
+            return self._string_comparison(
+                Call(T.BOOLEAN, name, (expr.args[1], expr.args[0])), b, a
+            )
+        # literal rhs: translate to a code comparison against the
+        # column's dictionary (codes are in lexicographic order)
+        if a.dictionary is not None and b.dictionary is not None:
+            if b.is_literal:
+                s = str(b.dictionary.values[0])
+                code, exact = _code_bound(a.dictionary, s)
+
+                # when the literal is absent, `code` is the insertion
+                # point: x < s  <=>  x <= s  <=>  code(x) < code, and
+                # x > s  <=>  x >= s  <=>  code(x) >= code
+                name = expr.name
+                if not exact:
+                    name = {"le": "lt", "gt": "ge"}.get(name, name)
+
+                def ev_lit(env):
+                    a_d, a_v = a.fn(env)
+                    if name == "eq":
+                        r = (a_d == code) if exact else jnp.zeros_like(a_d, dtype=jnp.bool_)
+                    elif name == "ne":
+                        r = (a_d != code) if exact else jnp.ones_like(a_d, dtype=jnp.bool_)
+                    else:
+                        r = _CMP_OPS[name](a_d, jnp.asarray(code, dtype=a_d.dtype))
+                    return r, a_v
+
+                return CompiledExpr(ev_lit, T.BOOLEAN)
+            if a.dictionary is b.dictionary:
+                def ev_shared(env):
+                    a_d, a_v = a.fn(env)
+                    b_d, b_v = b.fn(env)
+                    return op(a_d, b_d), _and_valid(a_v, b_v)
+
+                return CompiledExpr(ev_shared, T.BOOLEAN)
+        raise NotImplementedError(
+            "varchar comparison requires a literal or a shared dictionary"
+        )
+
+    def _string_predicate(self, expr: Call) -> CompiledExpr:
+        """LIKE & friends: host-eval over the dictionary -> device LUT."""
+        a = self.compile(expr.args[0])
+        if a.dictionary is None:
+            raise NotImplementedError(f"{expr.name} requires a dictionary input")
+        if expr.name in ("like", "not_like"):
+            pattern = str(expr.args[1].value)  # type: ignore[attr-defined]
+            rx = re.compile(_like_to_regex(pattern), re.DOTALL)
+            lut = np.fromiter(
+                (rx.fullmatch(str(v)) is not None for v in a.dictionary.values),
+                dtype=np.bool_,
+                count=len(a.dictionary),
+            )
+            if expr.name == "not_like":
+                lut = ~lut
+        else:
+            raise NotImplementedError(expr.name)
+        lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros(1, dtype=jnp.bool_)
+
+        def ev(env):
+            data, valid = a.fn(env)
+            return jnp.take(lut_dev, data, mode="clip"), valid
+
+        return CompiledExpr(ev, T.BOOLEAN)
+
+    def _string_transform(self, expr: Call) -> CompiledExpr:
+        """substr/lower/upper/...: transform dictionary values on host,
+        re-sort, and compile to a device code-remap gather."""
+        a = self.compile(expr.args[0])
+        if a.dictionary is None:
+            raise NotImplementedError(f"{expr.name} requires a dictionary input")
+        f = _STRING_TRANSFORMS[expr.name]
+        lits = [l.value for l in expr.args[1:]]  # type: ignore[attr-defined]
+        transformed = np.asarray(
+            [f(str(v), *lits) for v in a.dictionary.values], dtype=object
+        )
+        if len(transformed):
+            new_dict, codes = StringDictionary.from_strings(transformed)
+            remap = jnp.asarray(codes)
+        else:
+            new_dict, remap = StringDictionary(np.asarray([], dtype=object)), jnp.zeros(
+                1, dtype=jnp.int32
+            )
+
+        def ev(env):
+            data, valid = a.fn(env)
+            return jnp.take(remap, data, mode="clip"), valid
+
+        return CompiledExpr(ev, expr.type, new_dict)
+
+    def _arith(self, expr: Call) -> CompiledExpr:
+        lhs, rhs = expr.args
+        a = self.compile(lhs)
+        b = self.compile(rhs)
+        name = expr.name
+        out_t = expr.type
+
+        if isinstance(out_t, T.DecimalType):
+            return self._decimal_arith(expr, a, b)
+
+        ops = {
+            "add": jnp.add,
+            "subtract": jnp.subtract,
+            "multiply": jnp.multiply,
+        }
+        if name in ops:
+            op = ops[name]
+
+            def ev(env):
+                a_d, a_v = a.fn(env)
+                b_d, b_v = b.fn(env)
+                return op(a_d, b_d).astype(out_t.np_dtype), _and_valid(a_v, b_v)
+
+            return CompiledExpr(ev, out_t)
+        if name == "divide":
+            if out_t.is_integer:
+                def ev_idiv(env):
+                    a_d, a_v = a.fn(env)
+                    b_d, b_v = b.fn(env)
+                    safe = jnp.where(b_d == 0, 1, b_d)
+                    q = _int_div_trunc(a_d, safe)
+                    # division by zero nulls the row (the reference
+                    # raises DIVISION_BY_ZERO; vectorized execution
+                    # cannot raise per-row — masked at output instead)
+                    return q.astype(out_t.np_dtype), _and_valid(
+                        _and_valid(a_v, b_v), b_d != 0
+                    )
+
+                return CompiledExpr(ev_idiv, out_t)
+
+            def ev_fdiv(env):
+                a_d, a_v = a.fn(env)
+                b_d, b_v = b.fn(env)
+                return (a_d / b_d).astype(out_t.np_dtype), _and_valid(a_v, b_v)
+
+            return CompiledExpr(ev_fdiv, out_t)
+        if name == "modulus":
+            def ev_mod(env):
+                a_d, a_v = a.fn(env)
+                b_d, b_v = b.fn(env)
+                safe = jnp.where(b_d == 0, 1, b_d)
+                r = a_d - _int_div_trunc(a_d, safe) * safe
+                return r.astype(out_t.np_dtype), _and_valid(
+                    _and_valid(a_v, b_v), b_d != 0
+                )
+
+            return CompiledExpr(ev_mod, out_t)
+        raise NotImplementedError(name)
+
+    def _decimal_arith(self, expr: Call, a: CompiledExpr, b: CompiledExpr) -> CompiledExpr:
+        """Decimal arithmetic on unscaled int64 (reference semantics:
+        MAIN/type/DecimalOperators.java — round half-up on divide)."""
+        out_t: T.DecimalType = expr.type  # type: ignore[assignment]
+        name = expr.name
+        s_a = a.type.scale if isinstance(a.type, T.DecimalType) else 0
+        s_b = b.type.scale if isinstance(b.type, T.DecimalType) else 0
+
+        def ev(env):
+            a_d, a_v = a.fn(env)
+            b_d, b_v = b.fn(env)
+            valid = _and_valid(a_v, b_v)
+            a_i = a_d.astype(jnp.int64)
+            b_i = b_d.astype(jnp.int64)
+            if name in ("add", "subtract"):
+                a_i = a_i * 10 ** (out_t.scale - s_a)
+                b_i = b_i * 10 ** (out_t.scale - s_b)
+                out = a_i + b_i if name == "add" else a_i - b_i
+            elif name == "multiply":
+                out = a_i * b_i  # scale s_a + s_b == out_t.scale
+            elif name == "divide":
+                # rescale so that quotient has out_t.scale
+                shift = out_t.scale - s_a + s_b
+                num = a_i * 10**shift
+                safe = jnp.where(b_i == 0, 1, b_i)
+                out = _div_round_half_up(num, safe)
+                valid = _and_valid(valid, b_i != 0)  # null the /0 rows
+            elif name == "modulus":
+                safe = jnp.where(b_i == 0, 1, b_i)
+                out = a_i - _int_div_trunc(a_i, safe) * safe
+                valid = _and_valid(valid, b_i != 0)
+            else:
+                raise NotImplementedError(name)
+            return out, valid
+
+        return CompiledExpr(ev, out_t)
+
+    def _simple(self, expr: Call) -> CompiledExpr:
+        parts = [self.compile(a) for a in expr.args]
+        f = _SIMPLE_FNS[expr.name]
+        out_t = expr.type
+
+        def ev(env):
+            vals = [p.fn(env) for p in parts]
+            datas = [d for d, _ in vals]
+            valid = None
+            for _, v in vals:
+                valid = _and_valid(valid, v)
+            return f(*datas).astype(out_t.np_dtype), valid
+
+        return CompiledExpr(ev, out_t)
+
+
+# ---- helpers -------------------------------------------------------------
+
+def _literal_device_value(expr: Literal):
+    v = expr.value
+    if isinstance(expr.type, T.DateType) and isinstance(v, str):
+        return T.parse_date(v)
+    if isinstance(expr.type, T.DecimalType):
+        from decimal import Decimal
+
+        return int(
+            (Decimal(str(v)) * (10 ** expr.type.scale)).to_integral_value()
+        )
+    return v
+
+
+def _int_div_trunc(a, b):
+    """C-style truncating integer division (SQL semantics), vs
+    python/jnp floor division."""
+    q = a // b
+    r = a - q * b
+    fix = (r != 0) & ((a < 0) != (b < 0))
+    return q + jnp.where(fix, 1, 0)
+
+
+def _div_round_half_up(a, b):
+    """Integer divide rounding half away from zero (Trino decimal rule,
+    MAIN reference io.trino.spi.type.Decimals.rescale)."""
+    sign = jnp.where((a < 0) != (b < 0), -1, 1)
+    aa = jnp.abs(a)
+    ab = jnp.abs(b)
+    return sign * ((aa + ab // 2) // ab)
+
+
+def _like_to_regex(pattern: str, escape: str | None = None) -> str:
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if escape and ch == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "".join(out)
+
+
+def _code_bound(d: StringDictionary, s: str) -> tuple[int, bool]:
+    """(code position of s in dictionary, whether s is present).
+
+    For non-equality comparisons the insertion point works as the
+    bound: x < s  <=>  code(x) < insertion_point when s absent.
+    """
+    i = int(np.searchsorted(d.values, s))
+    exact = i < len(d.values) and d.values[i] == s
+    if not exact and i == len(d.values):
+        # all values < s: use a code past the end
+        return len(d.values), False
+    return i, exact
+
+
+def _merge_result_dicts(out_type, parts):
+    if not isinstance(out_type, T.VarcharType):
+        return None
+    dicts = [p.dictionary for p in parts]
+    if any(d is None for d in dicts):
+        raise NotImplementedError("varchar branches must be dictionary-backed")
+    merged = dicts[0]
+    for d in dicts[1:]:
+        if d is not merged:
+            merged, _, _ = merged.union(d)
+    return merged
+
+
+def _redict(data, part: CompiledExpr, merged: StringDictionary):
+    if part.dictionary is merged:
+        return data
+    remap = np.searchsorted(merged.values, part.dictionary.values).astype(np.int32)
+    if len(remap) == 0:
+        return data
+    return jnp.take(jnp.asarray(remap), data, mode="clip")
+
+
+_CMP_OPS = {
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+}
+
+_STRING_PREDICATES = {"like", "not_like"}
+
+_STRING_TRANSFORMS: dict[str, Callable] = {
+    "substr": lambda s, start, length=None: (
+        s[int(start) - 1 : int(start) - 1 + int(length)]
+        if length is not None
+        else s[int(start) - 1 :]
+    ),
+    "lower": lambda s: s.lower(),
+    "upper": lambda s: s.upper(),
+    "trim": lambda s: s.strip(),
+}
+
+
+def _extract_civil(days):
+    """Vectorized Gregorian calendar decomposition of epoch days
+    (days-from-civil inverse, Howard Hinnant's algorithm)."""
+    z = days.astype(jnp.int64) + 719_468
+    era = jnp.where(z >= 0, z, z - 146_096) // 146_097
+    doe = z - era * 146_097
+    yoe = (doe - doe // 1460 + doe // 36_524 - doe // 146_096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+_SIMPLE_FNS: dict[str, Callable] = {
+    "extract_year": lambda d: _extract_civil(d)[0],
+    "extract_month": lambda d: _extract_civil(d)[1],
+    "extract_day": lambda d: _extract_civil(d)[2],
+    "abs": jnp.abs,
+    "sqrt": jnp.sqrt,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+}

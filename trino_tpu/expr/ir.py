@@ -1,0 +1,81 @@
+"""Typed row-expression IR.
+
+The analog of the reference's ``RowExpression`` tree
+(MAIN/sql/relational/RowExpression.java: ConstantExpression,
+InputReferenceExpression, CallExpression, SpecialForm). The analyzer
+produces *typed* nodes with explicit ``Cast``s inserted, so the
+compiler is a straightforward (function, argument types) -> kernel
+dispatch with no implicit coercion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from trino_tpu import types as T
+
+__all__ = ["RowExpression", "Literal", "InputRef", "Call", "Cast", "AggCall"]
+
+
+@dataclass(frozen=True)
+class RowExpression:
+    type: T.DataType
+
+
+@dataclass(frozen=True)
+class Literal(RowExpression):
+    value: Any = None  # python value; None = SQL NULL
+
+    def __repr__(self):
+        return f"lit({self.value!r}:{self.type})"
+
+
+@dataclass(frozen=True)
+class InputRef(RowExpression):
+    name: str = ""
+
+    def __repr__(self):
+        return f"{self.name}:{self.type}"
+
+
+@dataclass(frozen=True)
+class Call(RowExpression):
+    """Scalar function or operator call.
+
+    Function names are lowercase: arithmetic ("add", "subtract",
+    "multiply", "divide", "modulus", "negate"), comparison ("eq", "ne",
+    "lt", "le", "gt", "ge"), logic ("and", "or", "not"), special forms
+    ("if", "case", "coalesce", "in", "between", "is_null", "like"),
+    and the scalar library ("extract_year", "substr", ...).
+    """
+
+    name: str = ""
+    args: tuple[RowExpression, ...] = ()
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+@dataclass(frozen=True)
+class Cast(RowExpression):
+    arg: RowExpression = None  # type: ignore[assignment]
+
+    def __repr__(self):
+        return f"cast({self.arg!r} as {self.type})"
+
+
+@dataclass(frozen=True)
+class AggCall:
+    """Aggregate function reference used by Aggregate plan nodes
+    (analog of MAIN/sql/planner/plan/AggregationNode.Aggregation)."""
+
+    name: str  # sum/count/avg/min/max/count_all/...
+    args: tuple[RowExpression, ...]
+    type: T.DataType
+    distinct: bool = False
+    filter: RowExpression | None = None
+
+    def __repr__(self):
+        d = "distinct " if self.distinct else ""
+        return f"{self.name}({d}{', '.join(map(repr, self.args))})"
