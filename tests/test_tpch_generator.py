@@ -70,8 +70,8 @@ def test_sqlite_golden_loads(tiny):
     n = conn.execute("select count(*) from supplier").fetchone()[0]
     assert n == 100
     rows = conn.execute(
-        "select n.name, r.name from nation n join region r on n.regionkey = r.regionkey "
-        "where r.name = 'ASIA' order by n.name"
+        "select n_name, r_name from nation join region on n_regionkey = r_regionkey "
+        "where r_name = 'ASIA' order by n_name"
     ).fetchall()
     assert [r[0] for r in rows] == ["CHINA", "INDIA", "INDONESIA", "JAPAN", "VIETNAM"]
 

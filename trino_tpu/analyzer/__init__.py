@@ -1,0 +1,3 @@
+from trino_tpu.analyzer.analyzer import Analyzer, AnalysisError
+
+__all__ = ["Analyzer", "AnalysisError"]

@@ -1,0 +1,984 @@
+"""Analyzer + relation planner: AST -> typed logical plan.
+
+The analog of the reference's StatementAnalyzer + RelationPlanner +
+QueryPlanner (MAIN/sql/analyzer/StatementAnalyzer.java,
+MAIN/sql/planner/QueryPlanner.java): resolves names against catalog
+metadata, types every expression (inserting casts), lowers SELECT
+blocks to plan nodes, and decorrelates subqueries:
+
+- uncorrelated scalar subquery  -> cross join with a one-row subplan
+- correlated scalar aggregate   -> group the subquery by its
+  correlation keys, left-join on them (classic decorrelation; the
+  reference routes this through ApplyNode +
+  TransformCorrelatedScalarAggregationToJoin)
+- [NOT] EXISTS / [NOT] IN (q)   -> SemiJoin on extracted equality keys
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+
+from trino_tpu import types as T
+from trino_tpu.expr.ir import AggCall, Call, Cast, InputRef, Literal, RowExpression
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.plan import nodes as P
+from trino_tpu.analyzer.scope import (
+    AGG_FNS,
+    SCALAR_FNS,
+    AnalysisError,
+    Field,
+    Scope,
+    SymbolAllocator,
+    agg_result_type,
+    arith_result_type,
+)
+from trino_tpu.sql import ast
+
+__all__ = ["Analyzer", "AnalysisError"]
+
+
+@dataclass
+class RelationPlan:
+    node: P.PlanNode
+    scope: Scope
+
+
+def _ast_key(node) -> str:
+    """Structural key for matching group-by exprs / duplicate aggregates
+    (dataclass reprs are deterministic and structural)."""
+    return repr(node)
+
+
+def split_conjuncts(e: ast.Expr) -> list[ast.Expr]:
+    if isinstance(e, ast.Binary) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def _contains_subquery(e) -> bool:
+    if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+        return True
+    for v in vars(e).values() if hasattr(e, "__dict__") else []:
+        if isinstance(v, ast.Expr) and _contains_subquery(v):
+            return True
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, ast.Expr) and _contains_subquery(x):
+                    return True
+                if isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, ast.Expr) and _contains_subquery(y):
+                            return True
+    return False
+
+
+def _find_scalar_subqueries(e, out: list):
+    if isinstance(e, ast.ScalarSubquery):
+        out.append(e)
+        return
+    for v in vars(e).values() if hasattr(e, "__dict__") else []:
+        if isinstance(v, ast.Expr):
+            _find_scalar_subqueries(v, out)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, ast.Expr):
+                    _find_scalar_subqueries(x, out)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, ast.Expr):
+                            _find_scalar_subqueries(y, out)
+
+
+class Analyzer:
+    def __init__(self, metadata: Metadata, session: Session):
+        self.metadata = metadata
+        self.session = session
+        self.symbols = SymbolAllocator()
+
+    # ---- entry -----------------------------------------------------------
+    def analyze(self, stmt: ast.Statement) -> P.PlanNode:
+        if isinstance(stmt, ast.Query):
+            rp, names = self.plan_query(stmt, outer=None, ctes={})
+            symbols = [f.symbol for f in rp.scope.fields]
+            return P.Output(
+                outputs=dict(rp.node.outputs),
+                source=rp.node,
+                names=names,
+                symbols=symbols,
+            )
+        raise AnalysisError(f"unsupported statement {type(stmt).__name__}")
+
+    # ---- queries ---------------------------------------------------------
+    def plan_query(
+        self, q: ast.Query, outer: Scope | None, ctes: dict
+    ) -> tuple[RelationPlan, list[str]]:
+        ctes = dict(ctes)
+        for name, cte_q in q.with_:
+            ctes[name.lower()] = cte_q
+        if isinstance(q.select, ast.SetOp):
+            raise AnalysisError("set operations are not supported yet")
+        rp, names, alias_syms, pre_scope = self.plan_select(q.select, outer, ctes)
+        node = rp.node
+        if q.order_by:
+            keys, node = self._order_keys(q.order_by, node, rp.scope, alias_syms)
+            if q.limit is not None and q.offset is None:
+                node = P.TopN(dict(node.outputs), source=node, count=q.limit, keys=keys)
+            else:
+                node = P.Sort(dict(node.outputs), source=node, keys=keys)
+                if q.limit is not None or q.offset:
+                    node = P.Limit(
+                        dict(node.outputs), source=node,
+                        count=q.limit if q.limit is not None else -1,
+                        offset=q.offset or 0,
+                    )
+        elif q.limit is not None or q.offset:
+            node = P.Limit(
+                dict(node.outputs), source=node,
+                count=q.limit if q.limit is not None else -1, offset=q.offset or 0
+            )
+        return RelationPlan(node, rp.scope), names
+
+    def _order_keys(self, order_by, node, scope: Scope, alias_syms: dict):
+        keys = []
+        for item in order_by:
+            e = item.expr
+            sym = None
+            if isinstance(e, ast.Ident) and len(e.parts) == 1 and e.parts[0] in alias_syms:
+                sym = alias_syms[e.parts[0]]
+            elif isinstance(e, ast.IntLit):
+                syms = list(node.outputs)
+                if not (1 <= e.value <= len(syms)):
+                    raise AnalysisError(f"ORDER BY position {e.value} out of range")
+                sym = syms[e.value - 1]
+            elif _ast_key(e) in alias_syms:
+                sym = alias_syms[_ast_key(e)]
+            else:
+                ea = ExprAnalyzer(self, scope)
+                ir = ea.analyze(e)
+                if isinstance(ir, InputRef) and ir.name in node.outputs:
+                    sym = ir.name
+                else:
+                    raise AnalysisError(
+                        f"ORDER BY expression must be a select item: {e!r}"
+                    )
+            keys.append(P.SortKey(sym, item.ascending, item.nulls_first))
+        return keys, node
+
+    # ---- select ----------------------------------------------------------
+    def plan_select(self, sel: ast.Select, outer: Scope | None, ctes: dict):
+        # FROM
+        if sel.relations:
+            rp = self.plan_relation(sel.relations[0], outer, ctes)
+            for r in sel.relations[1:]:
+                right = self.plan_relation(r, outer, ctes)
+                rp = self._cross_join(rp, right)
+        else:
+            rp = RelationPlan(P.Values({}, rows=[[]]), Scope([], parent=outer))
+        node, scope = rp.node, rp.scope
+
+        outer_refs: set[str] = set()
+
+        # WHERE (with subquery handling)
+        if sel.where is not None:
+            node, scope = self._apply_where(node, scope, sel.where, ctes, outer_refs)
+
+        # aggregation
+        agg_items = self._collect_aggs(sel)
+        replacements: dict[str, InputRef] = {}
+        group_syms: list[str] = []
+        if sel.group_by or agg_items:
+            node, scope, replacements, group_syms = self._plan_aggregation(
+                node, scope, sel, agg_items, ctes, outer_refs
+            )
+
+        # HAVING
+        if sel.having is not None:
+            node, scope = self._apply_where(
+                node, scope, sel.having, ctes, outer_refs,
+                replacements=replacements, restrict_to=group_syms or None,
+            )
+
+        # SELECT items
+        assignments: dict[str, RowExpression] = {}
+        names: list[str] = []
+        fields: list[Field] = []
+        alias_syms: dict[str, str] = {}
+        restrict = group_syms if (sel.group_by or agg_items) else None
+        for item in sel.items:
+            if isinstance(item.expr, ast.Star):
+                for f in scope.visible_fields(
+                    item.expr.qualifier[-1] if item.expr.qualifier else None
+                ):
+                    sym = self.symbols.new(f.name, f.type)
+                    assignments[sym] = InputRef(f.type, f.symbol)
+                    names.append(f.name)
+                    fields.append(Field(f.name, sym, f.type))
+                continue
+            ea = ExprAnalyzer(
+                self, scope, replacements=replacements,
+                restrict_to=restrict, outer_refs=outer_refs,
+            )
+            ir = ea.analyze(item.expr)
+            name = item.alias or _derive_name(item.expr)
+            sym = self.symbols.new(name or "expr", ir.type)
+            assignments[sym] = ir
+            names.append(name or f"_col{len(names)}")
+            fields.append(Field((name or "").lower(), sym, ir.type))
+            if item.alias:
+                alias_syms[item.alias.lower()] = sym
+            alias_syms[_ast_key(item.expr)] = sym
+        node = P.Project(
+            {s: e.type for s, e in assignments.items()},
+            source=node,
+            assignments=assignments,
+        )
+        scope = Scope(fields, parent=outer)
+
+        if sel.distinct:
+            node = P.Aggregate(
+                dict(node.outputs), source=node,
+                group_keys=list(node.outputs), aggregates={},
+            )
+        return RelationPlan(node, scope), names, alias_syms, scope
+
+    # ---- FROM relations --------------------------------------------------
+    def plan_relation(self, rel: ast.Relation, outer: Scope | None, ctes: dict) -> RelationPlan:
+        if isinstance(rel, ast.TableRef):
+            if len(rel.parts) == 1 and rel.parts[0].lower() in ctes:
+                sub_rp, names = self.plan_query(ctes[rel.parts[0].lower()], outer, ctes)
+                alias = (rel.alias or rel.parts[0]).lower()
+                fields = [
+                    Field(n.lower(), f.symbol, f.type, alias)
+                    for n, f in zip(names, sub_rp.scope.fields)
+                ]
+                return RelationPlan(sub_rp.node, Scope(fields, parent=outer))
+            qt, schema = self.metadata.resolve_table(self.session, rel.parts)
+            alias = (rel.alias or qt.table).lower()
+            assignments = {}
+            fields = []
+            outputs = {}
+            for col, typ in schema.columns:
+                sym = self.symbols.new(col, typ)
+                assignments[sym] = col
+                outputs[sym] = typ
+                fields.append(Field(col.lower(), sym, typ, alias))
+            node = P.TableScan(
+                outputs, catalog=qt.catalog, schema=qt.schema, table=qt.table,
+                assignments=assignments,
+            )
+            return RelationPlan(node, Scope(fields, parent=outer))
+        if isinstance(rel, ast.SubqueryRel):
+            sub_rp, names = self.plan_query(rel.query, outer, ctes)
+            alias = rel.alias.lower() if rel.alias else None
+            fields = [
+                Field(n.lower(), f.symbol, f.type, alias)
+                for n, f in zip(names, sub_rp.scope.fields)
+            ]
+            return RelationPlan(sub_rp.node, Scope(fields, parent=outer))
+        if isinstance(rel, ast.JoinRel):
+            return self._plan_join(rel, outer, ctes)
+        raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+    def _cross_join(self, left: RelationPlan, right: RelationPlan) -> RelationPlan:
+        outputs = {**left.node.outputs, **right.node.outputs}
+        node = P.Join(outputs, kind="cross", left=left.node, right=right.node)
+        return RelationPlan(
+            node, Scope(left.scope.fields + right.scope.fields, parent=left.scope.parent)
+        )
+
+    def _plan_join(self, rel: ast.JoinRel, outer: Scope | None, ctes: dict) -> RelationPlan:
+        left = self.plan_relation(rel.left, outer, ctes)
+        right = self.plan_relation(rel.right, outer, ctes)
+        combined = self._cross_join(left, right)
+        if rel.kind == "cross":
+            return combined
+        join_node: P.Join = combined.node  # type: ignore[assignment]
+        join_node.kind = rel.kind
+        if rel.using:
+            conds = []
+            for col in rel.using:
+                lf, _ = left.scope.resolve((col,))
+                rf, _ = right.scope.resolve((col,))
+                conds.append((lf, rf))
+            join_node.criteria = [(lf.symbol, rf.symbol) for lf, rf in conds]
+            return combined
+        if rel.on is None:
+            raise AnalysisError("JOIN requires ON or USING")
+        # split ON into equi criteria (left vs right) and residual filter
+        left_syms = {f.symbol for f in left.scope.fields}
+        right_syms = {f.symbol for f in right.scope.fields}
+        residual: list[RowExpression] = []
+        ea = ExprAnalyzer(self, combined.scope)
+        for c in split_conjuncts(rel.on):
+            ir = ea.analyze(c)
+            pair = _equi_pair(ir, left_syms, right_syms)
+            if pair is not None:
+                join_node.criteria.append(pair)
+            else:
+                residual.append(ir)
+        if residual:
+            join_node.filter = _and_all(residual)
+        return combined
+
+    # ---- WHERE / subqueries ----------------------------------------------
+    def _apply_where(
+        self, node, scope, where_ast, ctes, outer_refs,
+        replacements=None, restrict_to=None,
+    ):
+        for c in split_conjuncts(where_ast):
+            c, negated = _strip_not(c)
+            if isinstance(c, (ast.Exists, ast.InSubquery)):
+                neg = negated != getattr(c, "negated", False)
+                node, scope, match_sym = self._plan_semijoin(node, scope, c, ctes)
+                pred = InputRef(T.BOOLEAN, match_sym)
+                if neg:
+                    pred = Call(T.BOOLEAN, "not", (pred,))
+                node = P.Filter(
+                    {k: v for k, v in node.outputs.items() if k != match_sym},
+                    source=node, predicate=pred,
+                )
+                continue
+            subqueries: list[ast.ScalarSubquery] = []
+            _find_scalar_subqueries(c, subqueries)
+            repl = dict(replacements or {})
+            if subqueries:
+                for sq in subqueries:
+                    node, scope, sym, typ = self._plan_scalar_subquery(
+                        node, scope, sq, ctes
+                    )
+                    repl[_ast_key(sq)] = InputRef(typ, sym)
+            ea = ExprAnalyzer(
+                self, scope, replacements=repl,
+                restrict_to=restrict_to, outer_refs=outer_refs,
+            )
+            ir = ea.analyze(c if not negated else ast.Unary("not", c))
+            if ir.type != T.BOOLEAN:
+                raise AnalysisError("WHERE/HAVING predicate must be boolean")
+            node = P.Filter(dict(node.outputs), source=node, predicate=ir)
+        return node, scope
+
+    def _plan_semijoin(self, node, scope, c, ctes):
+        """[NOT] EXISTS(q) / x [NOT] IN (q) -> SemiJoin."""
+        q = c.query
+        sub_refs: set[str] = set()
+        sub_rp, _ = self._plan_subquery(q, scope, ctes, sub_refs)
+        sub_node, sub_scope = sub_rp.node, sub_rp.scope
+        keys: list[tuple[str, str]] = []
+        if isinstance(c, ast.InSubquery):
+            ea = ExprAnalyzer(self, scope)
+            arg = ea.analyze(c.arg)
+            if not isinstance(arg, InputRef):
+                sym = self.symbols.new("in_arg", arg.type)
+                node = P.Project(
+                    {**node.outputs, sym: arg.type}, source=node,
+                    assignments={
+                        **{s: InputRef(t, s) for s, t in node.outputs.items()},
+                        sym: arg,
+                    },
+                )
+                arg = InputRef(arg.type, sym)
+            inner_sym = list(sub_node.outputs)[0]
+            keys.append((arg.name, inner_sym))
+        if sub_refs:
+            sub_node, corr = _extract_correlation(sub_node, sub_refs)
+            for outer_sym, inner_sym in corr:
+                keys.append((outer_sym, inner_sym))
+        if not keys:
+            raise AnalysisError(
+                "EXISTS subquery must be correlated by an equality predicate"
+            )
+        match_sym = self.symbols.new("match", T.BOOLEAN)
+        sj = P.SemiJoin(
+            {**node.outputs, match_sym: T.BOOLEAN},
+            source=node, filter_source=sub_node,
+            keys=keys, match_symbol=match_sym,
+        )
+        return sj, scope, match_sym
+
+    def _plan_scalar_subquery(self, node, scope, sq: ast.ScalarSubquery, ctes):
+        sub_refs: set[str] = set()
+        sub_rp, _ = self._plan_subquery(sq.query, scope, ctes, sub_refs)
+        sub_node = sub_rp.node
+        value_sym = list(sub_node.outputs)[0]
+        value_type = sub_node.outputs[value_sym]
+        if not sub_refs:
+            # uncorrelated: cross join the (single-row) subplan
+            outputs = {**node.outputs, **sub_node.outputs}
+            node = P.Join(outputs, kind="cross", left=node, right=sub_node)
+            return node, scope, value_sym, value_type
+        # correlated scalar aggregate: group by correlation keys + left join
+        sub_node, corr = _extract_correlation_through_agg(
+            sub_node, sub_refs, self.symbols
+        )
+        criteria = [(outer_sym, inner_sym) for outer_sym, inner_sym in corr]
+        outputs = {**node.outputs, value_sym: value_type}
+        join = P.Join(
+            {**node.outputs, **sub_node.outputs},
+            kind="left", left=node, right=sub_node, criteria=criteria,
+        )
+        return join, scope, value_sym, value_type
+
+    def _plan_subquery(self, q: ast.Query, outer_scope: Scope, ctes, refs_out: set):
+        """Plan a subquery allowing references to the outer scope;
+        collect the outer symbols used."""
+        analyzer_refs: set[str] = set()
+        rp, names = SubqueryPlanner(self, outer_scope, analyzer_refs).plan(q, ctes)
+        refs_out |= analyzer_refs
+        return rp, names
+
+    # ---- aggregation -----------------------------------------------------
+    def _collect_aggs(self, sel: ast.Select) -> list[ast.FnCall]:
+        found: list[ast.FnCall] = []
+        seen: set[str] = set()
+
+        def walk(e):
+            if isinstance(e, ast.FnCall) and (
+                e.name.lower() in AGG_FNS or e.star
+            ):
+                k = _ast_key(e)
+                if k not in seen:
+                    seen.add(k)
+                    found.append(e)
+                return  # no nested aggregates
+            if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+                return
+            for v in vars(e).values() if hasattr(e, "__dict__") else []:
+                if isinstance(v, ast.Expr):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if isinstance(x, ast.Expr):
+                            walk(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, ast.Expr):
+                                    walk(y)
+
+        for item in sel.items:
+            if not isinstance(item.expr, ast.Star):
+                walk(item.expr)
+        if sel.having is not None:
+            walk(sel.having)
+        return found
+
+    def _plan_aggregation(self, node, scope, sel, agg_items, ctes, outer_refs):
+        # group keys
+        group_syms: list[str] = []
+        key_replacements: dict[str, InputRef] = {}
+        pre_assignments: dict[str, RowExpression] = {
+            s: InputRef(t, s) for s, t in node.outputs.items()
+        }
+        need_pre_project = False
+        for g in sel.group_by:
+            if isinstance(g, ast.IntLit):  # ordinal
+                if not (1 <= g.value <= len(sel.items)):
+                    raise AnalysisError(f"GROUP BY position {g.value} out of range")
+                g = sel.items[g.value - 1].expr
+            ea = ExprAnalyzer(self, scope, outer_refs=outer_refs)
+            ir = ea.analyze(g)
+            if isinstance(ir, InputRef):
+                sym = ir.name
+            else:
+                sym = self.symbols.new("group", ir.type)
+                pre_assignments[sym] = ir
+                need_pre_project = True
+            group_syms.append(sym)
+            key_replacements[_ast_key(g)] = InputRef(ir.type, sym)
+        # aliases usable as group keys: group by alias
+        alias_of = {
+            (it.alias or "").lower(): it.expr for it in sel.items if it.alias
+        }
+        resolved_gs = []
+        for i, g in enumerate(sel.group_by):
+            if (
+                isinstance(g, ast.Ident)
+                and len(g.parts) == 1
+                and g.parts[0] in alias_of
+                and _ast_key(g) not in key_replacements
+            ):
+                pass  # already handled via scope resolution or error earlier
+            resolved_gs.append(g)
+        if need_pre_project:
+            node = P.Project(
+                {s: e.type for s, e in pre_assignments.items()},
+                source=node, assignments=pre_assignments,
+            )
+        # aggregate calls
+        aggs: dict[str, AggCall] = {}
+        replacements = dict(key_replacements)
+        for fc in agg_items:
+            name = fc.name.lower()
+            ea = ExprAnalyzer(self, scope, outer_refs=outer_refs)
+            if fc.star:
+                call = AggCall("count_all", (), T.BIGINT)
+            else:
+                args = tuple(ea.analyze(a) for a in fc.args)
+                if name == "count":
+                    call = AggCall("count", args, T.BIGINT, distinct=fc.distinct)
+                else:
+                    rt = agg_result_type(name, args[0].type if args else None)
+                    call = AggCall(name, args, rt, distinct=fc.distinct)
+            sym = self.symbols.new(name, call.type)
+            aggs[sym] = call
+            replacements[_ast_key(fc)] = InputRef(call.type, sym)
+        outputs = {s: self.symbols.types[s] for s in group_syms}
+        outputs.update({s: a.type for s, a in aggs.items()})
+        node = P.Aggregate(
+            outputs, source=node, group_keys=group_syms, aggregates=aggs
+        )
+        # scope keeps all fields so that references to ungrouped columns
+        # produce a "must appear in GROUP BY" error (via restrict_to)
+        # instead of a resolution failure
+        return node, scope, replacements, group_syms
+
+
+class SubqueryPlanner:
+    """Plans a subquery whose scope chains to the outer query's scope,
+    recording which outer symbols were referenced."""
+
+    def __init__(self, parent: Analyzer, outer_scope: Scope, refs: set):
+        self.parent = parent
+        self.outer_scope = outer_scope
+        self.refs = refs
+
+    def plan(self, q: ast.Query, ctes):
+        a = self.parent
+        marker = _OuterRefRecorder(self.outer_scope, self.refs)
+        rp, names = a.plan_query(q, outer=marker, ctes=ctes)
+        return rp, names
+
+
+class _OuterRefRecorder(Scope):
+    """Scope wrapper that records which outer fields get resolved."""
+
+    def __init__(self, inner: Scope, refs: set):
+        super().__init__(inner.fields, inner.parent)
+        self._refs = refs
+
+    def resolve(self, parts):
+        f, outer = super().resolve(parts)
+        self._refs.add(f.symbol)
+        return f, True
+
+
+# ---- correlation extraction ----------------------------------------------
+
+def _extract_correlation(node: P.PlanNode, outer_syms: set[str]):
+    """Remove Filter conjuncts of the form inner = outer from the
+    subplan; return (new plan, [(outer_sym, inner_sym)])."""
+    corr: list[tuple[str, str]] = []
+
+    def rewrite(n: P.PlanNode) -> P.PlanNode:
+        if isinstance(n, P.Filter):
+            kept: list[RowExpression] = []
+            for cj in _ir_conjuncts(n.predicate):
+                pair = _corr_eq_pair(cj, outer_syms)
+                if pair is not None:
+                    corr.append(pair)
+                else:
+                    if _ir_refs(cj) & outer_syms:
+                        raise AnalysisError(
+                            f"unsupported correlated predicate: {cj!r}"
+                        )
+                    kept.append(cj)
+            src = rewrite(n.source)
+            if not kept:
+                return src
+            return P.Filter(dict(src.outputs), source=src, predicate=_and_all(kept))
+        if isinstance(n, P.Project):
+            src = rewrite(n.source)
+            # keep correlated inner symbols visible through projections
+            assignments = dict(n.assignments)
+            outputs = dict(n.outputs)
+            for _, inner in corr:
+                if inner not in assignments and inner in src.outputs:
+                    assignments[inner] = InputRef(src.outputs[inner], inner)
+                    outputs[inner] = src.outputs[inner]
+            return P.Project(outputs, source=src, assignments=assignments)
+        if isinstance(n, P.Aggregate):
+            raise AnalysisError(
+                "correlated subquery with aggregation requires scalar form"
+            )
+        # any other node ends the Filter/Project spine: correlation may
+        # not hide below it — verify and keep the subtree as-is
+        _assert_no_outer_refs(n, outer_syms)
+        return n
+
+    return rewrite(node), corr
+
+
+def _assert_no_outer_refs(node: P.PlanNode, outer_syms: set[str]):
+    preds: list[RowExpression] = []
+    if isinstance(node, P.Filter):
+        preds.append(node.predicate)
+    elif isinstance(node, P.Project):
+        preds.extend(node.assignments.values())
+    elif isinstance(node, P.Join) and node.filter is not None:
+        preds.append(node.filter)
+    for p in preds:
+        if _ir_refs(p) & outer_syms:
+            raise AnalysisError(
+                f"unsupported correlated predicate below a "
+                f"{type(node).__name__}: {p!r}"
+            )
+    for s in node.sources:
+        _assert_no_outer_refs(s, outer_syms)
+
+
+def _extract_correlation_through_agg(
+    node: P.PlanNode, outer_syms: set[str], symbols: SymbolAllocator
+):
+    """Decorrelate a scalar aggregate subquery: hoist correlated
+    equality keys out of the Filter below the Aggregate and add them as
+    group keys."""
+    if isinstance(node, P.Project):
+        inner, corr = _extract_correlation_through_agg(node.source, outer_syms, symbols)
+        assignments = dict(node.assignments)
+        outputs = dict(node.outputs)
+        for _, isym in corr:
+            if isym not in assignments:
+                assignments[isym] = InputRef(inner.outputs[isym], isym)
+                outputs[isym] = inner.outputs[isym]
+        return P.Project(outputs, source=inner, assignments=assignments), corr
+    if not isinstance(node, P.Aggregate):
+        raise AnalysisError(
+            "correlated scalar subquery must be an aggregate query"
+        )
+    if node.group_keys:
+        raise AnalysisError(
+            "correlated scalar subquery must not have GROUP BY"
+        )
+    inner, corr = _extract_correlation(node.source, outer_syms)
+    group_keys = [isym for _, isym in corr]
+    outputs = {s: inner.outputs[s] for s in group_keys}
+    outputs.update({s: a.type for s, a in node.aggregates.items()})
+    agg = P.Aggregate(
+        outputs, source=inner, group_keys=group_keys, aggregates=node.aggregates
+    )
+    return agg, corr
+
+
+def _corr_eq_pair(ir: RowExpression, outer_syms: set[str]):
+    if isinstance(ir, Call) and ir.name == "eq":
+        a, b = ir.args
+        if isinstance(a, InputRef) and isinstance(b, InputRef):
+            if a.name in outer_syms and b.name not in outer_syms:
+                return (a.name, b.name)
+            if b.name in outer_syms and a.name not in outer_syms:
+                return (b.name, a.name)
+    return None
+
+
+def _ir_conjuncts(ir: RowExpression) -> list[RowExpression]:
+    if isinstance(ir, Call) and ir.name == "and":
+        out = []
+        for a in ir.args:
+            out.extend(_ir_conjuncts(a))
+        return out
+    return [ir]
+
+
+def _ir_refs(ir: RowExpression) -> set[str]:
+    if isinstance(ir, InputRef):
+        return {ir.name}
+    out: set[str] = set()
+    if isinstance(ir, Call):
+        for a in ir.args:
+            out |= _ir_refs(a)
+    elif isinstance(ir, Cast):
+        out |= _ir_refs(ir.arg)
+    return out
+
+
+def _and_all(parts: list[RowExpression]) -> RowExpression:
+    if len(parts) == 1:
+        return parts[0]
+    return Call(T.BOOLEAN, "and", tuple(parts))
+
+
+def _equi_pair(ir: RowExpression, left_syms: set[str], right_syms: set[str]):
+    if isinstance(ir, Call) and ir.name == "eq":
+        a, b = ir.args
+        if isinstance(a, InputRef) and isinstance(b, InputRef):
+            if a.name in left_syms and b.name in right_syms:
+                return (a.name, b.name)
+            if b.name in left_syms and a.name in right_syms:
+                return (b.name, a.name)
+    return None
+
+
+def _strip_not(e: ast.Expr) -> tuple[ast.Expr, bool]:
+    neg = False
+    while isinstance(e, ast.Unary) and e.op == "not":
+        neg = not neg
+        e = e.arg
+    return e, neg
+
+
+def _derive_name(e: ast.Expr) -> str | None:
+    if isinstance(e, ast.Ident):
+        return e.parts[-1]
+    if isinstance(e, ast.FnCall):
+        return e.name
+    return None
+
+
+# ---- expression analysis -------------------------------------------------
+
+class ExprAnalyzer:
+    """AST expression -> typed RowExpression over scope symbols.
+
+    The analog of MAIN/sql/analyzer/ExpressionAnalyzer.java: resolves
+    names, types every node, inserts casts for numeric coercion, and
+    desugars BETWEEN / CASE / LIKE / IN-list forms.
+    """
+
+    def __init__(
+        self, analyzer: Analyzer, scope: Scope,
+        replacements: dict[str, InputRef] | None = None,
+        restrict_to: list[str] | None = None,
+        outer_refs: set[str] | None = None,
+    ):
+        self.analyzer = analyzer
+        self.scope = scope
+        self.replacements = replacements or {}
+        self.restrict_to = set(restrict_to) if restrict_to is not None else None
+        self.outer_refs = outer_refs if outer_refs is not None else set()
+
+    def analyze(self, e: ast.Expr) -> RowExpression:
+        k = _ast_key(e)
+        if k in self.replacements:
+            return self.replacements[k]
+        m = getattr(self, f"_{type(e).__name__}", None)
+        if m is None:
+            raise AnalysisError(f"unsupported expression {type(e).__name__}")
+        return m(e)
+
+    # literals
+    def _IntLit(self, e: ast.IntLit):
+        return Literal(T.BIGINT, e.value)
+
+    def _DecimalLit(self, e: ast.DecimalLit):
+        digits = e.text.replace(".", "").lstrip("0") or "0"
+        scale = len(e.text.split(".")[1]) if "." in e.text else 0
+        precision = max(len(digits), scale, 1)
+        return Literal(T.DecimalType(min(precision, 18), scale), e.text)
+
+    def _FloatLit(self, e: ast.FloatLit):
+        return Literal(T.DOUBLE, e.value)
+
+    def _StrLit(self, e: ast.StrLit):
+        return Literal(T.VARCHAR, e.value)
+
+    def _BoolLit(self, e: ast.BoolLit):
+        return Literal(T.BOOLEAN, e.value)
+
+    def _NullLit(self, e: ast.NullLit):
+        return Literal(T.UNKNOWN, None)
+
+    def _DateLit(self, e: ast.DateLit):
+        return Literal(T.DATE, e.text)
+
+    def _Ident(self, e: ast.Ident):
+        f, outer = self.scope.resolve(e.parts)
+        if outer:
+            self.outer_refs.add(f.symbol)
+        elif self.restrict_to is not None and f.symbol not in self.restrict_to:
+            raise AnalysisError(
+                f"column {'.'.join(e.parts)!r} must appear in GROUP BY "
+                "or be used in an aggregate"
+            )
+        return InputRef(f.type, f.symbol)
+
+    # operators
+    def _Unary(self, e: ast.Unary):
+        arg = self.analyze(e.arg)
+        if e.op == "not":
+            return Call(T.BOOLEAN, "not", (arg,))
+        if e.op == "-":
+            return Call(arg.type, "negate", (arg,))
+        return arg
+
+    def _Binary(self, e: ast.Binary):
+        if e.op in ("and", "or"):
+            return Call(T.BOOLEAN, e.op, (self.analyze(e.left), self.analyze(e.right)))
+        if e.op in ("=", "<>", "<", "<=", ">", ">="):
+            return self._comparison(e)
+        if e.op in ("+", "-") and isinstance(e.right, ast.IntervalLit):
+            return self._date_interval(e)
+        if e.op == "||":
+            return self._concat(e)
+        op_name = {"+": "add", "-": "subtract", "*": "multiply",
+                   "/": "divide", "%": "modulus"}[e.op]
+        left = self.analyze(e.left)
+        right = self.analyze(e.right)
+        # date +- integer days
+        if isinstance(left.type, T.DateType) and right.type.is_integer:
+            return Call(T.DATE, op_name, (left, right))
+        rt = arith_result_type(op_name, left.type, right.type)
+        if isinstance(rt, (T.DoubleType, T.RealType)):
+            left = _cast_to(left, rt)
+            right = _cast_to(right, rt)
+        return Call(rt, op_name, (left, right))
+
+    def _comparison(self, e: ast.Binary):
+        op = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}[e.op]
+        left = self.analyze(e.left)
+        right = self.analyze(e.right)
+        if isinstance(left.type, T.VarcharType) or isinstance(right.type, T.VarcharType):
+            return Call(T.BOOLEAN, op, (left, right))
+        if left.type != right.type:
+            common = T.common_super_type(left.type, right.type)
+            left = _cast_to(left, common)
+            right = _cast_to(right, common)
+        return Call(T.BOOLEAN, op, (left, right))
+
+    def _date_interval(self, e: ast.Binary):
+        left = self.analyze(e.left)
+        iv: ast.IntervalLit = e.right  # type: ignore[assignment]
+        if not isinstance(left.type, T.DateType):
+            raise AnalysisError("interval arithmetic requires a date operand")
+        amount = int(iv.value) * (-1 if iv.negative else 1)
+        if e.op == "-":
+            amount = -amount
+        if iv.unit in ("day", "week"):
+            days = amount * (7 if iv.unit == "week" else 1)
+            if isinstance(left, Literal):
+                return Literal(T.DATE, T.format_date(T.parse_date(left.value) + days))
+            return Call(T.DATE, "add", (left, Literal(T.INTEGER, days)))
+        if iv.unit in ("month", "year"):
+            months = amount * (12 if iv.unit == "year" else 1)
+            if isinstance(left, Literal):
+                return Literal(T.DATE, _add_months(left.value, months))
+            raise AnalysisError(
+                "non-constant date +- month/year interval not supported yet"
+            )
+        raise AnalysisError(f"unsupported interval unit {iv.unit}")
+
+    def _concat(self, e: ast.Binary):
+        left = self.analyze(e.left)
+        right = self.analyze(e.right)
+        if isinstance(left, Literal) and isinstance(right, Literal):
+            return Literal(T.VARCHAR, str(left.value) + str(right.value))
+        if isinstance(right, Literal):
+            return Call(T.VARCHAR, "concat_suffix", (left, right))
+        if isinstance(left, Literal):
+            return Call(T.VARCHAR, "concat_prefix", (right, left))
+        raise AnalysisError("varchar || varchar between two columns not supported yet")
+
+    # predicates
+    def _Between(self, e: ast.Between):
+        lo = ast.Binary(">=", e.arg, e.low)
+        hi = ast.Binary("<=", e.arg, e.high)
+        both = ast.Binary("and", lo, hi)
+        out = self.analyze(both)
+        if e.negated:
+            return Call(T.BOOLEAN, "not", (out,))
+        return out
+
+    def _InList(self, e: ast.InList):
+        arg = self.analyze(e.arg)
+        items = [self.analyze(i) for i in e.items]
+        if not isinstance(arg.type, T.VarcharType):
+            items = [_cast_to(i, arg.type) for i in items]
+        out = Call(T.BOOLEAN, "in", (arg, *items))
+        if e.negated:
+            return Call(T.BOOLEAN, "not", (out,))
+        return out
+
+    def _LikeExpr(self, e: ast.LikeExpr):
+        arg = self.analyze(e.arg)
+        pattern = self.analyze(e.pattern)
+        if not isinstance(pattern, Literal):
+            raise AnalysisError("LIKE pattern must be a literal")
+        name = "not_like" if e.negated else "like"
+        return Call(T.BOOLEAN, name, (arg, pattern))
+
+    def _IsNullExpr(self, e: ast.IsNullExpr):
+        out = Call(T.BOOLEAN, "is_null", (self.analyze(e.arg),))
+        if e.negated:
+            return Call(T.BOOLEAN, "not", (out,))
+        return out
+
+    def _CaseExpr(self, e: ast.CaseExpr):
+        whens = e.whens
+        if e.operand is not None:
+            whens = [(ast.Binary("=", e.operand, w), r) for w, r in whens]
+        conds = [self.analyze(w) for w, _ in whens]
+        results = [self.analyze(r) for _, r in whens]
+        else_ = self.analyze(e.else_) if e.else_ is not None else Literal(T.UNKNOWN, None)
+        rtype = else_.type
+        for r in results:
+            rtype = T.common_super_type(rtype, r.type)
+        results = [_cast_to(r, rtype) for r in results]
+        if not isinstance(else_, Literal) or else_.value is not None:
+            else_ = _cast_to(else_, rtype)
+        else:
+            else_ = Literal(rtype, None)
+        out = else_
+        for cond, res in zip(reversed(conds), reversed(results)):
+            out = Call(rtype, "if", (cond, res, out))
+        return out
+
+    def _CastExpr(self, e: ast.CastExpr):
+        arg = self.analyze(e.arg)
+        return Cast(T.type_from_name(e.type_name), arg)
+
+    def _ExtractExpr(self, e: ast.ExtractExpr):
+        arg = self.analyze(e.arg)
+        if e.field not in ("year", "month", "day"):
+            raise AnalysisError(f"EXTRACT({e.field}) not supported yet")
+        return Call(T.BIGINT, f"extract_{e.field}", (arg,))
+
+    def _FnCall(self, e: ast.FnCall):
+        name = e.name.lower()
+        if name in AGG_FNS or e.star:
+            raise AnalysisError(
+                f"aggregate function {name} not allowed in this context"
+            )
+        if name == "coalesce":
+            args = [self.analyze(a) for a in e.args]
+            rtype = args[0].type
+            for a in args[1:]:
+                rtype = T.common_super_type(rtype, a.type)
+            args = [_cast_to(a, rtype) for a in args]
+            return Call(rtype, "coalesce", tuple(args))
+        if name not in SCALAR_FNS:
+            raise AnalysisError(f"unknown function {name}")
+        ir_name, rt_fn = SCALAR_FNS[name]
+        args = tuple(self.analyze(a) for a in e.args)
+        return Call(rt_fn([a.type for a in args]), ir_name, args)
+
+    def _ScalarSubquery(self, e):
+        raise AnalysisError(
+            "scalar subqueries are only supported in WHERE/HAVING conjuncts"
+        )
+
+    def _Exists(self, e):
+        raise AnalysisError("EXISTS is only supported as a WHERE conjunct")
+
+    def _InSubquery(self, e):
+        raise AnalysisError("IN (subquery) is only supported as a WHERE conjunct")
+
+
+def _cast_to(ir: RowExpression, target: T.DataType) -> RowExpression:
+    if ir.type == target:
+        return ir
+    if isinstance(ir, Literal) and ir.value is None:
+        return Literal(target, None)
+    return Cast(target, ir)
+
+
+def _add_months(date_text: str, months: int) -> str:
+    y, m, d = (int(x) for x in date_text.split("-"))
+    m0 = (y * 12 + (m - 1)) + months
+    y2, m2 = divmod(m0, 12)
+    m2 += 1
+    # clamp day to target month length
+    for day in range(d, 27, -1):
+        try:
+            return datetime.date(y2, m2, day).isoformat()
+        except ValueError:
+            continue
+    return datetime.date(y2, m2, min(d, 28)).isoformat()

@@ -1,0 +1,171 @@
+"""Scopes, symbols, and function/type resolution for the analyzer.
+
+The analog of the reference's Scope/RelationType
+(MAIN/sql/analyzer/Scope.java) and function binding
+(MAIN/metadata/FunctionResolver.java), reduced to what a columnar
+TPU engine needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trino_tpu import types as T
+
+__all__ = [
+    "AnalysisError", "Field", "Scope", "SymbolAllocator",
+    "agg_result_type", "arith_result_type", "SCALAR_FNS", "AGG_FNS",
+]
+
+
+class AnalysisError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str        # column name as visible in SQL ('' = anonymous)
+    symbol: str      # plan symbol
+    type: T.DataType
+    relation: str | None = None  # alias / table name qualifier
+
+
+class Scope:
+    """Visible fields of a relation + link to the enclosing (outer)
+    query scope for correlated subqueries."""
+
+    def __init__(self, fields: list[Field], parent: "Scope | None" = None):
+        self.fields = fields
+        self.parent = parent
+
+    def resolve(self, parts: tuple[str, ...]) -> tuple[Field, bool]:
+        """Resolve a possibly-qualified name.
+
+        Returns (field, is_outer). Raises AnalysisError when ambiguous
+        or missing.
+        """
+        name = parts[-1].lower()
+        qualifier = parts[-2].lower() if len(parts) > 1 else None
+        matches = [
+            f
+            for f in self.fields
+            if f.name == name and (qualifier is None or f.relation == qualifier)
+        ]
+        if len(matches) > 1:
+            raise AnalysisError(f"column {'.'.join(parts)!r} is ambiguous")
+        if matches:
+            return matches[0], False
+        if self.parent is not None:
+            f, _ = self.parent.resolve(parts)
+            return f, True
+        raise AnalysisError(f"column {'.'.join(parts)!r} cannot be resolved")
+
+    def visible_fields(self, qualifier: str | None = None) -> list[Field]:
+        if qualifier is None:
+            return list(self.fields)
+        out = [f for f in self.fields if f.relation == qualifier]
+        if not out:
+            raise AnalysisError(f"relation {qualifier!r} not found")
+        return out
+
+
+class SymbolAllocator:
+    def __init__(self):
+        self._counter = 0
+        self.types: dict[str, T.DataType] = {}
+
+    def new(self, hint: str, type_: T.DataType) -> str:
+        base = "".join(c if c.isalnum() or c == "_" else "_" for c in hint) or "expr"
+        self._counter += 1
+        sym = f"{base}_{self._counter}"
+        self.types[sym] = type_
+        return sym
+
+
+# ---- type rules ----------------------------------------------------------
+
+def arith_result_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
+    """Result types of +,-,*,/,% (reference: io.trino.type arithmetic
+    operators; decimal rules from SPI DecimalType precision math,
+    capped at precision 18 until int128 lands)."""
+    if isinstance(lt, T.DoubleType) or isinstance(rt, T.DoubleType):
+        return T.DOUBLE
+    if isinstance(lt, T.RealType) or isinstance(rt, T.RealType):
+        return T.REAL if not (isinstance(lt, T.DoubleType) or isinstance(rt, T.DoubleType)) else T.DOUBLE
+    ld = lt if isinstance(lt, T.DecimalType) else None
+    rd = rt if isinstance(rt, T.DecimalType) else None
+    if ld is None and rd is None:
+        # integer op integer
+        if not (lt.is_integer and rt.is_integer):
+            raise AnalysisError(f"cannot apply {op} to {lt}, {rt}")
+        return T.common_super_type(lt, rt)
+    ld = ld or T.DecimalType(18, 0)
+    rd = rd or T.DecimalType(18, 0)
+    if op in ("add", "subtract"):
+        s = max(ld.scale, rd.scale)
+        p = min(18, max(ld.precision - ld.scale, rd.precision - rd.scale) + s + 1)
+        return T.DecimalType(p, s)
+    if op == "multiply":
+        s = min(18, ld.scale + rd.scale)
+        p = min(18, ld.precision + rd.precision)
+        return T.DecimalType(max(p, s), s)
+    if op == "divide":
+        s = max(ld.scale, rd.scale)
+        return T.DecimalType(18, s)
+    if op == "modulus":
+        s = max(ld.scale, rd.scale)
+        p = min(18, max(ld.precision - ld.scale, rd.precision - rd.scale) + s)
+        return T.DecimalType(max(p, 1), s)
+    raise AnalysisError(f"unknown arithmetic {op}")
+
+
+def agg_result_type(name: str, arg_type: T.DataType | None) -> T.DataType:
+    """Aggregate result types (reference: MAIN/operator/aggregation)."""
+    if name in ("count", "count_all"):
+        return T.BIGINT
+    if arg_type is None:
+        raise AnalysisError(f"aggregate {name} needs an argument")
+    if name == "sum":
+        if arg_type.is_integer:
+            return T.BIGINT
+        if isinstance(arg_type, T.DecimalType):
+            return T.DecimalType(18, arg_type.scale)
+        if isinstance(arg_type, (T.DoubleType, T.RealType)):
+            return T.DOUBLE
+        raise AnalysisError(f"cannot sum {arg_type}")
+    if name == "avg":
+        if isinstance(arg_type, T.DecimalType):
+            return arg_type
+        return T.DOUBLE
+    if name in ("min", "max", "any_value", "arbitrary"):
+        return arg_type
+    if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
+        return T.DOUBLE
+    if name == "bool_and" or name == "bool_or":
+        return T.BOOLEAN
+    raise AnalysisError(f"unknown aggregate function {name}")
+
+
+AGG_FNS = {
+    "count", "sum", "avg", "min", "max", "any_value", "arbitrary",
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+    "bool_and", "bool_or",
+}
+
+#: scalar fn name -> (ir_name, result_type fn(arg_types))
+SCALAR_FNS = {
+    "abs": ("abs", lambda ts: ts[0]),
+    "sqrt": ("sqrt", lambda ts: T.DOUBLE),
+    "floor": ("floor", lambda ts: ts[0]),
+    "ceil": ("ceil", lambda ts: ts[0]),
+    "ceiling": ("ceil", lambda ts: ts[0]),
+    "round": ("round", lambda ts: ts[0]),
+    "substr": ("substr", lambda ts: T.VARCHAR),
+    "lower": ("lower", lambda ts: T.VARCHAR),
+    "upper": ("upper", lambda ts: T.VARCHAR),
+    "trim": ("trim", lambda ts: T.VARCHAR),
+    "year": ("extract_year", lambda ts: T.BIGINT),
+    "month": ("extract_month", lambda ts: T.BIGINT),
+    "day": ("extract_day", lambda ts: T.BIGINT),
+    "coalesce": ("coalesce", None),  # special typing
+}

@@ -33,38 +33,50 @@ MAX_ORDER_DATE = parse_date("1998-08-02")
 
 D152 = T.DecimalType(15, 2)
 
-SCHEMAS: dict[str, TableSchema] = {
-    "region": TableSchema("region", [
-        ("regionkey", T.BIGINT), ("name", T.VARCHAR), ("comment", T.VARCHAR)]),
-    "nation": TableSchema("nation", [
+#: canonical TPC-H column prefixes per table
+PREFIX = {
+    "region": "r_", "nation": "n_", "supplier": "s_", "customer": "c_",
+    "part": "p_", "partsupp": "ps_", "orders": "o_", "lineitem": "l_",
+}
+
+_BASE_COLUMNS: dict[str, list[tuple[str, T.DataType]]] = {
+    "region": [
+        ("regionkey", T.BIGINT), ("name", T.VARCHAR), ("comment", T.VARCHAR)],
+    "nation": [
         ("nationkey", T.BIGINT), ("name", T.VARCHAR), ("regionkey", T.BIGINT),
-        ("comment", T.VARCHAR)]),
-    "supplier": TableSchema("supplier", [
+        ("comment", T.VARCHAR)],
+    "supplier": [
         ("suppkey", T.BIGINT), ("name", T.VARCHAR), ("address", T.VARCHAR),
         ("nationkey", T.BIGINT), ("phone", T.VARCHAR), ("acctbal", D152),
-        ("comment", T.VARCHAR)]),
-    "customer": TableSchema("customer", [
+        ("comment", T.VARCHAR)],
+    "customer": [
         ("custkey", T.BIGINT), ("name", T.VARCHAR), ("address", T.VARCHAR),
         ("nationkey", T.BIGINT), ("phone", T.VARCHAR), ("acctbal", D152),
-        ("mktsegment", T.VARCHAR), ("comment", T.VARCHAR)]),
-    "part": TableSchema("part", [
+        ("mktsegment", T.VARCHAR), ("comment", T.VARCHAR)],
+    "part": [
         ("partkey", T.BIGINT), ("name", T.VARCHAR), ("mfgr", T.VARCHAR),
         ("brand", T.VARCHAR), ("type", T.VARCHAR), ("size", T.INTEGER),
-        ("container", T.VARCHAR), ("retailprice", D152), ("comment", T.VARCHAR)]),
-    "partsupp": TableSchema("partsupp", [
+        ("container", T.VARCHAR), ("retailprice", D152), ("comment", T.VARCHAR)],
+    "partsupp": [
         ("partkey", T.BIGINT), ("suppkey", T.BIGINT), ("availqty", T.INTEGER),
-        ("supplycost", D152), ("comment", T.VARCHAR)]),
-    "orders": TableSchema("orders", [
+        ("supplycost", D152), ("comment", T.VARCHAR)],
+    "orders": [
         ("orderkey", T.BIGINT), ("custkey", T.BIGINT), ("orderstatus", T.VARCHAR),
         ("totalprice", D152), ("orderdate", T.DATE), ("orderpriority", T.VARCHAR),
-        ("clerk", T.VARCHAR), ("shippriority", T.INTEGER), ("comment", T.VARCHAR)]),
-    "lineitem": TableSchema("lineitem", [
+        ("clerk", T.VARCHAR), ("shippriority", T.INTEGER), ("comment", T.VARCHAR)],
+    "lineitem": [
         ("orderkey", T.BIGINT), ("partkey", T.BIGINT), ("suppkey", T.BIGINT),
         ("linenumber", T.INTEGER), ("quantity", D152), ("extendedprice", D152),
         ("discount", D152), ("tax", D152), ("returnflag", T.VARCHAR),
         ("linestatus", T.VARCHAR), ("shipdate", T.DATE), ("commitdate", T.DATE),
         ("receiptdate", T.DATE), ("shipinstruct", T.VARCHAR),
-        ("shipmode", T.VARCHAR), ("comment", T.VARCHAR)]),
+        ("shipmode", T.VARCHAR), ("comment", T.VARCHAR)],
+}
+
+#: external schemas use the canonical prefixed names (l_orderkey, ...)
+SCHEMAS: dict[str, TableSchema] = {
+    t: TableSchema(t, [(PREFIX[t] + c, ty) for c, ty in cols])
+    for t, cols in _BASE_COLUMNS.items()
 }
 
 #: named schema -> scale factor, mirroring the reference's tpch schemas
@@ -121,6 +133,10 @@ class TpchData:
 
     # ---- public API ------------------------------------------------------
     def column(self, table: str, name: str) -> np.ndarray:
+        # accept both canonical prefixed (l_orderkey) and bare names
+        prefix = PREFIX.get(table, "")
+        if prefix and name.startswith(prefix):
+            name = name[len(prefix):]
         key = (table, name)
         if key not in self._cache:
             gen = getattr(self, f"_{table}_{name}", None)

@@ -149,13 +149,22 @@ class _Compiler:
                 m = 10 ** d_t.scale
                 return wrap(lambda x: x.astype(jnp.int64) * m)
             if isinstance(s_t, (T.DoubleType, T.RealType)):
+                # round half away from zero (reference: double->decimal
+                # cast uses HALF_UP)
                 m = 10.0 ** d_t.scale
-                return wrap(lambda x: jnp.round(x * m).astype(jnp.int64))
+                return wrap(
+                    lambda x: (
+                        jnp.sign(x) * jnp.floor(jnp.abs(x) * m + 0.5)
+                    ).astype(jnp.int64)
+                )
         if d_t.is_integer:
             dtype = d_t.np_dtype
             if isinstance(s_t, T.DecimalType):
                 m = 10 ** s_t.scale
                 return wrap(lambda x: _div_round_half_up(x, m).astype(dtype))
+            if isinstance(s_t, (T.DoubleType, T.RealType)):
+                # reference rounds (Math.round): floor(x + 0.5)
+                return wrap(lambda x: jnp.floor(x + 0.5).astype(dtype))
             return wrap(lambda x: x.astype(dtype))
         if isinstance(d_t, T.VarcharType):
             raise NotImplementedError(f"cast {s_t} -> varchar not yet supported")
@@ -233,16 +242,15 @@ class _Compiler:
     def _if(self, expr: Call) -> CompiledExpr:
         cond, then, els = (self.compile(a) for a in expr.args)
         out_dict = _merge_result_dicts(expr.type, [then, els])
+        redict_then = _redict_fn(then, out_dict)
+        redict_els = _redict_fn(els, out_dict)
 
         def ev(env):
             c_d, c_v = cond.fn(env)
             t_d, t_v = then.fn(env)
             e_d, e_v = els.fn(env)
             take_then = c_d if c_v is None else (c_d & c_v)
-            if out_dict is not None:
-                t_d = _redict(t_d, then, out_dict)
-                e_d = _redict(e_d, els, out_dict)
-            data = jnp.where(take_then, t_d, e_d)
+            data = jnp.where(take_then, redict_then(t_d), redict_els(e_d))
             if t_v is None and e_v is None:
                 return data, None
             t_vv = t_v if t_v is not None else jnp.ones_like(take_then)
@@ -254,18 +262,16 @@ class _Compiler:
     def _coalesce(self, expr: Call) -> CompiledExpr:
         parts = [self.compile(a) for a in expr.args]
         out_dict = _merge_result_dicts(expr.type, parts)
+        redicts = [_redict_fn(p, out_dict) for p in parts]
 
         def ev(env):
             data, valid = parts[0].fn(env)
-            if out_dict is not None:
-                data = _redict(data, parts[0], out_dict)
-            for p in parts[1:]:
+            data = redicts[0](data)
+            for p, rd in zip(parts[1:], redicts[1:]):
                 if valid is None:
                     break
                 d, v = p.fn(env)
-                if out_dict is not None:
-                    d = _redict(d, p, out_dict)
-                data = jnp.where(valid, data, d)
+                data = jnp.where(valid, data, rd(d))
                 valid = valid | (v if v is not None else True)
             return data, valid
 
@@ -282,7 +288,7 @@ class _Compiler:
                 raise NotImplementedError("varchar IN requires literal list")
             wanted = {str(i.value) for i in items}
             lut = np.isin(dict_.values, list(wanted))
-            lut_dev = jnp.asarray(lut)
+            lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros(1, dtype=jnp.bool_)
 
             def ev_str(env):
                 data, valid = a.fn(env)
@@ -294,12 +300,20 @@ class _Compiler:
         def ev(env):
             data, valid = a.fn(env)
             out = None
+            any_null_item = None
             for ci in compiled_items:
                 d, v = ci.fn(env)
                 hit = data == d
                 if v is not None:
                     hit = hit & v
+                    item_null = ~v
+                    any_null_item = (
+                        item_null if any_null_item is None else any_null_item | item_null
+                    )
                 out = hit if out is None else out | hit
+            if any_null_item is not None:
+                # 3VL: no match + a NULL item -> NULL, not FALSE
+                valid = _and_valid(valid, out | ~any_null_item)
             return out, valid
 
         return CompiledExpr(ev, T.BOOLEAN)
@@ -605,13 +619,15 @@ def _merge_result_dicts(out_type, parts):
     return merged
 
 
-def _redict(data, part: CompiledExpr, merged: StringDictionary):
-    if part.dictionary is merged:
-        return data
+def _redict_fn(part: CompiledExpr, merged: StringDictionary | None):
+    """Compile-time code remap onto a merged dictionary (device gather)."""
+    if merged is None or part.dictionary is merged:
+        return lambda data: data
     remap = np.searchsorted(merged.values, part.dictionary.values).astype(np.int32)
     if len(remap) == 0:
-        return data
-    return jnp.take(jnp.asarray(remap), data, mode="clip")
+        return lambda data: data
+    remap_dev = jnp.asarray(remap)
+    return lambda data: jnp.take(remap_dev, data, mode="clip")
 
 
 _CMP_OPS = {
@@ -641,7 +657,7 @@ def _extract_civil(days):
     """Vectorized Gregorian calendar decomposition of epoch days
     (days-from-civil inverse, Howard Hinnant's algorithm)."""
     z = days.astype(jnp.int64) + 719_468
-    era = jnp.where(z >= 0, z, z - 146_096) // 146_097
+    era = z // 146_097  # jnp // is floor division — no truncation offset
     doe = z - era * 146_097
     yoe = (doe - doe // 1460 + doe // 36_524 - doe // 146_096) // 365
     y = yoe + era * 400

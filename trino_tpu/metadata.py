@@ -1,0 +1,70 @@
+"""Metadata layer: catalogs -> connectors -> tables.
+
+The (much smaller) analog of the reference's MetadataManager
+(MAIN/metadata/MetadataManager.java:180) + CatalogManager: resolves
+qualified names against registered catalogs and exposes connector
+metadata to the analyzer/planner.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trino_tpu.connectors.base import Catalog, Connector, TableSchema
+
+__all__ = ["Metadata", "Session", "QualifiedTable"]
+
+
+@dataclass
+class Session:
+    """Per-query context (the reference's io.trino.Session analog)."""
+
+    catalog: str | None = None
+    schema: str | None = None
+    properties: dict = field(default_factory=dict)
+    user: str = "user"
+
+
+@dataclass(frozen=True)
+class QualifiedTable:
+    catalog: str
+    schema: str
+    table: str
+
+
+class Metadata:
+    def __init__(self):
+        self._catalogs: dict[str, Catalog] = {}
+
+    def register_catalog(self, name: str, connector: Connector, **properties):
+        self._catalogs[name] = Catalog(name, connector, properties)
+
+    def catalogs(self) -> list[str]:
+        return sorted(self._catalogs)
+
+    def connector(self, catalog: str) -> Connector:
+        if catalog not in self._catalogs:
+            raise KeyError(f"catalog not found: {catalog}")
+        return self._catalogs[catalog].connector
+
+    def resolve_table(
+        self, session: Session, parts: tuple[str, ...]
+    ) -> tuple[QualifiedTable, TableSchema]:
+        """Resolve [catalog.][schema.]table against session defaults."""
+        if len(parts) == 3:
+            cat, sch, tab = parts
+        elif len(parts) == 2:
+            cat, (sch, tab) = session.catalog, parts
+        elif len(parts) == 1:
+            cat, sch, tab = session.catalog, session.schema, parts[0]
+        else:
+            raise ValueError(f"bad table name: {'.'.join(parts)}")
+        if cat is None or sch is None:
+            raise ValueError(
+                f"table {'.'.join(parts)!r} requires a session catalog/schema"
+            )
+        try:
+            schema = self.connector(cat).table_schema(sch, tab)
+        except KeyError:
+            raise KeyError(f"table not found: {cat}.{sch}.{tab}") from None
+        return QualifiedTable(cat, sch, tab), schema

@@ -1,0 +1,27 @@
+from trino_tpu.plan.nodes import (
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    Output,
+    PlanNode,
+    Project,
+    SemiJoin,
+    Sort,
+    TableScan,
+    TopN,
+)
+
+__all__ = [
+    "Aggregate",
+    "Filter",
+    "Join",
+    "Limit",
+    "Output",
+    "PlanNode",
+    "Project",
+    "SemiJoin",
+    "Sort",
+    "TableScan",
+    "TopN",
+]

@@ -1,0 +1,218 @@
+"""Logical plan nodes.
+
+The analog of the reference's PlanNode hierarchy
+(MAIN/sql/planner/plan/, ~60 node types). Symbols are plain strings
+with a type map carried per node (the reference's Symbol + TypeProvider
+split). Kept deliberately small; nodes are added as engine features
+land, mirroring: TableScanNode, FilterNode, ProjectNode,
+AggregationNode, JoinNode, SemiJoinNode, SortNode, TopNNode, LimitNode,
+OutputNode, ValuesNode, ExchangeNode.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trino_tpu import types as T
+from trino_tpu.expr.ir import AggCall, RowExpression
+
+__all__ = [
+    "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "Join",
+    "SemiJoin", "Sort", "TopN", "Limit", "Output", "Values", "Exchange",
+    "SortKey",
+]
+
+
+@dataclass
+class PlanNode:
+    #: output symbol name -> type, in column order
+    outputs: dict[str, T.DataType]
+
+    @property
+    def sources(self) -> list["PlanNode"]:
+        return []
+
+
+@dataclass
+class TableScan(PlanNode):
+    catalog: str = ""
+    schema: str = ""
+    table: str = ""
+    #: output symbol -> connector column name
+    assignments: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Filter(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    predicate: RowExpression = None  # type: ignore[assignment]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Project(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    #: output symbol -> expression over source symbols
+    assignments: dict[str, RowExpression] = field(default_factory=dict)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Aggregate(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    group_keys: list[str] = field(default_factory=list)
+    #: output symbol -> aggregate call (args are symbols of source)
+    aggregates: dict[str, AggCall] = field(default_factory=dict)
+    #: PARTIAL | FINAL | SINGLE — set by the optimizer when splitting
+    step: str = "SINGLE"
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Join(PlanNode):
+    kind: str = "inner"  # inner/left/right/full/cross
+    left: PlanNode = None  # type: ignore[assignment]
+    right: PlanNode = None  # type: ignore[assignment]
+    #: equi-join clauses: (left symbol, right symbol)
+    criteria: list[tuple[str, str]] = field(default_factory=list)
+    #: residual non-equi condition evaluated on joined rows
+    filter: RowExpression | None = None
+    #: join distribution chosen by the optimizer: PARTITIONED|BROADCAST
+    distribution: str | None = None
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+
+@dataclass
+class SemiJoin(PlanNode):
+    """Produces source rows + a boolean membership symbol
+    (MAIN/sql/planner/plan/SemiJoinNode.java analog)."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    filter_source: PlanNode = None  # type: ignore[assignment]
+    #: (source symbol, filter-source symbol) equi pairs
+    keys: list[tuple[str, str]] = field(default_factory=list)
+    match_symbol: str = ""
+
+    @property
+    def sources(self):
+        return [self.source, self.filter_source]
+
+
+@dataclass
+class SortKey:
+    symbol: str
+    ascending: bool = True
+    nulls_first: bool | None = None
+
+
+@dataclass
+class Sort(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    keys: list[SortKey] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class TopN(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    count: int = 0
+    keys: list[SortKey] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Limit(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    count: int = 0
+    offset: int = 0
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Values(PlanNode):
+    rows: list[list] = field(default_factory=list)
+
+
+@dataclass
+class Exchange(PlanNode):
+    """Repartitioning boundary inserted by the optimizer
+    (MAIN/sql/planner/plan/ExchangeNode.java analog). scope=REMOTE
+    becomes an ICI all_to_all / all_gather; scope=LOCAL a host-side
+    reshard."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    partitioning: str = "single"  # single | hash | broadcast | source
+    hash_symbols: list[str] = field(default_factory=list)
+    scope: str = "REMOTE"
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Output(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    #: user-facing column names in order
+    names: list[str] = field(default_factory=list)
+    symbols: list[str] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style rendering (MAIN/sql/planner/planprinter analog)."""
+    pad = "  " * indent
+    name = type(node).__name__
+    detail = ""
+    if isinstance(node, TableScan):
+        detail = f"[{node.catalog}.{node.schema}.{node.table}]"
+    elif isinstance(node, Filter):
+        detail = f"[{node.predicate!r}]"
+    elif isinstance(node, Project):
+        detail = "[" + ", ".join(f"{k} := {v!r}" for k, v in node.assignments.items()) + "]"
+    elif isinstance(node, Aggregate):
+        detail = f"[{node.step} keys={node.group_keys} aggs=" + \
+            ", ".join(f"{k}:={v!r}" for k, v in node.aggregates.items()) + "]"
+    elif isinstance(node, Join):
+        detail = f"[{node.kind} {node.criteria}" + (
+            f" filter={node.filter!r}" if node.filter else "") + "]"
+    elif isinstance(node, SemiJoin):
+        detail = f"[{node.keys} -> {node.match_symbol}]"
+    elif isinstance(node, (Sort, TopN)):
+        ks = ", ".join(f"{k.symbol} {'asc' if k.ascending else 'desc'}" for k in node.keys)
+        n = f" n={node.count}" if isinstance(node, TopN) else ""
+        detail = f"[{ks}{n}]"
+    elif isinstance(node, Limit):
+        detail = f"[{node.count}]"
+    elif isinstance(node, Exchange):
+        detail = f"[{node.scope} {node.partitioning} {node.hash_symbols}]"
+    elif isinstance(node, Output):
+        detail = f"[{node.names}]"
+    lines = [f"{pad}{name}{detail} -> {list(node.outputs)}"]
+    for s in node.sources:
+        lines.append(plan_tree_str(s, indent + 1))
+    return "\n".join(lines)

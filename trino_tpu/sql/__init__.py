@@ -1,0 +1,3 @@
+from trino_tpu.sql.parser import parse_statement
+
+__all__ = ["parse_statement"]

@@ -1,0 +1,297 @@
+"""Surface-syntax AST.
+
+The analog of the reference's parse tree (PARSER/tree/, 290 node
+classes). Untyped; the analyzer resolves names/types and lowers to the
+expression IR + logical plan. Only the nodes the grammar supports are
+defined — the set grows with the grammar.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = [
+    "Node", "Expr", "Relation", "Statement",
+    "Ident", "IntLit", "DecimalLit", "FloatLit", "StrLit", "BoolLit", "NullLit",
+    "DateLit", "TimestampLit", "IntervalLit", "Star",
+    "Unary", "Binary", "FnCall", "CastExpr", "CaseExpr", "Between", "InList",
+    "InSubquery", "Exists", "ScalarSubquery", "LikeExpr", "IsNullExpr",
+    "ExtractExpr",
+    "TableRef", "SubqueryRel", "JoinRel",
+    "SelectItem", "Select", "OrderItem", "Query", "SetOp",
+    "Explain", "ShowTables", "ShowSchemas", "ShowCatalogs", "DescribeTable",
+    "SessionSet", "Use",
+]
+
+
+class Node:
+    pass
+
+
+class Expr(Node):
+    pass
+
+
+class Relation(Node):
+    pass
+
+
+class Statement(Node):
+    pass
+
+
+# ---- expressions ---------------------------------------------------------
+
+@dataclass
+class Ident(Expr):
+    parts: tuple[str, ...]  # a, t.a, cat.sch.t.a
+
+    @property
+    def name(self) -> str:
+        return self.parts[-1]
+
+
+@dataclass
+class IntLit(Expr):
+    value: int
+
+
+@dataclass
+class DecimalLit(Expr):
+    text: str  # "0.05"
+
+
+@dataclass
+class FloatLit(Expr):
+    value: float
+
+
+@dataclass
+class StrLit(Expr):
+    value: str
+
+
+@dataclass
+class BoolLit(Expr):
+    value: bool
+
+
+@dataclass
+class NullLit(Expr):
+    pass
+
+
+@dataclass
+class DateLit(Expr):
+    text: str  # "1995-03-15"
+
+
+@dataclass
+class TimestampLit(Expr):
+    text: str
+
+
+@dataclass
+class IntervalLit(Expr):
+    value: str      # "3"
+    unit: str       # day/month/year/hour/minute/second
+    negative: bool = False
+
+
+@dataclass
+class Star(Expr):
+    qualifier: Optional[tuple[str, ...]] = None  # t.*
+
+
+@dataclass
+class Unary(Expr):
+    op: str  # '-', '+', 'not'
+    arg: Expr
+
+
+@dataclass
+class Binary(Expr):
+    op: str  # + - * / % || = <> < <= > >= and or
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class FnCall(Expr):
+    name: str
+    args: list[Expr]
+    distinct: bool = False
+    star: bool = False  # count(*)
+
+
+@dataclass
+class CastExpr(Expr):
+    arg: Expr
+    type_name: str
+    try_cast: bool = False
+
+
+@dataclass
+class CaseExpr(Expr):
+    operand: Optional[Expr]  # CASE x WHEN ... vs CASE WHEN ...
+    whens: list[tuple[Expr, Expr]]
+    else_: Optional[Expr]
+
+
+@dataclass
+class Between(Expr):
+    arg: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass
+class InList(Expr):
+    arg: Expr
+    items: list[Expr]
+    negated: bool = False
+
+
+@dataclass
+class InSubquery(Expr):
+    arg: Expr
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass
+class Exists(Expr):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass
+class ScalarSubquery(Expr):
+    query: "Query"
+
+
+@dataclass
+class LikeExpr(Expr):
+    arg: Expr
+    pattern: Expr
+    escape: Optional[Expr] = None
+    negated: bool = False
+
+
+@dataclass
+class IsNullExpr(Expr):
+    arg: Expr
+    negated: bool = False
+
+
+@dataclass
+class ExtractExpr(Expr):
+    field: str  # year/month/day/...
+    arg: Expr
+
+
+# ---- relations -----------------------------------------------------------
+
+@dataclass
+class TableRef(Relation):
+    parts: tuple[str, ...]  # table | schema.table | catalog.schema.table
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryRel(Relation):
+    query: "Query"
+    alias: Optional[str] = None
+
+
+@dataclass
+class JoinRel(Relation):
+    kind: str  # inner/left/right/full/cross
+    left: Relation
+    right: Relation
+    on: Optional[Expr] = None
+    using: Optional[list[str]] = None
+
+
+# ---- query structure -----------------------------------------------------
+
+@dataclass
+class SelectItem(Node):
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass
+class Select(Node):
+    items: list[SelectItem]
+    relations: list[Relation] = field(default_factory=list)  # FROM a, b = cross
+    where: Optional[Expr] = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    distinct: bool = False
+
+
+@dataclass
+class OrderItem(Node):
+    expr: Expr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None = type default
+
+
+@dataclass
+class Query(Statement):
+    select: "Select | SetOp"
+    with_: list[tuple[str, "Query"]] = field(default_factory=list)
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+@dataclass
+class SetOp(Node):
+    op: str  # union/intersect/except
+    all: bool
+    left: "Select | SetOp | Query"
+    right: "Select | SetOp | Query"
+
+
+# ---- other statements ----------------------------------------------------
+
+@dataclass
+class Explain(Statement):
+    statement: Statement
+    analyze: bool = False
+    type_: str = "logical"  # logical | distributed | io
+
+
+@dataclass
+class ShowCatalogs(Statement):
+    pass
+
+
+@dataclass
+class ShowSchemas(Statement):
+    catalog: Optional[str] = None
+
+
+@dataclass
+class ShowTables(Statement):
+    schema: Optional[tuple[str, ...]] = None
+
+
+@dataclass
+class DescribeTable(Statement):
+    table: tuple[str, ...]
+
+
+@dataclass
+class SessionSet(Statement):
+    name: str
+    value: Expr
+
+
+@dataclass
+class Use(Statement):
+    parts: tuple[str, ...]

@@ -1,0 +1,86 @@
+"""SQL lexer.
+
+The analog of the reference's ANTLR-generated lexer
+(core/trino-grammar/.../SqlBase.g4): hand-rolled because the token set
+for SQL is small and a regex scanner keeps the front end dependency
+free. Keywords are case-insensitive; identifiers are lowercased unless
+double-quoted (the reference's rule as well).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+__all__ = ["Token", "tokenize", "SqlSyntaxError"]
+
+
+class SqlSyntaxError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # KEYWORD | IDENT | NUMBER | STRING | OP | EOF
+    text: str  # normalized: keywords/idents lowercased (unless quoted)
+    pos: int   # character offset, for error messages
+
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "exists", "between", "like",
+    "escape", "is", "null", "true", "false", "case", "when", "then", "else",
+    "end", "cast", "try_cast", "extract", "interval", "date", "timestamp",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on", "using",
+    "union", "intersect", "except", "all", "distinct", "with", "recursive",
+    "asc", "desc", "nulls", "first", "last", "explain", "analyze", "show",
+    "tables", "schemas", "catalogs", "describe", "use", "set", "session",
+    "year", "month", "day", "hour", "minute", "second", "substring", "for",
+    "values", "create", "table", "insert", "into", "drop", "count",
+}
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*\n?|/\*.*?\*/)
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?)
+  | (?P<qident>"([^"]|"")*")
+  | (?P<string>'([^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|<=|>=|\|\||=>|[-+*/%(),.;<>=\[\]?])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+def tokenize(sql: str) -> list[Token]:
+    tokens: list[Token] = []
+    pos = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise SqlSyntaxError(
+                f"unexpected character {sql[pos]!r} at position {pos}: "
+                f"...{sql[max(0, pos - 20):pos + 10]}..."
+            )
+        kind = m.lastgroup
+        text = m.group()
+        if kind == "ws":
+            pos = m.end()
+            continue
+        if kind == "number":
+            tokens.append(Token("NUMBER", text, pos))
+        elif kind == "string":
+            tokens.append(Token("STRING", text[1:-1].replace("''", "'"), pos))
+        elif kind == "qident":
+            tokens.append(Token("IDENT", text[1:-1].replace('""', '"'), pos))
+        elif kind == "ident":
+            low = text.lower()
+            tokens.append(
+                Token("KEYWORD" if low in KEYWORDS else "IDENT", low, pos)
+            )
+        else:
+            tokens.append(Token("OP", text, pos))
+        pos = m.end()
+    tokens.append(Token("EOF", "", pos))
+    return tokens

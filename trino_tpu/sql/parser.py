@@ -1,0 +1,568 @@
+"""Recursive-descent SQL parser.
+
+The analog of the reference's SqlParser + AstBuilder
+(PARSER/parser/SqlParser.java:51) over the SqlBase.g4 grammar. Covers
+the query language: SELECT with joins/subqueries/set operations, WITH,
+scalar/EXISTS/IN subqueries, CASE, CAST, EXTRACT, BETWEEN, LIKE,
+interval/date literals, EXPLAIN [ANALYZE], SHOW/DESCRIBE/USE/SET
+SESSION. Grows toward full DDL/DML as the engine does.
+"""
+
+from __future__ import annotations
+
+from trino_tpu.sql import ast
+from trino_tpu.sql.lexer import SqlSyntaxError, Token, tokenize
+
+__all__ = ["parse_statement", "SqlSyntaxError"]
+
+
+def parse_statement(sql: str) -> ast.Statement:
+    p = _Parser(tokenize(sql))
+    stmt = p.statement()
+    p.accept_op(";")
+    p.expect_eof()
+    return stmt
+
+
+class _Parser:
+    def __init__(self, tokens: list[Token]):
+        self.tokens = tokens
+        self.i = 0
+
+    # ---- token helpers ---------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "KEYWORD" and t.text in words
+
+    def accept_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.i += 1
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.accept_kw(word):
+            t = self.peek()
+            raise SqlSyntaxError(f"expected {word.upper()} but found {t.text!r} at {t.pos}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "OP" and t.text in ops
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.i += 1
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            t = self.peek()
+            raise SqlSyntaxError(f"expected {op!r} but found {t.text!r} at {t.pos}")
+
+    def expect_eof(self) -> None:
+        t = self.peek()
+        if t.kind != "EOF":
+            raise SqlSyntaxError(f"unexpected trailing input {t.text!r} at {t.pos}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind == "IDENT":
+            self.i += 1
+            return t.text
+        # non-reserved keywords usable as identifiers
+        if t.kind == "KEYWORD" and t.text in _NONRESERVED:
+            self.i += 1
+            return t.text
+        raise SqlSyntaxError(f"expected identifier but found {t.text!r} at {t.pos}")
+
+    # ---- statements ------------------------------------------------------
+    def statement(self) -> ast.Statement:
+        if self.accept_kw("explain"):
+            analyze = self.accept_kw("analyze")
+            return ast.Explain(self.statement(), analyze=analyze)
+        if self.accept_kw("show"):
+            if self.accept_kw("catalogs"):
+                return ast.ShowCatalogs()
+            if self.accept_kw("schemas"):
+                if self.accept_kw("from"):
+                    return ast.ShowSchemas(self.ident())
+                return ast.ShowSchemas()
+            if self.accept_kw("tables"):
+                if self.accept_kw("from"):
+                    return ast.ShowTables(self.qualified_name())
+                return ast.ShowTables()
+            t = self.peek()
+            raise SqlSyntaxError(f"unsupported SHOW {t.text!r}")
+        if self.accept_kw("describe"):
+            return ast.DescribeTable(self.qualified_name())
+        if self.accept_kw("use"):
+            return ast.Use(self.qualified_name())
+        if self.accept_kw("set"):
+            self.expect_kw("session")
+            name_parts = [self.ident()]
+            while self.accept_op("."):
+                name_parts.append(self.ident())
+            self.expect_op("=")
+            return ast.SessionSet(".".join(name_parts), self.expr())
+        return self.query()
+
+    def qualified_name(self) -> tuple[str, ...]:
+        parts = [self.ident()]
+        while self.accept_op("."):
+            parts.append(self.ident())
+        return tuple(parts)
+
+    # ---- queries ---------------------------------------------------------
+    def query(self) -> ast.Query:
+        with_ = []
+        if self.accept_kw("with"):
+            self.accept_kw("recursive")  # accepted, handled by analyzer
+            while True:
+                name = self.ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.query()
+                self.expect_op(")")
+                with_.append((name, q))
+                if not self.accept_op(","):
+                    break
+        body = self.query_body()
+        order_by: list[ast.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by = self.order_items()
+        offset = None
+        limit = None
+        if self.accept_kw("offset"):
+            offset = int(self.next().text)
+            self.accept_kw("rows") if self.at_kw("rows") else None
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind == "KEYWORD" and t.text == "all":
+                limit = None
+            else:
+                limit = int(t.text)
+        return ast.Query(select=body, with_=with_, order_by=order_by, limit=limit, offset=offset)
+
+    def order_items(self) -> list[ast.OrderItem]:
+        items = []
+        while True:
+            e = self.expr()
+            asc = True
+            if self.accept_kw("asc"):
+                asc = True
+            elif self.accept_kw("desc"):
+                asc = False
+            nulls_first = None
+            if self.accept_kw("nulls"):
+                if self.accept_kw("first"):
+                    nulls_first = True
+                else:
+                    self.expect_kw("last")
+                    nulls_first = False
+            items.append(ast.OrderItem(e, asc, nulls_first))
+            if not self.accept_op(","):
+                return items
+
+    def query_body(self):
+        left = self.query_term()
+        while self.at_kw("union", "except"):
+            op = self.next().text
+            all_ = self.accept_kw("all")
+            if not all_:
+                self.accept_kw("distinct")
+            right = self.query_term()
+            left = ast.SetOp(op, all_, left, right)
+        return left
+
+    def query_term(self):
+        left = self.query_primary()
+        while self.at_kw("intersect"):
+            self.next()
+            all_ = self.accept_kw("all")
+            if not all_:
+                self.accept_kw("distinct")
+            right = self.query_primary()
+            left = ast.SetOp("intersect", all_, left, right)
+        return left
+
+    def query_primary(self):
+        if self.accept_op("("):
+            q = self.query_body()
+            self.expect_op(")")
+            return q
+        if self.at_kw("values"):
+            raise SqlSyntaxError("VALUES is not supported yet")
+        return self.select()
+
+    def select(self) -> ast.Select:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        items = [self.select_item()]
+        while self.accept_op(","):
+            items.append(self.select_item())
+        relations: list[ast.Relation] = []
+        where = None
+        group_by: list[ast.Expr] = []
+        having = None
+        if self.accept_kw("from"):
+            relations.append(self.relation())
+            while self.accept_op(","):
+                relations.append(self.relation())
+        if self.accept_kw("where"):
+            where = self.expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.expr())
+            while self.accept_op(","):
+                group_by.append(self.expr())
+        if self.accept_kw("having"):
+            having = self.expr()
+        return ast.Select(items, relations, where, group_by, having, distinct)
+
+    def select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Star())
+        e = self.expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "IDENT":
+            alias = self.ident()
+        return ast.SelectItem(e, alias)
+
+    # ---- relations -------------------------------------------------------
+    def relation(self) -> ast.Relation:
+        left = self.relation_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.relation_primary()
+                left = ast.JoinRel("cross", left, right)
+                continue
+            kind = None
+            if self.at_kw("join"):
+                kind = "inner"
+            elif self.at_kw("inner"):
+                self.next()
+                kind = "inner"
+            elif self.at_kw("left"):
+                self.next()
+                self.accept_kw("outer")
+                kind = "left"
+            elif self.at_kw("right"):
+                self.next()
+                self.accept_kw("outer")
+                kind = "right"
+            elif self.at_kw("full"):
+                self.next()
+                self.accept_kw("outer")
+                kind = "full"
+            if kind is None:
+                return left
+            self.expect_kw("join")
+            right = self.relation_primary()
+            if self.accept_kw("on"):
+                left = ast.JoinRel(kind, left, right, on=self.expr())
+            elif self.accept_kw("using"):
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                left = ast.JoinRel(kind, left, right, using=cols)
+            else:
+                raise SqlSyntaxError("JOIN requires ON or USING")
+
+    def relation_primary(self) -> ast.Relation:
+        if self.accept_op("("):
+            if self.at_kw("select", "with") or self.at_op("("):
+                q = self.query()
+                self.expect_op(")")
+                alias = self._relation_alias()
+                return ast.SubqueryRel(q, alias)
+            r = self.relation()
+            self.expect_op(")")
+            return r
+        parts = self.qualified_name()
+        alias = self._relation_alias()
+        return ast.TableRef(parts, alias)
+
+    def _relation_alias(self) -> str | None:
+        if self.accept_kw("as"):
+            return self.ident()
+        if self.peek().kind == "IDENT":
+            return self.ident()
+        return None
+
+    # ---- expressions -----------------------------------------------------
+    def expr(self) -> ast.Expr:
+        return self.or_expr()
+
+    def or_expr(self) -> ast.Expr:
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = ast.Binary("or", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> ast.Expr:
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = ast.Binary("and", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> ast.Expr:
+        if self.accept_kw("not"):
+            return ast.Unary("not", self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> ast.Expr:
+        left = self.additive()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.next().text
+                if op == "!=":
+                    op = "<>"
+                right = self.additive()
+                left = ast.Binary(op, left, right)
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("between"):
+                low = self.additive()
+                self.expect_kw("and")
+                high = self.additive()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    q = self.query()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negated)
+                else:
+                    items = [self.expr()]
+                    while self.accept_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, items, negated)
+                continue
+            if self.accept_kw("like"):
+                pattern = self.additive()
+                escape = None
+                if self.accept_kw("escape"):
+                    escape = self.additive()
+                left = ast.LikeExpr(left, pattern, escape, negated)
+                continue
+            if negated:
+                self.i = save  # NOT belongs to a different production
+                return left
+            if self.accept_kw("is"):
+                neg = self.accept_kw("not")
+                self.expect_kw("null")
+                left = ast.IsNullExpr(left, neg)
+                continue
+            return left
+
+    def additive(self) -> ast.Expr:
+        left = self.multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().text
+                left = ast.Binary(op, left, self.multiplicative())
+            elif self.at_op("||"):
+                self.next()
+                left = ast.Binary("||", left, self.multiplicative())
+            else:
+                return left
+
+    def multiplicative(self) -> ast.Expr:
+        left = self.unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().text
+            left = ast.Binary(op, left, self.unary())
+        return left
+
+    def unary(self) -> ast.Expr:
+        if self.at_op("-"):
+            self.next()
+            return ast.Unary("-", self.unary())
+        if self.at_op("+"):
+            self.next()
+            return self.unary()
+        return self.primary()
+
+    def primary(self) -> ast.Expr:
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            return _number(t.text)
+        if t.kind == "STRING":
+            self.next()
+            return ast.StrLit(t.text)
+        if t.kind == "KEYWORD":
+            return self._keyword_primary(t)
+        if t.kind == "IDENT":
+            return self._ident_primary()
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                q = self.query()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        raise SqlSyntaxError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _keyword_primary(self, t: Token) -> ast.Expr:
+        kw = t.text
+        if kw == "true":
+            self.next()
+            return ast.BoolLit(True)
+        if kw == "false":
+            self.next()
+            return ast.BoolLit(False)
+        if kw == "null":
+            self.next()
+            return ast.NullLit()
+        if kw == "date" and self.peek(1).kind == "STRING":
+            self.next()
+            return ast.DateLit(self.next().text)
+        if kw == "timestamp" and self.peek(1).kind == "STRING":
+            self.next()
+            return ast.TimestampLit(self.next().text)
+        if kw == "interval":
+            self.next()
+            negative = False
+            if self.accept_op("-"):
+                negative = True
+            value = self.next().text
+            unit = self.next().text.rstrip("s")  # day(s), month(s)...
+            return ast.IntervalLit(value, unit, negative)
+        if kw == "case":
+            return self._case()
+        if kw in ("cast", "try_cast"):
+            self.next()
+            self.expect_op("(")
+            e = self.expr()
+            self.expect_kw("as")
+            type_name = self._type_name()
+            self.expect_op(")")
+            return ast.CastExpr(e, type_name, try_cast=(kw == "try_cast"))
+        if kw == "extract":
+            self.next()
+            self.expect_op("(")
+            field = self.next().text
+            self.expect_kw("from")
+            e = self.expr()
+            self.expect_op(")")
+            return ast.ExtractExpr(field, e)
+        if kw == "exists":
+            self.next()
+            self.expect_op("(")
+            q = self.query()
+            self.expect_op(")")
+            return ast.Exists(q)
+        if kw == "substring":
+            self.next()
+            self.expect_op("(")
+            e = self.expr()
+            if self.accept_kw("from"):
+                start = self.expr()
+                length = None
+                if self.accept_kw("for"):
+                    length = self.expr()
+                self.expect_op(")")
+                args = [e, start] + ([length] if length is not None else [])
+                return ast.FnCall("substr", args)
+            args = [e]
+            while self.accept_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            return ast.FnCall("substr", args)
+        # keyword used as a function name or identifier
+        if kw in _NONRESERVED:
+            return self._ident_primary()
+        raise SqlSyntaxError(f"unexpected keyword {kw!r} at {t.pos}")
+
+    def _case(self) -> ast.Expr:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.expr()
+            self.expect_kw("then")
+            whens.append((cond, self.expr()))
+        else_ = None
+        if self.accept_kw("else"):
+            else_ = self.expr()
+        self.expect_kw("end")
+        return ast.CaseExpr(operand, whens, else_)
+
+    def _ident_primary(self) -> ast.Expr:
+        parts = [self.ident()]
+        while self.accept_op("."):
+            if self.accept_op("*"):
+                return ast.Star(tuple(parts))
+            parts.append(self.ident())
+        if self.at_op("("):
+            return self._fn_call(parts[-1] if len(parts) == 1 else ".".join(parts))
+        return ast.Ident(tuple(parts))
+
+    def _fn_call(self, name: str) -> ast.Expr:
+        self.expect_op("(")
+        if self.accept_op("*"):
+            self.expect_op(")")
+            return ast.FnCall(name, [], star=True)
+        if self.accept_op(")"):
+            return ast.FnCall(name, [])
+        distinct = self.accept_kw("distinct")
+        args = [self.expr()]
+        while self.accept_op(","):
+            args.append(self.expr())
+        self.expect_op(")")
+        return ast.FnCall(name, args, distinct=distinct)
+
+    def _type_name(self) -> str:
+        base = self.next().text
+        if self.accept_op("("):
+            params = [self.next().text]
+            while self.accept_op(","):
+                params.append(self.next().text)
+            self.expect_op(")")
+            return f"{base}({','.join(params)})"
+        return base
+
+
+#: keywords that may be used as identifiers / function names
+_NONRESERVED = {
+    "year", "month", "day", "hour", "minute", "second", "date", "timestamp",
+    "count", "first", "last", "tables", "schemas", "catalogs", "session",
+    "analyze", "show", "use", "set", "values",
+}
+
+
+def _number(text: str) -> ast.Expr:
+    if "e" in text.lower():
+        return ast.FloatLit(float(text))
+    if "." in text:
+        return ast.DecimalLit(text)
+    return ast.IntLit(int(text))
