@@ -1,0 +1,66 @@
+"""Benchmark harness: TPC-H Q1 throughput on the default backend.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: lineitem rows/sec through the full engine (SQL -> parse ->
+optimize -> device execution) for TPC-H Q1 at BENCH_SF (default 0.1),
+warm (second run timed; the first run pays XLA compilation, the
+analog of the reference's JIT warmup runs in its benchto config,
+testing/trino-benchto-benchmarks/.../tpch.yaml prewarm).
+
+vs_baseline: speedup over sqlite (single-core C engine) running the
+same query over the same data — the stand-in single-node baseline
+until the reference Java engine is benchmarked side-by-side
+(BASELINE.md records the reference publishes no absolute numbers).
+Set BENCH_BASELINE=skip to emit vs_baseline=0 quickly.
+"""
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    schema = f"sf{sf:g}" if sf != 0.01 else "tiny"
+
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.engine import QueryRunner
+
+    sql = QUERIES["q01"]
+    runner = QueryRunner.tpch(schema)
+    conn = runner.metadata.connector("tpch")
+    n_rows = conn.row_count(schema, "lineitem")
+
+    runner.execute(sql)  # warmup: compile + cache
+    t0 = time.perf_counter()
+    result = runner.execute(sql)
+    dt = time.perf_counter() - t0
+    rows_per_sec = n_rows / dt
+
+    vs_baseline = 0.0
+    if os.environ.get("BENCH_BASELINE") != "skip":
+        import sqlite3  # noqa: F401  (sqlite ships with CPython)
+
+        from trino_tpu.testing.golden import load_tpch_sqlite, to_sqlite
+
+        oracle = load_tpch_sqlite(conn.data(schema), tables=["lineitem"])
+        q = to_sqlite(sql)
+        oracle.execute(q).fetchall()  # warm page cache
+        t1 = time.perf_counter()
+        oracle.execute(q).fetchall()
+        baseline_dt = time.perf_counter() - t1
+        vs_baseline = baseline_dt / dt
+
+    assert len(result.rows) == 4, f"Q1 must yield 4 groups, got {len(result.rows)}"
+    print(json.dumps({
+        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,4 +18,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The axon sitecustomize (TPU tunnel) registers its backend at
+# interpreter startup and overwrites jax_platforms — re-pin to CPU
+# AFTER import so the suite runs on the virtual 8-device CPU mesh,
+# not through the remote-compile tunnel.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

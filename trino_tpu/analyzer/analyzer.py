@@ -20,7 +20,15 @@ import datetime
 from dataclasses import dataclass
 
 from trino_tpu import types as T
-from trino_tpu.expr.ir import AggCall, Call, Cast, InputRef, Literal, RowExpression
+from trino_tpu.expr.ir import (
+    AggCall,
+    Call,
+    Cast,
+    InputRef,
+    Literal,
+    RowExpression,
+    join_key_compatible,
+)
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.plan import nodes as P
 from trino_tpu.analyzer.scope import (
@@ -379,9 +387,17 @@ class Analyzer:
                 )
                 arg = InputRef(arg.type, sym)
             inner_sym = list(sub_node.outputs)[0]
+            if not join_key_compatible(arg.type, sub_node.outputs[inner_sym]):
+                raise AnalysisError(
+                    f"IN subquery key types are not comparable as join "
+                    f"keys: {arg.type} vs {sub_node.outputs[inner_sym]}"
+                )
             keys.append((arg.name, inner_sym))
+        residual: list[RowExpression] = []
         if sub_refs:
-            sub_node, corr = _extract_correlation(sub_node, sub_refs)
+            sub_node, corr, residual = _extract_correlation(
+                sub_node, sub_refs, allow_residual=True
+            )
             for outer_sym, inner_sym in corr:
                 keys.append((outer_sym, inner_sym))
         if not keys:
@@ -393,6 +409,8 @@ class Analyzer:
             {**node.outputs, match_sym: T.BOOLEAN},
             source=node, filter_source=sub_node,
             keys=keys, match_symbol=match_sym,
+            filter=_and_all(residual) if residual else None,
+            null_aware=isinstance(c, ast.InSubquery),
         )
         return sj, scope, match_sym
 
@@ -412,7 +430,6 @@ class Analyzer:
             sub_node, sub_refs, self.symbols
         )
         criteria = [(outer_sym, inner_sym) for outer_sym, inner_sym in corr]
-        outputs = {**node.outputs, value_sym: value_type}
         join = P.Join(
             {**node.outputs, **sub_node.outputs},
             kind="left", left=node, right=sub_node, criteria=criteria,
@@ -485,20 +502,6 @@ class Analyzer:
                 need_pre_project = True
             group_syms.append(sym)
             key_replacements[_ast_key(g)] = InputRef(ir.type, sym)
-        # aliases usable as group keys: group by alias
-        alias_of = {
-            (it.alias or "").lower(): it.expr for it in sel.items if it.alias
-        }
-        resolved_gs = []
-        for i, g in enumerate(sel.group_by):
-            if (
-                isinstance(g, ast.Ident)
-                and len(g.parts) == 1
-                and g.parts[0] in alias_of
-                and _ast_key(g) not in key_replacements
-            ):
-                pass  # already handled via scope resolution or error earlier
-            resolved_gs.append(g)
         if need_pre_project:
             node = P.Project(
                 {s: e.type for s, e in pre_assignments.items()},
@@ -564,10 +567,17 @@ class _OuterRefRecorder(Scope):
 
 # ---- correlation extraction ----------------------------------------------
 
-def _extract_correlation(node: P.PlanNode, outer_syms: set[str]):
+def _extract_correlation(
+    node: P.PlanNode, outer_syms: set[str], allow_residual: bool = False
+):
     """Remove Filter conjuncts of the form inner = outer from the
-    subplan; return (new plan, [(outer_sym, inner_sym)])."""
+    subplan; return (new plan, [(outer_sym, inner_sym)], residual).
+
+    With ``allow_residual``, correlated non-equi conjuncts (e.g.
+    ``l2.l_suppkey <> l1.l_suppkey`` in q21's EXISTS) are collected
+    instead of rejected; the caller evaluates them over matched pairs."""
     corr: list[tuple[str, str]] = []
+    residual: list[RowExpression] = []
 
     def rewrite(n: P.PlanNode) -> P.PlanNode:
         if isinstance(n, P.Filter):
@@ -576,11 +586,14 @@ def _extract_correlation(node: P.PlanNode, outer_syms: set[str]):
                 pair = _corr_eq_pair(cj, outer_syms)
                 if pair is not None:
                     corr.append(pair)
-                else:
-                    if _ir_refs(cj) & outer_syms:
+                elif _ir_refs(cj) & outer_syms:
+                    if allow_residual:
+                        residual.append(cj)
+                    else:
                         raise AnalysisError(
                             f"unsupported correlated predicate: {cj!r}"
                         )
+                else:
                     kept.append(cj)
             src = rewrite(n.source)
             if not kept:
@@ -591,7 +604,10 @@ def _extract_correlation(node: P.PlanNode, outer_syms: set[str]):
             # keep correlated inner symbols visible through projections
             assignments = dict(n.assignments)
             outputs = dict(n.outputs)
-            for _, inner in corr:
+            inner_needed = [i for _, i in corr]
+            for cj in residual:
+                inner_needed.extend(_ir_refs(cj) - outer_syms)
+            for inner in inner_needed:
                 if inner not in assignments and inner in src.outputs:
                     assignments[inner] = InputRef(src.outputs[inner], inner)
                     outputs[inner] = src.outputs[inner]
@@ -605,7 +621,7 @@ def _extract_correlation(node: P.PlanNode, outer_syms: set[str]):
         _assert_no_outer_refs(n, outer_syms)
         return n
 
-    return rewrite(node), corr
+    return rewrite(node), corr, residual
 
 
 def _assert_no_outer_refs(node: P.PlanNode, outer_syms: set[str]):
@@ -649,7 +665,7 @@ def _extract_correlation_through_agg(
         raise AnalysisError(
             "correlated scalar subquery must not have GROUP BY"
         )
-    inner, corr = _extract_correlation(node.source, outer_syms)
+    inner, corr, _ = _extract_correlation(node.source, outer_syms)
     group_keys = [isym for _, isym in corr]
     outputs = {s: inner.outputs[s] for s in group_keys}
     outputs.update({s: a.type for s, a in node.aggregates.items()})
@@ -662,7 +678,11 @@ def _extract_correlation_through_agg(
 def _corr_eq_pair(ir: RowExpression, outer_syms: set[str]):
     if isinstance(ir, Call) and ir.name == "eq":
         a, b = ir.args
-        if isinstance(a, InputRef) and isinstance(b, InputRef):
+        if (
+            isinstance(a, InputRef)
+            and isinstance(b, InputRef)
+            and join_key_compatible(a.type, b.type)
+        ):
             if a.name in outer_syms and b.name not in outer_syms:
                 return (a.name, b.name)
             if b.name in outer_syms and a.name not in outer_syms:
@@ -700,7 +720,11 @@ def _and_all(parts: list[RowExpression]) -> RowExpression:
 def _equi_pair(ir: RowExpression, left_syms: set[str], right_syms: set[str]):
     if isinstance(ir, Call) and ir.name == "eq":
         a, b = ir.args
-        if isinstance(a, InputRef) and isinstance(b, InputRef):
+        if (
+            isinstance(a, InputRef)
+            and isinstance(b, InputRef)
+            and join_key_compatible(a.type, b.type)
+        ):
             if a.name in left_syms and b.name in right_syms:
                 return (a.name, b.name)
             if b.name in left_syms and a.name in right_syms:
@@ -827,6 +851,12 @@ class ExprAnalyzer:
         left = self.analyze(e.left)
         right = self.analyze(e.right)
         if isinstance(left.type, T.VarcharType) or isinstance(right.type, T.VarcharType):
+            return Call(T.BOOLEAN, op, (left, right))
+        if isinstance(left.type, T.DecimalType) and isinstance(right.type, T.DecimalType):
+            # keep both operands unscaled: the compiler compares mixed
+            # scales exactly via floor-div/remainder at the coarser
+            # scale — upscaling to the common scale would overflow
+            # int64 (e.g. decimal(18,2) vs decimal(18,12))
             return Call(T.BOOLEAN, op, (left, right))
         if left.type != right.type:
             common = T.common_super_type(left.type, right.type)

@@ -1,0 +1,78 @@
+"""Top-level query engine facade.
+
+The analog of the reference's LocalQueryRunner
+(MAIN/testing/LocalQueryRunner.java:263): the full pipeline — parse,
+analyze, plan, execute — in one process without the HTTP layers. The
+distributed runner builds on the same stages but fragments the plan and
+executes over a device mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trino_tpu.analyzer.analyzer import Analyzer
+from trino_tpu.connectors.tpch.connector import TpchConnector
+from trino_tpu.exec.local import LocalExecutor
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.page import Page
+from trino_tpu.plan import nodes as P
+from trino_tpu.plan.optimizer import optimize
+from trino_tpu.sql.parser import parse_statement
+
+__all__ = ["QueryRunner", "QueryResult"]
+
+
+@dataclass
+class QueryResult:
+    names: list[str]
+    rows: list[tuple]
+    #: True when the query had a top-level ORDER BY (rows are ordered)
+    ordered: bool = False
+    plan: P.PlanNode | None = field(default=None, repr=False)
+
+
+class QueryRunner:
+    """SQL in, rows out — the LocalQueryRunner analog."""
+
+    def __init__(self, metadata: Metadata | None = None, session: Session | None = None):
+        self.metadata = metadata or Metadata()
+        self.session = session or Session()
+
+    @staticmethod
+    def tpch(schema: str = "tiny") -> "QueryRunner":
+        """Runner with the TPC-H catalog mounted (TpchQueryRunner analog,
+        testing/trino-tests/.../TpchQueryRunner.java:21)."""
+        md = Metadata()
+        md.register_catalog("tpch", TpchConnector())
+        return QueryRunner(md, Session(catalog="tpch", schema=schema))
+
+    def plan_sql(self, sql: str, optimized: bool = True) -> P.PlanNode:
+        stmt = parse_statement(sql)
+        analyzer = Analyzer(self.metadata, self.session)
+        plan = analyzer.analyze(stmt)
+        if optimized:
+            plan = optimize(plan, self.metadata, self.session)
+        return plan
+
+    def execute_page(self, sql: str) -> tuple[P.PlanNode, Page]:
+        plan = self.plan_sql(sql)
+        executor = LocalExecutor(self.metadata, self.session)
+        return plan, executor.execute(plan)
+
+    def execute(self, sql: str) -> QueryResult:
+        plan, page = self.execute_page(sql)
+        ordered = _has_order(plan)
+        return QueryResult(
+            names=list(page.names),
+            rows=page.to_pylist(),
+            ordered=ordered,
+            plan=plan,
+        )
+
+
+def _has_order(plan: P.PlanNode) -> bool:
+    node = plan
+    while isinstance(node, (P.Output, P.Limit, P.Project)):
+        node = node.sources[0]
+    return isinstance(node, (P.Sort, P.TopN))

@@ -1,0 +1,5 @@
+"""Execution layer: device kernels + plan executors."""
+
+from trino_tpu.exec.local import LocalExecutor
+
+__all__ = ["LocalExecutor"]

@@ -1,0 +1,128 @@
+"""Aggregate function evaluation as segment reductions.
+
+The analog of the reference's accumulator layer
+(MAIN/operator/aggregation/, AccumulatorCompiler): each aggregate is a
+(masked) segment reduction over group ids produced by
+``kernels.assign_groups``. Per-row accumulate loops become one
+``segment_sum``/``segment_min``/``segment_max`` per aggregate, which
+XLA lowers to sorted-scatter updates — the whole group-by runs as a
+handful of fused device ops.
+
+Distinct aggregates dedupe first: a second ``assign_groups`` over
+(group keys + argument) keeps one representative row per distinct
+value, then the plain path aggregates the representatives
+(the reference routes this through MarkDistinct / DistinctAccumulator).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.exec import kernels as K
+from trino_tpu.expr.compiler import _div_round_half_up
+
+__all__ = ["compute_aggregate", "VARIANCE_FNS"]
+
+VARIANCE_FNS = {
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+}
+
+
+def compute_aggregate(
+    name: str,
+    out_type: T.DataType,
+    arg: tuple[jnp.ndarray, jnp.ndarray | None] | None,
+    group: jnp.ndarray,
+    capacity: int,
+    live: jnp.ndarray,
+):
+    """Evaluate one aggregate over group ids.
+
+    ``group[i]`` in [0, capacity) for rows that aggregate, ``capacity``
+    for rows that don't (dead rows / later: filtered rows). Returns
+    (data[capacity], valid[capacity] | None).
+    """
+    if name == "count_all":
+        cnt = K.seg_sum(live.astype(jnp.int64), group, capacity)
+        return cnt, None
+
+    data, valid = arg
+    contrib = live if valid is None else (live & valid)
+
+    if name == "count":
+        cnt = K.seg_sum(contrib.astype(jnp.int64), group, capacity)
+        return cnt, None
+
+    cnt = K.seg_sum(contrib.astype(jnp.int64), group, capacity)
+    nonempty = cnt > 0
+
+    if name == "sum":
+        z = jnp.zeros((), dtype=data.dtype)
+        s = K.seg_sum(jnp.where(contrib, data, z), group, capacity)
+        if isinstance(out_type, (T.DoubleType, T.RealType)):
+            s = s.astype(out_type.np_dtype)
+        return s, nonempty
+
+    if name == "avg":
+        if isinstance(out_type, T.DecimalType):
+            # unscaled int sum / count, rounded half away from zero
+            # (reference: DecimalAverageAggregation)
+            s = K.seg_sum(jnp.where(contrib, data, 0), group, capacity)
+            d = _div_round_half_up(s, jnp.maximum(cnt, 1))
+            return d, nonempty
+        s = K.seg_sum(
+            jnp.where(contrib, data.astype(jnp.float64), 0.0), group, capacity
+        )
+        return s / jnp.maximum(cnt, 1), nonempty
+
+    if name in ("min", "max"):
+        if data.dtype == jnp.bool_:
+            d8 = data.astype(jnp.int8)
+            fill = jnp.int8(1 if name == "min" else 0)
+            masked = jnp.where(contrib, d8, fill)
+            red = K.seg_min if name == "min" else K.seg_max
+            return red(masked, group, capacity).astype(jnp.bool_), nonempty
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            fill = jnp.array(
+                np.inf if name == "min" else -np.inf, dtype=data.dtype
+            )
+        else:
+            info = jnp.iinfo(data.dtype)
+            fill = jnp.array(
+                info.max if name == "min" else info.min, dtype=data.dtype
+            )
+        masked = jnp.where(contrib, data, fill)
+        red = K.seg_min if name == "min" else K.seg_max
+        return red(masked, group, capacity), nonempty
+
+    if name in ("any_value", "arbitrary"):
+        n = data.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        first = K.seg_min(jnp.where(contrib, idx, n), group, capacity)
+        return data[jnp.clip(first, 0, n - 1)], nonempty
+
+    if name in ("bool_and", "bool_or"):
+        d8 = data.astype(jnp.int8)
+        fill = jnp.int8(1 if name == "bool_and" else 0)
+        masked = jnp.where(contrib, d8, fill)
+        red = K.seg_min if name == "bool_and" else K.seg_max
+        return red(masked, group, capacity).astype(jnp.bool_), nonempty
+
+    if name in VARIANCE_FNS:
+        x = jnp.where(contrib, data.astype(jnp.float64), 0.0)
+        s1 = K.seg_sum(x, group, capacity)
+        s2 = K.seg_sum(x * x, group, capacity)
+        n = cnt.astype(jnp.float64)
+        m2 = s2 - (s1 * s1) / jnp.maximum(n, 1.0)
+        m2 = jnp.maximum(m2, 0.0)  # clamp fp cancellation
+        pop = name.endswith("_pop")
+        denom = n if pop else n - 1.0
+        ok = cnt >= (1 if pop else 2)
+        var = m2 / jnp.maximum(denom, 1.0)
+        if name.startswith("stddev"):
+            var = jnp.sqrt(var)
+        return var, ok
+
+    raise NotImplementedError(f"aggregate {name}")

@@ -1,0 +1,276 @@
+"""Device kernels for relational operators.
+
+The TPU-native replacements for the reference's hot loops
+(SURVEY.md §3.3): instead of per-row probe loops (FlatHash.putIfAbsent,
+MAIN/operator/FlatHash.java:190; JoinProbe per-row lookup,
+MAIN/operator/join/JoinProbe.java:27), everything here is a
+whole-column computation XLA can tile onto the MXU/VPU:
+
+- ``assign_groups``: group-by key -> slot assignment via a vectorized
+  open-addressing claim-by-scatter loop (the FlatHash analog; the
+  control-byte probe of FlatHash.java:58 becomes a lane-parallel
+  scatter-min race).
+- ``segment_*``: aggregate accumulation as segment reductions (the
+  Accumulator analog, MAIN/operator/aggregation/).
+- ``join_expand``: equi-join via sort + searchsorted range expansion
+  (the PagesHash/LookupSource analog, MAIN/operator/join/PagesHash.java:19).
+- ``sort_perm``: multi-key order-by via iterated stable argsort
+  (the PagesIndex sort analog, MAIN/operator/OrderByOperator.java).
+
+All shapes are static; data-dependent sizes are carried as masks, and
+the only host syncs are capacity decisions at operator boundaries.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "hash_columns",
+    "normalize_key",
+    "assign_groups",
+    "sort_perm",
+    "join_ranges",
+    "expand_matches",
+]
+
+
+# ---- hashing ---------------------------------------------------------------
+
+_MIX_1 = np.uint64(0xFF51AFD7ED558CCD)
+_MIX_2 = np.uint64(0xC4CEB9FE1A85EC53)
+_NULL_SALT = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(h: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64-style finalizer (wraparound uint64 math)."""
+    h = h ^ (h >> 33)
+    h = h * _MIX_1
+    h = h ^ (h >> 33)
+    h = h * _MIX_2
+    h = h ^ (h >> 33)
+    return h
+
+
+def _to_bits(data: jnp.ndarray) -> jnp.ndarray:
+    """Reinterpret a key column as uint64 bits."""
+    if data.dtype == jnp.float64:
+        return jax.lax.bitcast_convert_type(data, jnp.uint64)
+    if data.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(data, jnp.uint32).astype(jnp.uint64)
+    if data.dtype == jnp.bool_:
+        return data.astype(jnp.uint64)
+    return data.astype(jnp.uint64)
+
+
+def normalize_key(data: jnp.ndarray, valid: jnp.ndarray | None):
+    """(bits, null_flag) with NULL data zeroed so equal keys have equal
+    bits (SQL GROUP BY / join keys treat NULLs as one group)."""
+    bits = _to_bits(data)
+    if valid is None:
+        return bits, jnp.zeros(bits.shape, dtype=jnp.bool_)
+    return jnp.where(valid, bits, jnp.uint64(0)), ~valid
+
+
+def hash_columns(cols: list[tuple[jnp.ndarray, jnp.ndarray | None]]) -> jnp.ndarray:
+    """Combined 64-bit hash of key columns (nulls hash to a salt)."""
+    h = jnp.zeros(cols[0][0].shape, dtype=jnp.uint64)
+    for data, valid in cols:
+        bits, isnull = normalize_key(data, valid)
+        bits = jnp.where(isnull, _NULL_SALT, bits)
+        h = _mix64(h ^ _mix64(bits))
+    return h
+
+
+# ---- group-by slot assignment ---------------------------------------------
+
+@partial(jax.jit, static_argnames=("capacity",))
+def assign_groups(
+    norm_bits: tuple[jnp.ndarray, ...],
+    null_flags: tuple[jnp.ndarray, ...],
+    live: jnp.ndarray,
+    capacity: int,
+):
+    """Assign each live row a slot in an open-addressed table.
+
+    The vectorized FlatHash (MAIN/operator/FlatHash.java:42): all rows
+    probe in lockstep; unclaimed slots are claimed by a scatter-min
+    race on row index; losers compare keys against the winner by
+    gather and advance their probe. Terminates in <= capacity rounds
+    (capacity must exceed the distinct-key count; callers size it at
+    2x the live rows).
+
+    Returns (group, owner): ``group[i]`` = slot of row i (== capacity
+    for dead rows, usable as a drop segment), ``owner[s]`` = row index
+    owning slot s (== n when the slot is empty).
+    """
+    n = live.shape[0]
+    row_idx = jnp.arange(n, dtype=jnp.int32)
+    h = hash_columns(
+        [(b, None) for b in norm_bits]
+        + [(f, None) for f in null_flags]
+    )
+    base = (h & jnp.uint64(capacity - 1)).astype(jnp.int32)
+
+    owner0 = jnp.full((capacity,), n, dtype=jnp.int32)
+    group0 = jnp.full((n,), capacity, dtype=jnp.int32)
+    probe0 = jnp.zeros((n,), dtype=jnp.int32)
+    resolved0 = ~live
+
+    def cond(state):
+        _, resolved, _, _ = state
+        return jnp.any(~resolved)
+
+    def body(state):
+        probe, resolved, group, owner = state
+        slot = (base + probe) & (capacity - 1)
+        pending = ~resolved
+        # claim empty slots: lowest row index wins
+        claim_slot = jnp.where(pending & (owner[slot] == n), slot, capacity)
+        owner = owner.at[claim_slot].min(row_idx, mode="drop")
+        own = owner[slot]
+        own_g = jnp.clip(own, 0, n - 1)
+        match = jnp.ones((n,), dtype=jnp.bool_)
+        for bits in norm_bits:
+            match = match & (bits == bits[own_g])
+        for flag in null_flags:
+            match = match & (flag == flag[own_g])
+        resolved_now = pending & match
+        group = jnp.where(resolved_now, slot, group)
+        resolved = resolved | resolved_now
+        probe = probe + jnp.where(resolved, 0, 1)
+        return probe, resolved, group, owner
+
+    _, _, group, owner = jax.lax.while_loop(
+        cond, body, (probe0, resolved0, group0, owner0)
+    )
+    return group, owner
+
+
+# ---- sorting ---------------------------------------------------------------
+
+def sort_perm(
+    keys: list[tuple[jnp.ndarray, jnp.ndarray | None, bool, bool]],
+    live: jnp.ndarray,
+) -> jnp.ndarray:
+    """Permutation ordering rows by the sort keys, dead rows last.
+
+    Each key is (data, valid, ascending, nulls_first). Implemented as
+    iterated stable argsorts (lexsort), two passes per key — data then
+    null flag — so no in-band sentinels are needed and int64 keys keep
+    full precision. The reference default null ordering (nulls treated
+    as largest: last for ASC, first for DESC) is resolved by the
+    caller into ``nulls_first``.
+    """
+    n = live.shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    for data, valid, ascending, nulls_first in reversed(keys):
+        kd = data if ascending else _invert(data)
+        perm = perm[jnp.argsort(kd[perm], stable=True)]
+        if valid is not None:
+            flag = (~valid).astype(jnp.int8)  # 1 = null
+            if nulls_first:
+                flag = -flag
+            perm = perm[jnp.argsort(flag[perm], stable=True)]
+    dead = (~live).astype(jnp.int8)
+    perm = perm[jnp.argsort(dead[perm], stable=True)]
+    return perm
+
+
+def _invert(data: jnp.ndarray) -> jnp.ndarray:
+    if data.dtype == jnp.bool_:
+        return ~data
+    return -data  # int64 min overflow is accepted (reference wraps too)
+
+
+# ---- equi-join -------------------------------------------------------------
+
+@jax.jit
+def join_ranges(
+    build_key: jnp.ndarray,
+    build_live: jnp.ndarray,
+    probe_key: jnp.ndarray,
+    probe_live: jnp.ndarray,
+):
+    """Sorted-range probe: the LookupSource analog.
+
+    ``build_key``/``probe_key`` are combined uint64 keys (exact for a
+    single fixed-width column; hashed for multi-column — callers must
+    re-verify matches after expansion). Rows with live=False never
+    match; the caller has already excluded NULL keys.
+
+    Returns (order, lo, cnt): ``order`` sorts the build side by key
+    (dead rows last), ``lo[i]``/``cnt[i]`` give each probe row's match
+    range inside the sorted build side.
+    """
+    # sort build: dead rows pushed past every live key via a 2-key sort
+    dead = (~build_live).astype(jnp.uint64)
+    order = jnp.argsort(build_key, stable=True)
+    order = order[jnp.argsort(dead[order], stable=True)]
+    n_build_live = jnp.sum(build_live)
+    # dead tail keys are arbitrary; pin them to MAX so the whole array
+    # is globally sorted (binary-search precondition), then clamp the
+    # ranges to the live prefix
+    pos = jnp.arange(build_key.shape[0])
+    sorted_key = jnp.where(
+        pos < n_build_live, build_key[order], jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    )
+    lo = jnp.searchsorted(sorted_key, probe_key, side="left")
+    hi = jnp.searchsorted(sorted_key, probe_key, side="right")
+    lo = jnp.minimum(lo, n_build_live)
+    hi = jnp.minimum(hi, n_build_live)
+    cnt = jnp.where(probe_live, hi - lo, 0)
+    return order.astype(jnp.int32), lo.astype(jnp.int32), cnt.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("out_capacity",))
+def expand_matches(
+    order: jnp.ndarray,
+    lo: jnp.ndarray,
+    cnt: jnp.ndarray,
+    out_capacity: int,
+):
+    """Expand per-probe match ranges into (probe_idx, build_idx) pairs.
+
+    Output position j belongs to the probe row whose cumulative match
+    count covers j (searchsorted over the prefix sums — the vectorized
+    form of JoinProbe's nested emit loop).
+
+    Returns (probe_idx, build_idx, out_live).
+    """
+    offsets = jnp.cumsum(cnt)  # inclusive
+    total = offsets[-1] if cnt.shape[0] else jnp.int32(0)
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    probe_idx = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+    probe_c = jnp.clip(probe_idx, 0, cnt.shape[0] - 1)
+    start = offsets[probe_c] - cnt[probe_c]
+    k = j - start
+    build_pos = lo[probe_c] + k
+    build_pos = jnp.clip(build_pos, 0, order.shape[0] - 1)
+    build_idx = order[build_pos]
+    out_live = j < total
+    return probe_c, build_idx, out_live
+
+
+# ---- segment aggregation ---------------------------------------------------
+
+def seg_sum(vals, group, num_segments):
+    return jax.ops.segment_sum(vals, group, num_segments=num_segments + 1)[
+        :num_segments
+    ]
+
+
+def seg_min(vals, group, num_segments):
+    return jax.ops.segment_min(vals, group, num_segments=num_segments + 1)[
+        :num_segments
+    ]
+
+
+def seg_max(vals, group, num_segments):
+    return jax.ops.segment_max(vals, group, num_segments=num_segments + 1)[
+        :num_segments
+    ]

@@ -1,0 +1,558 @@
+"""Single-fragment plan execution over device pages.
+
+The analog of the reference's LocalExecutionPlanner + Driver
+(MAIN/sql/planner/LocalExecutionPlanner.java:527,
+MAIN/operator/Driver.java:66) collapsed into a batch-synchronous tree
+walk: each plan node consumes whole device Pages and produces one —
+there is no page-at-a-time pull loop because a TPU wants one large
+batched computation per operator, not 4KB batches. Host syncs happen
+only at capacity decisions (join fan-out, group counts), mirroring the
+reference's build-side barriers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+from trino_tpu.exec import kernels as K
+from trino_tpu.exec.aggregates import compute_aggregate
+from trino_tpu.expr.compiler import ColumnLayout, compile_expr
+from trino_tpu.expr.ir import AggCall, RowExpression
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.page import Column, Page, pad_capacity, unify_dictionaries
+from trino_tpu.plan import nodes as P
+
+__all__ = ["LocalExecutor"]
+
+
+class LocalExecutor:
+    """Executes a logical plan tree on the local devices."""
+
+    def __init__(self, metadata: Metadata, session: Session):
+        self.metadata = metadata
+        self.session = session
+
+    def execute(self, node: P.PlanNode) -> Page:
+        m = getattr(self, f"_{type(node).__name__}", None)
+        if m is None:
+            raise NotImplementedError(f"no executor for {type(node).__name__}")
+        return m(node)
+
+    # ---- expression evaluation ------------------------------------------
+
+    def _layout(self, page: Page) -> ColumnLayout:
+        return ColumnLayout(
+            types={n: c.type for n, c in zip(page.names, page.columns)},
+            dictionaries={
+                n: c.dictionary for n, c in zip(page.names, page.columns)
+            },
+        )
+
+    def _eval(self, page: Page, expr: RowExpression):
+        """Evaluate an expression over a page.
+
+        Returns (data, valid, dictionary) with data broadcast to the
+        page capacity.
+        """
+        compiled = compile_expr(expr, self._layout(page))
+        env = {
+            n: (c.data, c.valid) for n, c in zip(page.names, page.columns)
+        }
+        data, valid = compiled.fn(env)
+        cap = page.capacity
+        if jnp.ndim(data) == 0:
+            data = jnp.broadcast_to(data, (cap,))
+        if valid is not None and jnp.ndim(valid) == 0:
+            valid = jnp.broadcast_to(valid, (cap,))
+        return data, valid, compiled.dictionary
+
+    # ---- leaf nodes ------------------------------------------------------
+
+    def _TableScan(self, node: P.TableScan) -> Page:
+        connector = self.metadata.connector(node.catalog)
+        cols = connector.scan(
+            node.schema, node.table, list(node.assignments.values())
+        )
+        named = {
+            sym: (node.outputs[sym], cols[col])
+            for sym, col in node.assignments.items()
+        }
+        return Page.from_arrays(named)
+
+    def _Values(self, node: P.Values) -> Page:
+        # only the zero-column single-row form (SELECT without FROM)
+        if node.outputs:
+            raise NotImplementedError("general VALUES is not supported yet")
+        mask = np.zeros(8, dtype=np.bool_)
+        mask[: len(node.rows)] = True
+        return Page([], [], jnp.asarray(mask))
+
+    # ---- row-level nodes -------------------------------------------------
+
+    def _Filter(self, node: P.Filter) -> Page:
+        page = self.execute(node.source)
+        data, valid, _ = self._eval(page, node.predicate)
+        keep = data if valid is None else (data & valid)
+        return Page(page.names, page.columns, page.mask & keep)
+
+    def _Project(self, node: P.Project) -> Page:
+        page = self.execute(node.source)
+        names, cols = [], []
+        for sym, expr in node.assignments.items():
+            data, valid, dictionary = self._eval(page, expr)
+            names.append(sym)
+            cols.append(Column(expr.type, data, valid, dictionary))
+        return Page(names, cols, page.mask)
+
+    def _Limit(self, node: P.Limit) -> Page:
+        page = self.execute(node.source)
+        rank = jnp.cumsum(page.mask.astype(jnp.int64))
+        keep = page.mask & (rank > node.offset)
+        if node.count >= 0:
+            keep = keep & (rank <= node.offset + node.count)
+        return Page(page.names, page.columns, keep)
+
+    def _Output(self, node: P.Output) -> Page:
+        page = self.execute(node.source)
+        cols = [page.column(s) for s in node.symbols]
+        return Page(list(node.names), cols, page.mask)
+
+    def _Exchange(self, node: P.Exchange) -> Page:
+        # single-fragment local execution: exchanges are pass-through;
+        # the distributed executor lowers REMOTE ones to collectives
+        return self.execute(node.source)
+
+    # ---- sorting ---------------------------------------------------------
+
+    def _sort_keys(self, page: Page, keys: list[P.SortKey]):
+        out = []
+        for k in keys:
+            col = page.column(k.symbol)
+            nulls_first = k.nulls_first
+            if nulls_first is None:
+                # reference default: nulls are largest (ASC last, DESC first)
+                nulls_first = not k.ascending
+            out.append((col.data, col.valid, k.ascending, nulls_first))
+        return out
+
+    def _apply_perm(self, page: Page, perm: jnp.ndarray, limit: int | None = None) -> Page:
+        cols = []
+        for c in page.columns:
+            data = c.data[perm]
+            valid = None if c.valid is None else c.valid[perm]
+            if limit is not None:
+                data = data[:limit]
+                valid = None if valid is None else valid[:limit]
+            cols.append(Column(c.type, data, valid, c.dictionary))
+        mask = page.mask[perm]
+        if limit is not None:
+            mask = mask[:limit]
+        return Page(page.names, cols, mask)
+
+    def _Sort(self, node: P.Sort) -> Page:
+        page = self.execute(node.source)
+        perm = K.sort_perm(self._sort_keys(page, node.keys), page.mask)
+        return self._apply_perm(page, perm)
+
+    def _TopN(self, node: P.TopN) -> Page:
+        page = self.execute(node.source)
+        perm = K.sort_perm(self._sort_keys(page, node.keys), page.mask)
+        out = self._apply_perm(page, perm, limit=None)
+        pos = jnp.arange(out.capacity)
+        mask = out.mask & (pos < node.count)
+        cap = pad_capacity(min(node.count, out.capacity))
+        return self._slice(Page(out.names, out.columns, mask), cap)
+
+    @staticmethod
+    def _slice(page: Page, capacity: int) -> Page:
+        if capacity >= page.capacity:
+            return page
+        cols = [
+            Column(
+                c.type,
+                c.data[:capacity],
+                None if c.valid is None else c.valid[:capacity],
+                c.dictionary,
+            )
+            for c in page.columns
+        ]
+        return Page(page.names, cols, page.mask[:capacity])
+
+    def _compact(self, page: Page, extra_capacity: int = 0) -> Page:
+        """Gather live rows to the front and shrink capacity
+        (Page.compact analog, SPI/Page.java:180). Host-syncs the count."""
+        n_live = page.num_rows()
+        cap = pad_capacity(n_live + extra_capacity)
+        perm = jnp.argsort((~page.mask).astype(jnp.int8), stable=True)
+        if cap >= page.capacity:
+            return self._apply_perm(page, perm)
+        return self._apply_perm(page, perm, limit=cap)
+
+    # ---- aggregation -----------------------------------------------------
+
+    def _Aggregate(self, node: P.Aggregate) -> Page:
+        page = self.execute(node.source)
+        live = page.mask
+        if not node.group_keys:
+            return self._global_aggregate(node, page)
+
+        key_cols = [page.column(s) for s in node.group_keys]
+        n_live = page.num_rows()
+        capacity = pad_capacity(max(2 * n_live, 8))
+        norm = [K.normalize_key(c.data, c.valid) for c in key_cols]
+        group, owner = K.assign_groups(
+            tuple(b for b, _ in norm), tuple(f for _, f in norm), live, capacity
+        )
+        occupied = owner < page.capacity
+
+        names, cols = [], []
+        own_idx = jnp.clip(owner, 0, page.capacity - 1)
+        for sym, col in zip(node.group_keys, key_cols):
+            data = col.data[own_idx]
+            valid = None if col.valid is None else (col.valid[own_idx] & occupied)
+            names.append(sym)
+            cols.append(Column(col.type, data, valid, col.dictionary))
+
+        for sym, call in node.aggregates.items():
+            data, valid = self._run_agg(page, call, group, capacity, live, key_cols)
+            names.append(sym)
+            cols.append(
+                Column(
+                    call.type, data, _and_mask(valid, None),
+                    self._agg_dictionary(page, call),
+                )
+            )
+        out = Page(names, cols, occupied)
+        return self._compact(out)
+
+    def _global_aggregate(self, node: P.Aggregate, page: Page) -> Page:
+        # one output row, even over empty input (reference semantics)
+        live = page.mask
+        group = jnp.where(live, 0, 1).astype(jnp.int32)
+        names, cols = [], []
+        cap = 8
+        for sym, call in node.aggregates.items():
+            data, valid = self._run_agg(page, call, group, 1, live, [])
+            data = _pad_to(data, cap)
+            valid = None if valid is None else _pad_to(valid, cap)
+            names.append(sym)
+            cols.append(
+                Column(call.type, data, valid, self._agg_dictionary(page, call))
+            )
+        mask = np.zeros(cap, dtype=np.bool_)
+        mask[0] = True
+        return Page(names, cols, jnp.asarray(mask))
+
+    def _agg_dictionary(self, page: Page, call: AggCall):
+        if not isinstance(call.type, T.VarcharType):
+            return None
+        # min/max/any_value over varchar keep the argument's dictionary
+        compiled = compile_expr(call.args[0], self._layout(page))
+        return compiled.dictionary
+
+    def _run_agg(
+        self, page: Page, call: AggCall, group, capacity, live, key_cols
+    ):
+        arg = None
+        if call.args:
+            data, valid, _ = self._eval(page, call.args[0])
+            arg = (data, valid)
+        contrib_live = live
+        if call.filter is not None:
+            fd, fv, _ = self._eval(page, call.filter)
+            contrib_live = contrib_live & (fd if fv is None else (fd & fv))
+        g = group
+        if call.distinct:
+            g, contrib_live = self._dedupe(
+                key_cols, arg, group, contrib_live, page.capacity
+            )
+        # rows that don't contribute use the drop segment
+        g = jnp.where(contrib_live, g, capacity)
+        return compute_aggregate(
+            call.name, call.type, arg, g, capacity, contrib_live
+        )
+
+    def _dedupe(self, key_cols, arg, group, live, page_capacity):
+        """DISTINCT: keep one representative row per (group, value)."""
+        data, valid = arg
+        live_d = live if valid is None else (live & valid)
+        norm = [K.normalize_key(c.data, c.valid) for c in key_cols]
+        norm.append(K.normalize_key(data, valid))
+        cap2 = pad_capacity(max(2 * page_capacity, 8))
+        g2, owner2 = K.assign_groups(
+            tuple(b for b, _ in norm), tuple(f for _, f in norm), live_d, cap2
+        )
+        row_idx = jnp.arange(page_capacity, dtype=jnp.int32)
+        rep = live_d & (owner2[jnp.clip(g2, 0, cap2 - 1)] == row_idx)
+        return group, rep
+
+    # ---- joins -----------------------------------------------------------
+
+    def _Join(self, node: P.Join) -> Page:
+        left = self._compact(self.execute(node.left))
+        right = self._compact(self.execute(node.right))
+        if node.kind == "cross":
+            return self._cross_join(node, left, right)
+        if node.kind == "right":
+            flipped = P.Join(
+                node.outputs, kind="left", left=node.right, right=node.left,
+                criteria=[(r, l) for l, r in node.criteria],
+                filter=node.filter,
+            )
+            # re-execute would recompute sources; join directly instead
+            return self._equi_join(flipped, right, left)
+        return self._equi_join(node, left, right)
+
+    def _cross_join(self, node: P.Join, left: Page, right: Page) -> Page:
+        # callers (_Join) hand in already-compacted pages
+        n_l, n_r = left.num_rows(), right.num_rows()
+        cap = pad_capacity(max(n_l * n_r, 1))
+        j = jnp.arange(cap)
+        li = jnp.clip(j // max(n_r, 1), 0, max(left.capacity - 1, 0))
+        ri = jnp.clip(j % max(n_r, 1), 0, max(right.capacity - 1, 0))
+        out_live = j < n_l * n_r
+        names, cols = [], []
+        for page, idx in ((left, li), (right, ri)):
+            for n, c in zip(page.names, page.columns):
+                names.append(n)
+                cols.append(
+                    Column(
+                        c.type,
+                        c.data[idx],
+                        None if c.valid is None else c.valid[idx],
+                        c.dictionary,
+                    )
+                )
+        return Page(names, cols, out_live)
+
+    def _join_key(self, probe: Page, build: Page, criteria):
+        """Combined uint64 keys for probe/build sides.
+
+        Single fixed-width key -> exact; multi-column -> hash-combined
+        and ``verify`` is True (matches re-checked after expansion).
+        """
+        pairs = []
+        for lsym, rsym in criteria:
+            pc, bc = probe.column(lsym), build.column(rsym)
+            if pc.dictionary is not None or bc.dictionary is not None:
+                pc2, bc2 = unify_dictionaries(pc, bc)
+                probe.columns[probe.names.index(lsym)] = pc2
+                build.columns[build.names.index(rsym)] = bc2
+                pc, bc = pc2, bc2
+            pairs.append((pc, bc))
+        probe_valid = None
+        build_valid = None
+        for pc, bc in pairs:
+            probe_valid = _and_mask(probe_valid, pc.valid)
+            build_valid = _and_mask(build_valid, bc.valid)
+        if len(pairs) == 1:
+            pk, _ = K.normalize_key(pairs[0][0].data, None)
+            bk, _ = K.normalize_key(pairs[0][1].data, None)
+            verify = False
+        else:
+            pk = K.hash_columns([(c.data, None) for c, _ in pairs])
+            bk = K.hash_columns([(c.data, None) for _, c in pairs])
+            verify = True
+        return pk, bk, probe_valid, build_valid, pairs, verify
+
+    def _equi_join(self, node: P.Join, probe: Page, build: Page) -> Page:
+        if not node.criteria:
+            raise NotImplementedError(f"{node.kind} join without equi criteria")
+        pk, bk, pv, bv, pairs, verify = self._join_key(
+            probe, build, node.criteria
+        )
+        probe_live = probe.mask if pv is None else (probe.mask & pv)
+        build_live = build.mask if bv is None else (build.mask & bv)
+        order, lo, cnt = K.join_ranges(bk, build_live, pk, probe_live)
+        total = int(jnp.sum(cnt))
+        out_cap = pad_capacity(max(total, 1))
+        probe_idx, build_idx, out_live = K.expand_matches(
+            order, lo, cnt, out_cap
+        )
+        if verify:
+            for pc, bc in pairs:
+                out_live = out_live & (pc.data[probe_idx] == bc.data[build_idx])
+
+        inner = self._gather_join_columns(
+            node, probe, build, probe_idx, build_idx, out_live
+        )
+        if node.filter is not None:
+            fd, fv, _ = self._eval(inner, node.filter)
+            out_live = inner.mask & (fd if fv is None else (fd & fv))
+            inner = Page(inner.names, inner.columns, out_live)
+        if node.kind == "inner":
+            return inner
+        if node.kind in ("left", "full"):
+            matched = K.seg_sum(
+                inner.mask.astype(jnp.int32), probe_idx, probe.capacity
+            ) > 0
+            unmatched = probe.mask & ~matched
+            out = self._append_outer_rows(node, inner, probe, unmatched, side="probe")
+            if node.kind == "full":
+                bmatched = K.seg_sum(
+                    inner.mask.astype(jnp.int32),
+                    jnp.where(inner.mask, build_idx, build.capacity),
+                    build.capacity,
+                ) > 0
+                bunmatched = build.mask & ~bmatched
+                out = self._append_outer_rows(node, out, build, bunmatched, side="build")
+            return out
+        raise NotImplementedError(f"join kind {node.kind}")
+
+    def _gather_join_columns(
+        self, node: P.Join, probe: Page, build: Page, probe_idx, build_idx, out_live
+    ) -> Page:
+        names, cols = [], []
+        for sym in node.outputs:
+            if sym in probe.names:
+                c, idx = probe.column(sym), probe_idx
+            else:
+                c, idx = build.column(sym), build_idx
+            names.append(sym)
+            cols.append(
+                Column(
+                    c.type,
+                    c.data[idx],
+                    None if c.valid is None else c.valid[idx],
+                    c.dictionary,
+                )
+            )
+        return Page(names, cols, out_live)
+
+    def _append_outer_rows(
+        self, node: P.Join, inner: Page, side_page: Page, unmatched, side: str
+    ) -> Page:
+        """Append unmatched outer rows with NULLs for the other side."""
+        n_un = int(jnp.sum(unmatched))
+        if n_un == 0:
+            return inner
+        perm = jnp.argsort(~unmatched, stable=True)
+        cap2 = pad_capacity(n_un)
+        idx = perm[:cap2]
+        sec_live = jnp.arange(cap2) < n_un
+        names, cols = [], []
+        for sym, c_in in zip(inner.names, inner.columns):
+            if sym in side_page.names:
+                c = side_page.column(sym)
+                data2 = c.data[idx]
+                valid2 = sec_live if c.valid is None else (c.valid[idx] & sec_live)
+            else:
+                data2 = jnp.zeros((cap2,), dtype=c_in.type.np_dtype)
+                valid2 = jnp.zeros((cap2,), dtype=jnp.bool_)
+            data = jnp.concatenate([c_in.data, data2])
+            v1 = c_in.valid
+            if v1 is None and valid2 is not None:
+                v1 = jnp.ones((inner.capacity,), dtype=jnp.bool_)
+            valid = None if v1 is None else jnp.concatenate([v1, valid2])
+            names.append(sym)
+            cols.append(Column(c_in.type, data, valid, c_in.dictionary))
+        mask = jnp.concatenate([inner.mask, sec_live])
+        return Page(names, cols, mask)
+
+    # ---- semi join -------------------------------------------------------
+
+    def _SemiJoin(self, node: P.SemiJoin) -> Page:
+        source = self.execute(node.source)
+        filt = self._compact(self.execute(node.filter_source))
+        pk, bk, pv, bv, pairs, verify = self._join_key(
+            source, filt, node.keys
+        )
+        probe_live = source.mask if pv is None else (source.mask & pv)
+        build_live = filt.mask if bv is None else (filt.mask & bv)
+        order, lo, cnt = K.join_ranges(bk, build_live, pk, probe_live)
+        if verify or node.filter is not None:
+            total = int(jnp.sum(cnt))
+            out_cap = pad_capacity(max(total, 1))
+            probe_idx, build_idx, out_live = K.expand_matches(
+                order, lo, cnt, out_cap
+            )
+            for pc, bc in pairs:
+                out_live = out_live & (pc.data[probe_idx] == bc.data[build_idx])
+            if node.filter is not None:
+                # residual correlated predicate over (source, filter) pairs
+                pair_page = self._gather_pair_page(
+                    source, filt, probe_idx, build_idx, out_live
+                )
+                fd, fv, _ = self._eval(pair_page, node.filter)
+                out_live = out_live & (fd if fv is None else (fd & fv))
+            matched = K.seg_sum(
+                out_live.astype(jnp.int32), probe_idx, source.capacity
+            ) > 0
+        else:
+            matched = cnt > 0
+        valid = None
+        if node.null_aware:
+            # IN 3VL: NULL probe key, or no match while the build side
+            # has NULLs -> NULL (reference SemiJoinNode semantics).
+            # EXISTS is 2-valued: match is plain TRUE/FALSE.
+            build_null_for = self._in_build_nulls(node, source, filt, bv)
+            if pv is not None or build_null_for is not None:
+                valid = pv if pv is not None else jnp.ones_like(matched)
+                if build_null_for is not None:
+                    valid = valid & (matched | ~build_null_for)
+        names = list(source.names) + [node.match_symbol]
+        cols = list(source.columns) + [
+            Column(T.BOOLEAN, matched, valid, None)
+        ]
+        return Page(names, cols, source.mask)
+
+    def _in_build_nulls(self, node: P.SemiJoin, source: Page, filt: Page, bv):
+        """Per-probe 'the build side contributed a NULL key' vector for
+        IN 3VL, or None when no NULL keys exist.
+
+        With a correlated residual filter, only NULL-key build rows
+        that pass the filter against that probe row count (the review
+        case: x NOT IN (select y from t where t.z <> outer.w))."""
+        if bv is None:
+            return None
+        null_rows = np.nonzero(np.asarray(filt.mask & ~bv))[0]
+        if len(null_rows) == 0:
+            return None
+        if node.filter is None:
+            return jnp.ones((source.capacity,), dtype=jnp.bool_)
+        any_null = jnp.zeros((source.capacity,), dtype=jnp.bool_)
+        probe_idx = jnp.arange(source.capacity, dtype=jnp.int32)
+        for r in null_rows.tolist():
+            build_idx = jnp.full((source.capacity,), r, dtype=jnp.int32)
+            pair = self._gather_pair_page(
+                source, filt, probe_idx, build_idx, source.mask
+            )
+            fd, fv, _ = self._eval(pair, node.filter)
+            passes = fd if fv is None else (fd & fv)
+            any_null = any_null | passes
+        return any_null
+
+    @staticmethod
+    def _gather_pair_page(probe: Page, build: Page, probe_idx, build_idx, live) -> Page:
+        names, cols = [], []
+        for page, idx in ((probe, probe_idx), (build, build_idx)):
+            for n, c in zip(page.names, page.columns):
+                names.append(n)
+                cols.append(
+                    Column(
+                        c.type,
+                        c.data[idx],
+                        None if c.valid is None else c.valid[idx],
+                        c.dictionary,
+                    )
+                )
+        return Page(names, cols, live)
+
+
+def _and_mask(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _pad_to(arr: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    n = arr.shape[0]
+    if n >= capacity:
+        return arr[:capacity]
+    return jnp.concatenate(
+        [arr, jnp.zeros((capacity - n,), dtype=arr.dtype)]
+    )

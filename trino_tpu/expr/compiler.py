@@ -324,6 +324,12 @@ class _Compiler:
         b = self.compile(rhs)
         if isinstance(lhs.type, T.VarcharType) or isinstance(rhs.type, T.VarcharType):
             return self._string_comparison(expr, a, b)
+        if (
+            isinstance(lhs.type, T.DecimalType)
+            and isinstance(rhs.type, T.DecimalType)
+            and lhs.type.scale != rhs.type.scale
+        ):
+            return self._mixed_scale_comparison(expr, a, b)
         op = _CMP_OPS[expr.name]
 
         def ev(env):
@@ -333,12 +339,54 @@ class _Compiler:
 
         return CompiledExpr(ev, T.BOOLEAN)
 
+    def _mixed_scale_comparison(self, expr: Call, a: CompiledExpr, b: CompiledExpr) -> CompiledExpr:
+        """Exact decimal comparison across scales without rescaling.
+
+        Upscaling the coarse side by 10^(s_b - s_a) overflows int64 for
+        large values (the reference sidesteps this with Int128 math,
+        SPI/type/Decimals.java). Instead compare at the coarser scale:
+        with m = 10^(s_b - s_a), q = floor(b / m), r = b - q*m (r >= 0):
+        a*m <=> q*m + r reduces to comparing (a, 0) with (q, r)
+        lexicographically.
+        """
+        name = expr.name
+        if a.type.scale > b.type.scale:
+            return self._mixed_scale_comparison(
+                Call(
+                    T.BOOLEAN,
+                    _MIRRORED_CMP.get(name, name),
+                    (expr.args[1], expr.args[0]),
+                ),
+                b, a,
+            )
+        m = 10 ** (b.type.scale - a.type.scale)
+
+        def ev(env):
+            a_d, a_v = a.fn(env)
+            b_d, b_v = b.fn(env)
+            q = b_d // m  # floor division: r in [0, m)
+            r = b_d - q * m
+            if name == "eq":
+                out = (a_d == q) & (r == 0)
+            elif name == "ne":
+                out = (a_d != q) | (r != 0)
+            elif name == "lt":
+                out = (a_d < q) | ((a_d == q) & (r > 0))
+            elif name == "le":
+                out = a_d <= q
+            elif name == "gt":
+                out = a_d > q
+            else:  # ge
+                out = (a_d > q) | ((a_d == q) & (r == 0))
+            return out, _and_valid(a_v, b_v)
+
+        return CompiledExpr(ev, T.BOOLEAN)
+
     def _string_comparison(self, expr: Call, a: CompiledExpr, b: CompiledExpr) -> CompiledExpr:
         op = _CMP_OPS[expr.name]
         if a.is_literal and not b.is_literal:
             # normalize literal to the rhs with the mirrored operator
-            mirrored = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
-            name = mirrored.get(expr.name, expr.name)
+            name = _MIRRORED_CMP.get(expr.name, expr.name)
             return self._string_comparison(
                 Call(T.BOOLEAN, name, (expr.args[1], expr.args[0])), b, a
             )
@@ -374,6 +422,18 @@ class _Compiler:
                     return op(a_d, b_d), _and_valid(a_v, b_v)
 
                 return CompiledExpr(ev_shared, T.BOOLEAN)
+            # distinct dictionaries: remap both onto their union at
+            # compile time (codes stay order-preserving), compare codes
+            merged, remap_a, remap_b = a.dictionary.union(b.dictionary)
+            ra = _remap_gather(remap_a)
+            rb = _remap_gather(remap_b)
+
+            def ev_merged(env):
+                a_d, a_v = a.fn(env)
+                b_d, b_v = b.fn(env)
+                return op(ra(a_d), rb(b_d)), _and_valid(a_v, b_v)
+
+            return CompiledExpr(ev_merged, T.BOOLEAN)
         raise NotImplementedError(
             "varchar comparison requires a literal or a shared dictionary"
         )
@@ -624,6 +684,10 @@ def _redict_fn(part: CompiledExpr, merged: StringDictionary | None):
     if merged is None or part.dictionary is merged:
         return lambda data: data
     remap = np.searchsorted(merged.values, part.dictionary.values).astype(np.int32)
+    return _remap_gather(remap)
+
+
+def _remap_gather(remap: np.ndarray):
     if len(remap) == 0:
         return lambda data: data
     remap_dev = jnp.asarray(remap)
@@ -638,6 +702,9 @@ _CMP_OPS = {
     "gt": lambda a, b: a > b,
     "ge": lambda a, b: a >= b,
 }
+
+#: operator under argument swap: a OP b == b MIRROR(OP) a
+_MIRRORED_CMP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
 
 _STRING_PREDICATES = {"like", "not_like"}
 

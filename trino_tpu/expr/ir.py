@@ -15,7 +15,28 @@ from typing import Any
 
 from trino_tpu import types as T
 
-__all__ = ["RowExpression", "Literal", "InputRef", "Call", "Cast", "AggCall"]
+__all__ = [
+    "RowExpression", "Literal", "InputRef", "Call", "Cast", "AggCall",
+    "join_key_compatible",
+]
+
+
+def join_key_compatible(a: T.DataType, b: T.DataType) -> bool:
+    """True when symbol-equality on these types may become a raw-bits
+    join/group key (executor compares unscaled device values).
+
+    Mixed-scale decimals store the same value as different ints, and
+    float32/float64 have different bit layouts — those must stay as
+    compiled comparisons, not hash-join criteria."""
+    if isinstance(a, T.DecimalType) or isinstance(b, T.DecimalType):
+        return (
+            isinstance(a, T.DecimalType)
+            and isinstance(b, T.DecimalType)
+            and a.scale == b.scale
+        )
+    if a.np_dtype.kind == "f" or b.np_dtype.kind == "f":
+        return a.np_dtype == b.np_dtype
+    return True
 
 
 @dataclass(frozen=True)

@@ -63,8 +63,9 @@ class Metadata:
             raise ValueError(
                 f"table {'.'.join(parts)!r} requires a session catalog/schema"
             )
+        connector = self.connector(cat)  # missing catalog reports itself
         try:
-            schema = self.connector(cat).table_schema(sch, tab)
+            schema = connector.table_schema(sch, tab)
         except KeyError:
             raise KeyError(f"table not found: {cat}.{sch}.{tab}") from None
         return QualifiedTable(cat, sch, tab), schema

@@ -1,0 +1,5 @@
+"""Distributed execution: device meshes and collective exchanges."""
+
+from trino_tpu.parallel.core import default_mesh, make_mesh
+
+__all__ = ["default_mesh", "make_mesh"]

@@ -1,0 +1,36 @@
+"""Device mesh construction.
+
+The analog of the reference's worker-set topology
+(MAIN/metadata/DiscoveryNodeManager.java + NodePartitioningManager,
+MAIN/sql/planner/NodePartitioningManager.java:59): instead of
+discovered HTTP workers, the "cluster" is a jax.sharding.Mesh over the
+slice's chips; the partition count is the mesh size and partition->
+node mapping is the mesh axis order.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "default_mesh", "WORKER_AXIS"]
+
+#: the canonical 1-D data-partitioning axis (FIXED_HASH_DISTRIBUTION's
+#: partition dimension)
+WORKER_AXIS = "workers"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = WORKER_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def default_mesh() -> Mesh:
+    return make_mesh(None)

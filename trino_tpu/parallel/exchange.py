@@ -1,0 +1,82 @@
+"""Hash exchange over the device mesh.
+
+The TPU-native replacement for the reference's shuffle subsystem
+(PartitionedOutputOperator -> OutputBuffer -> HTTP long-poll ->
+DirectExchangeClient, SURVEY.md §3.4): rows are routed to their owning
+device with one ``lax.all_to_all`` over ICI instead of serialize +
+HTTP + deserialize. No serde exists at all — device arrays stay device
+arrays.
+
+Shapes are static: each shard scatters its rows into ``n`` fixed-size
+buckets (one per destination device) and the all_to_all swaps bucket i
+of shard j with bucket j of shard i. Bucket overflow is detected and
+reported per shard (the analog of output-buffer backpressure; callers
+re-run with a bigger bucket or pre-aggregate harder).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["partition_exchange"]
+
+
+def partition_exchange(
+    dest: jnp.ndarray,
+    live: jnp.ndarray,
+    payload: dict[str, jnp.ndarray],
+    n_partitions: int,
+    bucket_capacity: int,
+    axis: str,
+):
+    """Route rows to devices by ``dest`` with one all_to_all.
+
+    Must be called inside shard_map over ``axis``. ``dest[i]`` in
+    [0, n_partitions) is row i's owning device; dead rows are dropped.
+
+    Returns (received payload dict of [n_partitions * bucket_capacity]
+    arrays, received live mask, overflowed: scalar bool — True when a
+    bucket was too small and rows were dropped).
+
+    The scatter is the PagePartitioner analog
+    (MAIN/operator/output/PagePartitioner.java:134): a rank-per-
+    destination prefix sum replaces the per-row appender loop.
+    """
+    n = dest.shape[0]
+    # position of each row within its destination bucket: prefix count
+    # of same-destination rows (one-hot cumsum, vectorized appender)
+    one_hot = (
+        (dest[:, None] == jnp.arange(n_partitions)[None, :]) & live[:, None]
+    )
+    rank = jnp.cumsum(one_hot.astype(jnp.int32), axis=0) - one_hot.astype(
+        jnp.int32
+    )
+    pos = jnp.take_along_axis(rank, jnp.clip(dest, 0, n_partitions - 1)[:, None], axis=1)[:, 0]
+    counts = jnp.sum(one_hot, axis=0)
+    overflowed = jnp.any(counts > bucket_capacity)
+
+    in_range = live & (pos < bucket_capacity)
+    flat_idx = jnp.where(
+        in_range, dest * bucket_capacity + pos, n_partitions * bucket_capacity
+    )
+
+    out = {}
+    for name, arr in payload.items():
+        buckets = jnp.zeros(
+            (n_partitions * bucket_capacity,), dtype=arr.dtype
+        ).at[flat_idx].set(arr, mode="drop")
+        buckets = buckets.reshape(n_partitions, bucket_capacity)
+        # swap bucket p of this shard with bucket <this> of shard p
+        received = jax.lax.all_to_all(
+            buckets, axis, split_axis=0, concat_axis=0, tiled=False
+        )
+        out[name] = received.reshape(-1)
+    sent_live = jnp.zeros(
+        (n_partitions * bucket_capacity,), dtype=jnp.bool_
+    ).at[flat_idx].set(True, mode="drop")
+    sent_live = sent_live.reshape(n_partitions, bucket_capacity)
+    recv_live = jax.lax.all_to_all(
+        sent_live, axis, split_axis=0, concat_axis=0, tiled=False
+    ).reshape(-1)
+    return out, recv_live, overflowed

@@ -104,6 +104,14 @@ class SemiJoin(PlanNode):
     #: (source symbol, filter-source symbol) equi pairs
     keys: list[tuple[str, str]] = field(default_factory=list)
     match_symbol: str = ""
+    #: residual predicate over (source row, filter-source row) pairs —
+    #: correlated non-equi conjuncts from EXISTS subqueries (the
+    #: reference plans these as correlated-join filters)
+    filter: RowExpression | None = None
+    #: True for IN-subquery semantics (3-valued NULL handling); False
+    #: for EXISTS, which is always TRUE/FALSE (reference distinguishes
+    #: these via SemiJoinNode vs CorrelatedJoin rewrites)
+    null_aware: bool = False
 
     @property
     def sources(self):

@@ -1,0 +1,555 @@
+"""Logical plan optimizer.
+
+The analog of the reference's optimizer pipeline
+(MAIN/sql/planner/PlanOptimizers.java:355-530), reduced to the passes
+that matter for a batch-synchronous TPU engine:
+
+- ``extract_joins``: rewrites Filter-over-cross-join chains (comma
+  syntax) into equi-join trees, greedily connecting relations so no
+  cross product remains (PredicatePushDown + join-graph planning; the
+  reference's ReorderJoins CBO is approximated by smallest-first
+  greedy growth using connector row counts).
+- ``push_predicates``: moves single-side conjuncts below joins and
+  through projects down to the scans (PredicatePushDown,
+  PushPredicateIntoTableScan).
+- ``prune_columns``: removes unused symbols so table scans only read
+  referenced columns (PruneUnreferencedOutputs / applyProjection).
+- ``choose_build_side``: flips inner joins so the estimated-smaller
+  input is the build side (DetermineJoinDistributionType's
+  size-based flip, sans exchange costing).
+
+Each pass is a pure tree rewrite; the pipeline runs them in a fixed
+order (the reference's IterativeOptimizer fixpoint machinery is not
+needed at this scale).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+
+from trino_tpu import types as T
+from trino_tpu.expr.ir import (
+    Call,
+    Cast,
+    InputRef,
+    Literal,
+    RowExpression,
+    join_key_compatible,
+)
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.plan import nodes as P
+
+__all__ = ["optimize"]
+
+
+def optimize(plan: P.PlanNode, metadata: Metadata, session: Session) -> P.PlanNode:
+    plan = _rewrite_bottom_up(plan, _merge_adjacent_filters)
+    plan = _rewrite_bottom_up(plan, _extract_joins)
+    plan = _push_predicates(plan, metadata)
+    plan = _choose_build_sides(plan, metadata)
+    plan = _prune_columns(plan)
+    return plan
+
+
+def _merge_adjacent_filters(node: P.PlanNode) -> P.PlanNode:
+    """Collapse Filter(Filter(x)) chains (the analyzer emits one Filter
+    per WHERE conjunct) so join extraction sees every conjunct at once."""
+    if not isinstance(node, P.Filter):
+        return node
+    preds = _conjuncts(node.predicate)
+    src = node.source
+    while isinstance(src, P.Filter):
+        preds = _conjuncts(src.predicate) + preds
+        src = src.source
+    if src is node.source:
+        return node
+    return P.Filter(dict(node.outputs), source=src, predicate=_and_all(preds))
+
+
+# ---- generic walking -------------------------------------------------------
+
+def _replace_sources(node: P.PlanNode, new_sources: list[P.PlanNode]) -> P.PlanNode:
+    if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
+                         P.Limit, P.Output, P.Exchange)):
+        return dc_replace(node, source=new_sources[0])
+    if isinstance(node, P.Join):
+        return dc_replace(node, left=new_sources[0], right=new_sources[1])
+    if isinstance(node, P.SemiJoin):
+        return dc_replace(
+            node, source=new_sources[0], filter_source=new_sources[1]
+        )
+    return node
+
+
+def _rewrite_bottom_up(node: P.PlanNode, fn) -> P.PlanNode:
+    srcs = node.sources
+    if srcs:
+        node = _replace_sources(
+            node, [_rewrite_bottom_up(s, fn) for s in srcs]
+        )
+    return fn(node)
+
+
+def _conjuncts(e: RowExpression) -> list[RowExpression]:
+    if isinstance(e, Call) and e.name == "and":
+        out = []
+        for a in e.args:
+            out.extend(_conjuncts(a))
+        return out
+    return [e]
+
+
+def _disjuncts(e: RowExpression) -> list[RowExpression]:
+    if isinstance(e, Call) and e.name == "or":
+        out = []
+        for a in e.args:
+            out.extend(_disjuncts(a))
+        return out
+    return [e]
+
+
+def _and_all(parts: list[RowExpression]) -> RowExpression | None:
+    if not parts:
+        return None
+    if len(parts) == 1:
+        return parts[0]
+    return Call(T.BOOLEAN, "and", tuple(parts))
+
+
+def _refs(e: RowExpression) -> set[str]:
+    if isinstance(e, InputRef):
+        return {e.name}
+    out: set[str] = set()
+    if isinstance(e, Call):
+        for a in e.args:
+            out |= _refs(a)
+    elif isinstance(e, Cast):
+        out |= _refs(e.arg)
+    return out
+
+
+# ---- join extraction -------------------------------------------------------
+
+def _flatten_cross(node: P.PlanNode) -> list[P.PlanNode] | None:
+    """Flatten a pure cross-join tree into its relation list."""
+    if isinstance(node, P.Join) and node.kind == "cross" and not node.criteria:
+        out = []
+        for s in (node.left, node.right):
+            sub = _flatten_cross(s)
+            out.extend(sub if sub is not None else [s])
+        return out
+    return None
+
+
+def _extract_joins(node: P.PlanNode) -> P.PlanNode:
+    """Filter(cross-join chain) -> connected equi-join tree."""
+    if not isinstance(node, P.Filter):
+        return node
+    rels = _flatten_cross(node.source)
+    if rels is None or len(rels) < 2:
+        return node
+    conjuncts = _hoist_or_common(_conjuncts(node.predicate))
+    rel_syms = [set(r.outputs) for r in rels]
+
+    def owner_of(refs: set[str]) -> list[int]:
+        return [i for i, syms in enumerate(rel_syms) if refs & syms]
+
+    # single-relation conjuncts stay as filters on that relation
+    local: dict[int, list[RowExpression]] = {}
+    equi: list[tuple[RowExpression, int, int, str, str]] = []
+    residual: list[RowExpression] = []
+    for c in conjuncts:
+        refs = _refs(c)
+        owners = owner_of(refs)
+        if len(owners) == 1:
+            local.setdefault(owners[0], []).append(c)
+            continue
+        pair = _equi_form(c, rel_syms)
+        if pair is not None:
+            i, j, ls, rs = pair
+            equi.append((c, i, j, ls, rs))
+        else:
+            residual.append(c)
+
+    parts: list[P.PlanNode | None] = list(rels)
+    for i, preds in local.items():
+        src = parts[i]
+        parts[i] = P.Filter(
+            dict(src.outputs), source=src, predicate=_and_all(preds)
+        )
+
+    # greedy connected join-tree growth: start from the largest
+    # relation's component? No — start anywhere, always join in a
+    # relation connected by at least one equi edge
+    remaining = set(range(len(rels)))
+    placed = {min(remaining)}
+    remaining -= placed
+    tree = parts[min(placed)]
+    used_edges: set[int] = set()
+    while remaining:
+        progress = False
+        for k, (c, i, j, ls, rs) in enumerate(equi):
+            if k in used_edges:
+                continue
+            if (i in placed) == (j in placed):
+                continue
+            new = i if i in remaining else j
+            # gather every unused equi edge between the tree and `new`
+            criteria = []
+            for k2, (c2, i2, j2, ls2, rs2) in enumerate(equi):
+                if k2 in used_edges:
+                    continue
+                if {i2, j2} <= (placed | {new}) and new in (i2, j2):
+                    crit = (ls2, rs2) if j2 == new else (rs2, ls2)
+                    criteria.append(crit)
+                    used_edges.add(k2)
+            right = parts[new]
+            tree = P.Join(
+                {**tree.outputs, **right.outputs},
+                kind="inner", left=tree, right=right, criteria=criteria,
+            )
+            placed.add(new)
+            remaining.remove(new)
+            progress = True
+            break
+        if not progress:
+            # disconnected component: true cross join
+            new = min(remaining)
+            right = parts[new]
+            tree = P.Join(
+                {**tree.outputs, **right.outputs},
+                kind="cross", left=tree, right=right,
+            )
+            placed.add(new)
+            remaining.remove(new)
+    # equi edges whose endpoints landed in the same component earlier
+    # than expected become residual comparisons
+    for k, (c, *_rest) in enumerate(equi):
+        if k not in used_edges:
+            residual.append(c)
+    if residual:
+        tree = P.Filter(
+            dict(tree.outputs), source=tree, predicate=_and_all(residual)
+        )
+    if set(tree.outputs) != set(node.outputs):
+        tree = P.Project(
+            dict(node.outputs),
+            source=tree,
+            assignments={
+                s: InputRef(t, s) for s, t in node.outputs.items()
+            },
+        )
+    return tree
+
+
+def _hoist_or_common(conjuncts: list[RowExpression]) -> list[RowExpression]:
+    """Factor conjuncts common to every OR branch up to the top level:
+    (A and X) or (A and Y)  ==>  A and ((A and X) or (A and Y)).
+
+    TPC-H q19 repeats its p_partkey = l_partkey equality inside each OR
+    branch; without hoisting, join extraction sees no top-level equi
+    edge and falls back to a cross product (the reference normalizes
+    predicates the same way in PredicatePushDown's extractCommon)."""
+    out = list(conjuncts)
+    for c in conjuncts:
+        if not (isinstance(c, Call) and c.name == "or"):
+            continue
+        branch_sets = [
+            {repr(x): x for x in _conjuncts(b)} for b in _disjuncts(c)
+        ]
+        common = set(branch_sets[0])
+        for bs in branch_sets[1:]:
+            common &= set(bs)
+        seen = {repr(x) for x in out}
+        for key in common:
+            if key not in seen:
+                out.append(branch_sets[0][key])
+    return out
+
+
+def _equi_form(c: RowExpression, rel_syms: list[set[str]]):
+    """symbol = symbol across two different relations."""
+    if not (isinstance(c, Call) and c.name == "eq"):
+        return None
+    a, b = c.args
+    if not (isinstance(a, InputRef) and isinstance(b, InputRef)):
+        return None
+    if not join_key_compatible(a.type, b.type):
+        return None
+    ia = [i for i, syms in enumerate(rel_syms) if a.name in syms]
+    ib = [i for i, syms in enumerate(rel_syms) if b.name in syms]
+    if len(ia) != 1 or len(ib) != 1 or ia[0] == ib[0]:
+        return None
+    return ia[0], ib[0], a.name, b.name
+
+
+# ---- predicate pushdown ----------------------------------------------------
+
+def _push_predicates(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    return _push_node(node, [], metadata)
+
+
+def _push_node(
+    node: P.PlanNode, preds: list[RowExpression], metadata: Metadata
+) -> P.PlanNode:
+    """Push the given conjuncts (over node's outputs) below node when
+    possible; re-attach the rest above."""
+    if isinstance(node, P.Filter):
+        return _push_node(
+            node.source, preds + _conjuncts(node.predicate), metadata
+        )
+    if isinstance(node, P.Project):
+        # push through when the conjunct only references pass-through
+        # (identity) assignments
+        identity = {
+            s: e.name for s, e in node.assignments.items()
+            if isinstance(e, InputRef)
+        }
+        pushable, kept = [], []
+        for c in preds:
+            refs = _refs(c)
+            if refs <= set(identity):
+                pushable.append(_rename(c, identity))
+            else:
+                kept.append(c)
+        src = _push_node(node.source, pushable, metadata)
+        out: P.PlanNode = dc_replace(node, source=src)
+        return _attach(out, kept)
+    if isinstance(node, P.Join):
+        left_syms = set(node.left.outputs)
+        right_syms = set(node.right.outputs)
+        lp, rp, kept = [], [], []
+        new_criteria = list(node.criteria)
+        kind = node.kind
+        for c in preds:
+            refs = _refs(c)
+            if refs <= left_syms and node.kind in ("inner", "left", "cross"):
+                # left is the null-producing side of right/full joins;
+                # pushing there would resurrect rows the filter drops
+                lp.append(c)
+            elif refs <= right_syms and node.kind in ("inner", "cross"):
+                # right is the null-producing side of a left join: a
+                # predicate there belongs above (it would drop the
+                # null-extended rows if pushed)
+                rp.append(c)
+            elif node.kind in ("inner", "cross"):
+                # equi predicate across the two sides joins them
+                pair = _equi_form(c, [left_syms, right_syms])
+                if pair is not None:
+                    _, _, ls, rs = pair
+                    new_criteria.append((ls, rs))
+                    kind = "inner"
+                else:
+                    kept.append(c)
+            else:
+                kept.append(c)
+        left = _push_node(node.left, lp, metadata)
+        right = _push_node(node.right, rp, metadata)
+        out = dc_replace(
+            node, left=left, right=right, criteria=new_criteria, kind=kind
+        )
+        return _attach(out, kept)
+    if isinstance(node, P.SemiJoin):
+        src_syms = set(node.source.outputs)
+        sp, kept = [], []
+        for c in preds:
+            if _refs(c) <= src_syms:
+                sp.append(c)
+            else:
+                kept.append(c)
+        src = _push_node(node.source, sp, metadata)
+        filt = _push_node(node.filter_source, [], metadata)
+        out = dc_replace(node, source=src, filter_source=filt)
+        return _attach(out, kept)
+    if isinstance(node, (P.Limit, P.Sort, P.TopN)):
+        # filters do not commute with LIMIT; they do with SORT but
+        # nothing generates that shape today — recurse without pushing
+        src = _push_node(node.sources[0], [], metadata)
+        return _attach(_replace_sources(node, [src]), preds)
+    if isinstance(node, P.Aggregate):
+        # conjuncts over group keys commute with the aggregation
+        keys = set(node.group_keys)
+        pushable = [c for c in preds if _refs(c) <= keys]
+        kept = [c for c in preds if not (_refs(c) <= keys)]
+        src = _push_node(node.source, pushable, metadata)
+        return _attach(dc_replace(node, source=src), kept)
+    if isinstance(node, (P.Output, P.Exchange)):
+        src = _push_node(node.sources[0], preds, metadata)
+        return _replace_sources(node, [src])
+    # leaves (TableScan, Values) and anything unknown
+    srcs = node.sources
+    if srcs:
+        node = _replace_sources(
+            node, [_push_node(s, [], metadata) for s in srcs]
+        )
+    return _attach(node, preds)
+
+
+def _attach(node: P.PlanNode, preds: list[RowExpression]) -> P.PlanNode:
+    if not preds:
+        return node
+    return P.Filter(
+        dict(node.outputs), source=node, predicate=_and_all(preds)
+    )
+
+
+def _rename(e: RowExpression, mapping: dict[str, str]) -> RowExpression:
+    if isinstance(e, InputRef):
+        return InputRef(e.type, mapping.get(e.name, e.name))
+    if isinstance(e, Call):
+        return Call(e.type, e.name, tuple(_rename(a, mapping) for a in e.args))
+    if isinstance(e, Cast):
+        return Cast(e.type, _rename(e.arg, mapping))
+    return e
+
+
+# ---- build-side choice -----------------------------------------------------
+
+def _estimate_rows(node: P.PlanNode, metadata: Metadata) -> float:
+    """Crude cardinality estimate (the StatsCalculator stand-in)."""
+    if isinstance(node, P.TableScan):
+        try:
+            conn = metadata.connector(node.catalog)
+            return float(conn.row_count(node.schema, node.table))
+        except Exception:
+            return 1e6
+    if isinstance(node, P.Filter):
+        return 0.25 * _estimate_rows(node.source, metadata)
+    if isinstance(node, P.Aggregate):
+        base = _estimate_rows(node.source, metadata)
+        return base if not node.group_keys else max(base / 10.0, 1.0)
+    if isinstance(node, P.Join):
+        l = _estimate_rows(node.left, metadata)
+        r = _estimate_rows(node.right, metadata)
+        if node.kind == "cross":
+            return l * r
+        return max(l, r)
+    if isinstance(node, (P.Limit, P.TopN)):
+        n = getattr(node, "count", -1)
+        sub = _estimate_rows(node.sources[0], metadata)
+        return min(float(n), sub) if n >= 0 else sub
+    if node.sources:
+        return max(_estimate_rows(s, metadata) for s in node.sources)
+    return 1.0
+
+
+def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    def fn(n: P.PlanNode) -> P.PlanNode:
+        if isinstance(n, P.Join) and n.kind == "inner" and n.criteria:
+            l = _estimate_rows(n.left, metadata)
+            r = _estimate_rows(n.right, metadata)
+            if r > l * 1.5:  # build side (right) should be the smaller
+                return dc_replace(
+                    n, left=n.right, right=n.left,
+                    criteria=[(b, a) for a, b in n.criteria],
+                )
+        return n
+
+    return _rewrite_bottom_up(node, fn)
+
+
+# ---- column pruning --------------------------------------------------------
+
+def _prune_columns(node: P.PlanNode) -> P.PlanNode:
+    return _prune(node, None)
+
+
+def _prune(node: P.PlanNode, needed: set[str] | None) -> P.PlanNode:
+    """Rebuild the tree keeping only symbols in ``needed`` (None = all,
+    used at the root)."""
+    if isinstance(node, P.Output):
+        src = _prune(node.source, set(node.symbols))
+        return dc_replace(node, source=src)
+    if needed is None:
+        needed = set(node.outputs)
+
+    if isinstance(node, P.TableScan):
+        assignments = {
+            s: c for s, c in node.assignments.items() if s in needed
+        }
+        if not assignments:
+            # count(*)-style scans still need one column for row counts
+            s, c = next(iter(node.assignments.items()))
+            assignments = {s: c}
+        outputs = {s: t for s, t in node.outputs.items() if s in assignments}
+        return dc_replace(node, assignments=assignments, outputs=outputs)
+    if isinstance(node, P.Filter):
+        src_needed = needed | _refs(node.predicate)
+        src = _prune(node.source, src_needed)
+        return dc_replace(
+            node, source=src,
+            outputs={s: t for s, t in src.outputs.items() if s in needed or s in node.outputs},
+        )
+    if isinstance(node, P.Project):
+        assignments = {
+            s: e for s, e in node.assignments.items() if s in needed
+        }
+        src_needed = set()
+        for e in assignments.values():
+            src_needed |= _refs(e)
+        src = _prune(node.source, src_needed)
+        return P.Project(
+            {s: e.type for s, e in assignments.items()},
+            source=src, assignments=assignments,
+        )
+    if isinstance(node, P.Aggregate):
+        aggs = {s: a for s, a in node.aggregates.items() if s in needed}
+        src_needed = set(node.group_keys)
+        for a in aggs.values():
+            for arg in a.args:
+                src_needed |= _refs(arg)
+            if a.filter is not None:
+                src_needed |= _refs(a.filter)
+        src = _prune(node.source, src_needed)
+        outputs = {s: t for s, t in node.outputs.items()
+                   if s in needed or s in node.group_keys}
+        outputs.update({s: a.type for s, a in aggs.items()})
+        return dc_replace(node, source=src, aggregates=aggs, outputs=outputs)
+    if isinstance(node, P.Join):
+        src_needed = set(needed)
+        for a, b in node.criteria:
+            src_needed.add(a)
+            src_needed.add(b)
+        filter_refs: set[str] = set()
+        if node.filter is not None:
+            filter_refs = _refs(node.filter)
+            src_needed |= filter_refs
+        left = _prune(node.left, src_needed & set(node.left.outputs))
+        right = _prune(node.right, src_needed & set(node.right.outputs))
+        # the executor materializes exactly node.outputs for the joined
+        # page, so residual-filter columns must stay in it
+        outputs = {
+            s: t for s, t in node.outputs.items()
+            if s in needed or s in filter_refs
+        }
+        return dc_replace(node, left=left, right=right, outputs=outputs)
+    if isinstance(node, P.SemiJoin):
+        filter_refs = set() if node.filter is None else _refs(node.filter)
+        src_needed = (
+            needed | {a for a, _ in node.keys} | filter_refs
+        ) - {node.match_symbol}
+        filt_needed = {b for _, b in node.keys} | (
+            filter_refs & set(node.filter_source.outputs)
+        )
+        src = _prune(node.source, src_needed & set(node.source.outputs))
+        filt = _prune(node.filter_source, filt_needed)
+        outputs = {s: t for s, t in node.outputs.items() if s in needed}
+        return dc_replace(node, source=src, filter_source=filt, outputs=outputs)
+    if isinstance(node, (P.Sort, P.TopN)):
+        src_needed = needed | {k.symbol for k in node.keys}
+        src = _prune(node.sources[0], src_needed)
+        return _replace_sources(
+            dc_replace(node, outputs={
+                s: t for s, t in src.outputs.items()
+                if s in needed or s in src_needed
+            }),
+            [src],
+        )
+    if isinstance(node, (P.Limit, P.Exchange)):
+        src = _prune(node.sources[0], needed)
+        return _replace_sources(
+            dc_replace(node, outputs=dict(src.outputs)), [src]
+        )
+    if isinstance(node, P.Values):
+        return node
+    return node

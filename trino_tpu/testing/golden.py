@@ -20,7 +20,7 @@ from trino_tpu import types as T
 from trino_tpu.connectors.tpch.generator import SCHEMAS, TpchData
 from trino_tpu.types import format_date
 
-__all__ = ["load_tpch_sqlite", "assert_rows_match"]
+__all__ = ["load_tpch_sqlite", "assert_rows_match", "to_sqlite"]
 
 
 def load_tpch_sqlite(data: TpchData, tables: list[str] | None = None) -> sqlite3.Connection:
@@ -61,7 +61,51 @@ def load_tpch_sqlite(data: TpchData, tables: list[str] | None = None) -> sqlite3
     return conn
 
 
-def _close(a, b, rel=1e-6) -> bool:
+def to_sqlite(sql: str) -> str:
+    """Translate engine SQL to the sqlite dialect: date literals become
+    text, constant date +- interval arithmetic is folded, EXTRACT
+    becomes strftime (the H2QueryRunner dialect-bridge analog)."""
+    import datetime
+    import re
+
+    out = re.sub(r"\bdate\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", sql, flags=re.I)
+
+    def fold(m):
+        d = datetime.date.fromisoformat(m.group(1))
+        n = int(m.group(3)) * (1 if m.group(2) == "+" else -1)
+        unit = m.group(4).lower()
+        if unit == "day":
+            d2 = d + datetime.timedelta(days=n)
+        else:
+            import calendar
+
+            months = n * (12 if unit == "year" else 1)
+            t = d.year * 12 + (d.month - 1) + months
+            y, mo = divmod(t, 12)
+            last = calendar.monthrange(y, mo + 1)[1]
+            d2 = datetime.date(y, mo + 1, min(d.day, last))
+        return f"'{d2.isoformat()}'"
+
+    prev = None
+    while prev != out:
+        prev = out
+        out = re.sub(
+            r"'(\d{4}-\d{2}-\d{2})'\s*([+-])\s*interval\s+'(\d+)'\s+"
+            r"(day|month|year)s?",
+            fold, out, flags=re.I,
+        )
+    out = re.sub(
+        r"\bextract\s*\(\s*year\s+from\s+([a-z_0-9.]+)\s*\)",
+        r"CAST(strftime('%Y', \1) AS INTEGER)", out, flags=re.I,
+    )
+    out = re.sub(
+        r"\bextract\s*\(\s*month\s+from\s+([a-z_0-9.]+)\s*\)",
+        r"CAST(strftime('%m', \1) AS INTEGER)", out, flags=re.I,
+    )
+    return out
+
+
+def _close(a, b, rel=1e-6, abs_tol=1e-9) -> bool:
     if a is None or b is None:
         return a is None and b is None
     if isinstance(a, Decimal):
@@ -71,27 +115,52 @@ def _close(a, b, rel=1e-6) -> bool:
     if isinstance(a, float) or isinstance(b, float):
         if isinstance(a, str) or isinstance(b, str):
             return False
-        return math.isclose(float(a), float(b), rel_tol=rel, abs_tol=1e-9)
+        return math.isclose(float(a), float(b), rel_tol=rel, abs_tol=abs_tol)
     return a == b
 
 
-def assert_rows_match(actual: list[tuple], expected: list[tuple], ordered: bool = False):
+def assert_rows_match(
+    actual: list[tuple],
+    expected: list[tuple],
+    ordered: bool = False,
+    abs_tol: float = 1e-9,
+):
     assert len(actual) == len(expected), (
         f"row count mismatch: got {len(actual)}, want {len(expected)}\n"
         f"got:  {actual[:5]}\nwant: {expected[:5]}"
     )
+    def rows_equal(ra, re_):
+        return len(ra) == len(re_) and all(
+            _close(va, ve, abs_tol=abs_tol) for va, ve in zip(ra, re_)
+        )
+
     if not ordered:
         def keyfn(r):
-            # quantize floats so tolerance-equal rows sort identically
+            # quantize floats so tolerance-equal rows sort nearby
             return tuple(
                 f"{float(x):.4e}" if isinstance(x, (float, Decimal)) else str(x)
                 for x in r
             )
         actual = sorted(actual, key=keyfn)
-        expected = sorted(expected, key=keyfn)
+        expected = list(sorted(expected, key=keyfn))
+        # tolerance-equal floats can quantize to different sort keys;
+        # allow matches within a small window instead of exact position
+        window = 8
+        for i, ra in enumerate(actual):
+            hit = None
+            for j in range(max(0, i - window), min(len(expected), i + window + 1)):
+                if expected[j] is not None and rows_equal(ra, expected[j]):
+                    hit = j
+                    break
+            assert hit is not None, (
+                f"row {i} has no tolerance-equal counterpart\n"
+                f"got:  {ra}\nnear: {[e for e in expected[max(0, i-2):i+3] if e is not None]}"
+            )
+            expected[hit] = None
+        return
     for i, (ra, re_) in enumerate(zip(actual, expected)):
         assert len(ra) == len(re_), f"row {i} arity: {ra} vs {re_}"
         for j, (va, ve) in enumerate(zip(ra, re_)):
-            assert _close(va, ve), (
+            assert _close(va, ve, abs_tol=abs_tol), (
                 f"row {i} col {j}: {va!r} != {ve!r}\ngot:  {ra}\nwant: {re_}"
             )
