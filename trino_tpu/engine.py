@@ -38,6 +38,9 @@ class QueryRunner:
     def __init__(self, metadata: Metadata | None = None, session: Session | None = None):
         self.metadata = metadata or Metadata()
         self.session = session or Session()
+        # one executor across queries: keeps the jit-program cache and
+        # device-resident scanned tables warm (a Trino worker's lifetime)
+        self.executor = LocalExecutor(self.metadata, self.session)
 
     @staticmethod
     def tpch(schema: str = "tiny") -> "QueryRunner":
@@ -57,8 +60,7 @@ class QueryRunner:
 
     def execute_page(self, sql: str) -> tuple[P.PlanNode, Page]:
         plan = self.plan_sql(sql)
-        executor = LocalExecutor(self.metadata, self.session)
-        return plan, executor.execute(plan)
+        return plan, self.executor.execute(plan)
 
     def execute(self, sql: str) -> QueryResult:
         plan, page = self.execute_page(sql)

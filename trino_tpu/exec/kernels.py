@@ -105,8 +105,10 @@ def assign_groups(
     2x the live rows).
 
     Returns (group, owner): ``group[i]`` = slot of row i (== capacity
-    for dead rows, usable as a drop segment), ``owner[s]`` = row index
-    owning slot s (== n when the slot is empty).
+    for dead rows AND for unresolved rows when the table overflowed —
+    callers detect ``live & (group == capacity)`` and retry with a
+    larger capacity, the FlatHash rehash analog), ``owner[s]`` = row
+    index owning slot s (== n when the slot is empty).
     """
     n = live.shape[0]
     row_idx = jnp.arange(n, dtype=jnp.int32)
@@ -122,8 +124,9 @@ def assign_groups(
     resolved0 = ~live
 
     def cond(state):
-        _, resolved, _, _ = state
-        return jnp.any(~resolved)
+        probe, resolved, _, _ = state
+        # bounded probing: a full sweep without resolution = overflow
+        return jnp.any(~resolved) & (probe.max() < capacity)
 
     def body(state):
         probe, resolved, group, owner = state

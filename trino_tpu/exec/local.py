@@ -12,8 +12,9 @@ reference's build-side barriers.
 
 from __future__ import annotations
 
-import numpy as np
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
@@ -28,17 +29,121 @@ __all__ = ["LocalExecutor"]
 
 
 class LocalExecutor:
-    """Executes a logical plan tree on the local devices."""
+    """Executes a logical plan tree on the local devices.
+
+    Per-node device computations are traced into jitted programs cached
+    by (plan structure, input layout, capacity) — the analog of the
+    reference's per-query bytecode generation with
+    PageFunctionCompiler's cache (MAIN/sql/gen/PageFunctionCompiler.java:102).
+    Scanned tables are cached device-resident (a worker's memory
+    connector analog), so repeated queries pay no host->HBM transfer.
+    """
 
     def __init__(self, metadata: Metadata, session: Session):
         self.metadata = metadata
         self.session = session
+        #: structural key -> (jitted fn, host metadata)
+        self._jit_cache: dict = {}
+        #: (catalog, schema, table) -> {column name: Column}; "" -> mask
+        self._scan_cache: dict = {}
 
     def execute(self, node: P.PlanNode) -> Page:
+        if isinstance(node, stage.FUSABLE):
+            chain: list[P.PlanNode] = []
+            cur = node
+            while isinstance(cur, stage.FUSABLE):
+                chain.append(cur)
+                cur = cur.sources[0]
+            base = self.execute(cur)
+            return self._run_chain(list(reversed(chain)), base)
         m = getattr(self, f"_{type(node).__name__}", None)
         if m is None:
             raise NotImplementedError(f"no executor for {type(node).__name__}")
         return m(node)
+
+    # ---- fused pipelines -------------------------------------------------
+
+    @staticmethod
+    def _node_key(n: P.PlanNode):
+        if isinstance(n, P.Filter):
+            return ("F", repr(n.predicate))
+        if isinstance(n, P.Project):
+            return ("P", tuple((s, repr(e)) for s, e in n.assignments.items()))
+        if isinstance(n, P.Aggregate):
+            return (
+                "A", tuple(n.group_keys),
+                tuple(
+                    (s, a.name, a.distinct, repr(a.args), repr(a.filter))
+                    for s, a in n.aggregates.items()
+                ),
+                n.step,
+            )
+        if isinstance(n, (P.Sort, P.TopN)):
+            return (
+                "S",
+                tuple((k.symbol, k.ascending, k.nulls_first) for k in n.keys),
+                getattr(n, "count", None),
+            )
+        if isinstance(n, P.Limit):
+            return ("L", n.count, n.offset)
+        if isinstance(n, P.Exchange):
+            return ("E",)
+        raise NotImplementedError(type(n).__name__)
+
+    def _run_chain(self, chain: list[P.PlanNode], page: Page) -> Page:
+        """Run a fused operator chain: one jitted program, one dispatch.
+
+        Grouped aggregations retry with 8x larger slot tables when the
+        returned overflow flag trips (rare: only when the group count
+        exceeds capacity/2 of the initial guess)."""
+        caps = stage.plan_capacities(chain, page.capacity)
+        while True:
+            key = (
+                "chain",
+                tuple(self._node_key(n) for n in chain),
+                tuple((i, c[0]) for i, c in sorted(caps.items())),
+                self._layout_sig(page),
+            )
+            hit = self._jit_cache.get(key)
+            if hit is None:
+                in_layout = stage.ChainLayout(
+                    names=list(page.names),
+                    types={
+                        n: c.type for n, c in zip(page.names, page.columns)
+                    },
+                    dicts={
+                        n: c.dictionary
+                        for n, c in zip(page.names, page.columns)
+                    },
+                    capacity=page.capacity,
+                )
+                fn, out_layout = stage.build_chain(chain, in_layout, caps)
+                hit = (jax.jit(fn), out_layout)
+                self._jit_cache[key] = hit
+            fn, out_layout = hit
+            env, mask, flags = fn(self._env(page), page.mask)
+            if flags:
+                vals = jax.device_get(flags)
+                overflowed = [i for i, v in vals.items() if v]
+                if overflowed:
+                    for i in overflowed:
+                        cap, mx = caps[i]
+                        if cap >= mx:
+                            raise RuntimeError(
+                                "aggregation table overflow at max capacity"
+                            )
+                        caps[i][0] = min(cap * 8, mx)
+                    continue
+            cols = [
+                Column(
+                    out_layout.types[s],
+                    env[s][0],
+                    env[s][1],
+                    out_layout.dicts.get(s),
+                )
+                for s in out_layout.names
+            ]
+            return Page(list(out_layout.names), cols, mask)
 
     # ---- expression evaluation ------------------------------------------
 
@@ -50,17 +155,21 @@ class LocalExecutor:
             },
         )
 
-    def _eval(self, page: Page, expr: RowExpression):
-        """Evaluate an expression over a page.
+    def _layout_sig(self, page: Page) -> tuple:
+        return tuple(
+            (n, repr(c.type), id(c.dictionary), c.valid is not None)
+            for n, c in zip(page.names, page.columns)
+        ) + (page.capacity,)
 
-        Returns (data, valid, dictionary) with data broadcast to the
-        page capacity.
-        """
+    def _env(self, page: Page) -> dict:
+        return {n: (c.data, c.valid) for n, c in zip(page.names, page.columns)}
+
+    def _eval(self, page: Page, expr: RowExpression):
+        """Evaluate one expression over a page (eager path for join
+        residuals etc.). Returns (data, valid, dictionary), data
+        broadcast to the page capacity."""
         compiled = compile_expr(expr, self._layout(page))
-        env = {
-            n: (c.data, c.valid) for n, c in zip(page.names, page.columns)
-        }
-        data, valid = compiled.fn(env)
+        data, valid = compiled.fn(self._env(page))
         cap = page.capacity
         if jnp.ndim(data) == 0:
             data = jnp.broadcast_to(data, (cap,))
@@ -71,15 +180,26 @@ class LocalExecutor:
     # ---- leaf nodes ------------------------------------------------------
 
     def _TableScan(self, node: P.TableScan) -> Page:
-        connector = self.metadata.connector(node.catalog)
-        cols = connector.scan(
-            node.schema, node.table, list(node.assignments.values())
-        )
-        named = {
-            sym: (node.outputs[sym], cols[col])
-            for sym, col in node.assignments.items()
-        }
-        return Page.from_arrays(named)
+        key = (node.catalog, node.schema, node.table)
+        cache = self._scan_cache.setdefault(key, {})
+        missing = [c for c in node.assignments.values() if c not in cache]
+        if missing or "" not in cache:
+            connector = self.metadata.connector(node.catalog)
+            cols = connector.scan(node.schema, node.table, missing)
+            n = connector.row_count(node.schema, node.table)
+            cap = pad_capacity(n)
+            if "" not in cache:
+                mask = np.zeros(cap, dtype=np.bool_)
+                mask[:n] = True
+                cache[""] = jnp.asarray(mask)
+            by_col = {c: s for s, c in node.assignments.items()}
+            for cname in missing:
+                cache[cname] = Column.from_numpy(
+                    node.outputs[by_col[cname]], cols[cname], capacity=cap
+                )
+        names = list(node.assignments)
+        columns = [cache[c] for c in node.assignments.values()]
+        return Page(names, columns, cache[""])
 
     def _Values(self, node: P.Values) -> Page:
         # only the zero-column single-row form (SELECT without FROM)
@@ -91,51 +211,10 @@ class LocalExecutor:
 
     # ---- row-level nodes -------------------------------------------------
 
-    def _Filter(self, node: P.Filter) -> Page:
-        page = self.execute(node.source)
-        data, valid, _ = self._eval(page, node.predicate)
-        keep = data if valid is None else (data & valid)
-        return Page(page.names, page.columns, page.mask & keep)
-
-    def _Project(self, node: P.Project) -> Page:
-        page = self.execute(node.source)
-        names, cols = [], []
-        for sym, expr in node.assignments.items():
-            data, valid, dictionary = self._eval(page, expr)
-            names.append(sym)
-            cols.append(Column(expr.type, data, valid, dictionary))
-        return Page(names, cols, page.mask)
-
-    def _Limit(self, node: P.Limit) -> Page:
-        page = self.execute(node.source)
-        rank = jnp.cumsum(page.mask.astype(jnp.int64))
-        keep = page.mask & (rank > node.offset)
-        if node.count >= 0:
-            keep = keep & (rank <= node.offset + node.count)
-        return Page(page.names, page.columns, keep)
-
     def _Output(self, node: P.Output) -> Page:
         page = self.execute(node.source)
         cols = [page.column(s) for s in node.symbols]
         return Page(list(node.names), cols, page.mask)
-
-    def _Exchange(self, node: P.Exchange) -> Page:
-        # single-fragment local execution: exchanges are pass-through;
-        # the distributed executor lowers REMOTE ones to collectives
-        return self.execute(node.source)
-
-    # ---- sorting ---------------------------------------------------------
-
-    def _sort_keys(self, page: Page, keys: list[P.SortKey]):
-        out = []
-        for k in keys:
-            col = page.column(k.symbol)
-            nulls_first = k.nulls_first
-            if nulls_first is None:
-                # reference default: nulls are largest (ASC last, DESC first)
-                nulls_first = not k.ascending
-            out.append((col.data, col.valid, k.ascending, nulls_first))
-        return out
 
     def _apply_perm(self, page: Page, perm: jnp.ndarray, limit: int | None = None) -> Page:
         cols = []
@@ -151,35 +230,6 @@ class LocalExecutor:
             mask = mask[:limit]
         return Page(page.names, cols, mask)
 
-    def _Sort(self, node: P.Sort) -> Page:
-        page = self.execute(node.source)
-        perm = K.sort_perm(self._sort_keys(page, node.keys), page.mask)
-        return self._apply_perm(page, perm)
-
-    def _TopN(self, node: P.TopN) -> Page:
-        page = self.execute(node.source)
-        perm = K.sort_perm(self._sort_keys(page, node.keys), page.mask)
-        out = self._apply_perm(page, perm, limit=None)
-        pos = jnp.arange(out.capacity)
-        mask = out.mask & (pos < node.count)
-        cap = pad_capacity(min(node.count, out.capacity))
-        return self._slice(Page(out.names, out.columns, mask), cap)
-
-    @staticmethod
-    def _slice(page: Page, capacity: int) -> Page:
-        if capacity >= page.capacity:
-            return page
-        cols = [
-            Column(
-                c.type,
-                c.data[:capacity],
-                None if c.valid is None else c.valid[:capacity],
-                c.dictionary,
-            )
-            for c in page.columns
-        ]
-        return Page(page.names, cols, page.mask[:capacity])
-
     def _compact(self, page: Page, extra_capacity: int = 0) -> Page:
         """Gather live rows to the front and shrink capacity
         (Page.compact analog, SPI/Page.java:180). Host-syncs the count."""
@@ -191,104 +241,6 @@ class LocalExecutor:
         return self._apply_perm(page, perm, limit=cap)
 
     # ---- aggregation -----------------------------------------------------
-
-    def _Aggregate(self, node: P.Aggregate) -> Page:
-        page = self.execute(node.source)
-        live = page.mask
-        if not node.group_keys:
-            return self._global_aggregate(node, page)
-
-        key_cols = [page.column(s) for s in node.group_keys]
-        n_live = page.num_rows()
-        capacity = pad_capacity(max(2 * n_live, 8))
-        norm = [K.normalize_key(c.data, c.valid) for c in key_cols]
-        group, owner = K.assign_groups(
-            tuple(b for b, _ in norm), tuple(f for _, f in norm), live, capacity
-        )
-        occupied = owner < page.capacity
-
-        names, cols = [], []
-        own_idx = jnp.clip(owner, 0, page.capacity - 1)
-        for sym, col in zip(node.group_keys, key_cols):
-            data = col.data[own_idx]
-            valid = None if col.valid is None else (col.valid[own_idx] & occupied)
-            names.append(sym)
-            cols.append(Column(col.type, data, valid, col.dictionary))
-
-        for sym, call in node.aggregates.items():
-            data, valid = self._run_agg(page, call, group, capacity, live, key_cols)
-            names.append(sym)
-            cols.append(
-                Column(
-                    call.type, data, _and_mask(valid, None),
-                    self._agg_dictionary(page, call),
-                )
-            )
-        out = Page(names, cols, occupied)
-        return self._compact(out)
-
-    def _global_aggregate(self, node: P.Aggregate, page: Page) -> Page:
-        # one output row, even over empty input (reference semantics)
-        live = page.mask
-        group = jnp.where(live, 0, 1).astype(jnp.int32)
-        names, cols = [], []
-        cap = 8
-        for sym, call in node.aggregates.items():
-            data, valid = self._run_agg(page, call, group, 1, live, [])
-            data = _pad_to(data, cap)
-            valid = None if valid is None else _pad_to(valid, cap)
-            names.append(sym)
-            cols.append(
-                Column(call.type, data, valid, self._agg_dictionary(page, call))
-            )
-        mask = np.zeros(cap, dtype=np.bool_)
-        mask[0] = True
-        return Page(names, cols, jnp.asarray(mask))
-
-    def _agg_dictionary(self, page: Page, call: AggCall):
-        if not isinstance(call.type, T.VarcharType):
-            return None
-        # min/max/any_value over varchar keep the argument's dictionary
-        compiled = compile_expr(call.args[0], self._layout(page))
-        return compiled.dictionary
-
-    def _run_agg(
-        self, page: Page, call: AggCall, group, capacity, live, key_cols
-    ):
-        arg = None
-        if call.args:
-            data, valid, _ = self._eval(page, call.args[0])
-            arg = (data, valid)
-        contrib_live = live
-        if call.filter is not None:
-            fd, fv, _ = self._eval(page, call.filter)
-            contrib_live = contrib_live & (fd if fv is None else (fd & fv))
-        g = group
-        if call.distinct:
-            g, contrib_live = self._dedupe(
-                key_cols, arg, group, contrib_live, page.capacity
-            )
-        # rows that don't contribute use the drop segment
-        g = jnp.where(contrib_live, g, capacity)
-        return compute_aggregate(
-            call.name, call.type, arg, g, capacity, contrib_live
-        )
-
-    def _dedupe(self, key_cols, arg, group, live, page_capacity):
-        """DISTINCT: keep one representative row per (group, value)."""
-        data, valid = arg
-        live_d = live if valid is None else (live & valid)
-        norm = [K.normalize_key(c.data, c.valid) for c in key_cols]
-        norm.append(K.normalize_key(data, valid))
-        cap2 = pad_capacity(max(2 * page_capacity, 8))
-        g2, owner2 = K.assign_groups(
-            tuple(b for b, _ in norm), tuple(f for _, f in norm), live_d, cap2
-        )
-        row_idx = jnp.arange(page_capacity, dtype=jnp.int32)
-        rep = live_d & (owner2[jnp.clip(g2, 0, cap2 - 1)] == row_idx)
-        return group, rep
-
-    # ---- joins -----------------------------------------------------------
 
     def _Join(self, node: P.Join) -> Page:
         left = self._compact(self.execute(node.left))
@@ -547,12 +499,3 @@ def _and_mask(a, b):
     if b is None:
         return a
     return a & b
-
-
-def _pad_to(arr: jnp.ndarray, capacity: int) -> jnp.ndarray:
-    n = arr.shape[0]
-    if n >= capacity:
-        return arr[:capacity]
-    return jnp.concatenate(
-        [arr, jnp.zeros((capacity - n,), dtype=arr.dtype)]
-    )

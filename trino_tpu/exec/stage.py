@@ -1,0 +1,293 @@
+"""Pipeline compiler: a chain of row-level operators becomes ONE
+jitted XLA program.
+
+The analog of the reference's compiled pipelines: Trino JIT-compiles
+query-specific operator internals per pipeline
+(ExpressionCompiler/PageFunctionCompiler, MAIN/sql/gen/) and runs them
+page-at-a-time through Driver's pull loop (MAIN/operator/Driver.java:367).
+On TPU the batch IS the table, so the whole chain
+Filter -> Project -> Aggregate -> Sort -> Limit fuses into a single
+XLA computation: one device dispatch, one result, no per-operator
+round trips (which dominate when the device link has latency).
+
+Chains break at joins/exchanges (data-dependent capacities need a host
+decision) — those are the stage boundaries, exactly where the
+reference splits pipelines.
+
+Aggregations inside a chain carry a static slot-table capacity; the
+program returns per-aggregate overflow flags and the caller re-builds
+with an 8x larger table when one trips (FlatHash rehash analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace as dc_replace
+
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+from trino_tpu.exec import kernels as K
+from trino_tpu.exec.aggregates import compute_aggregate
+from trino_tpu.expr.compiler import ColumnLayout, compile_expr
+from trino_tpu.page import StringDictionary, pad_capacity
+from trino_tpu.plan import nodes as P
+
+__all__ = ["FUSABLE", "ChainLayout", "plan_capacities", "build_chain"]
+
+#: node types that fuse into one program (single-source, static shapes)
+FUSABLE = (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN, P.Limit, P.Exchange)
+
+
+@dataclass
+class ChainLayout:
+    """Host-side metadata flowing through the chain builder."""
+
+    names: list[str]
+    types: dict[str, T.DataType]
+    dicts: dict[str, StringDictionary | None]
+    capacity: int
+
+    def expr_layout(self) -> ColumnLayout:
+        return ColumnLayout(types=dict(self.types), dictionaries=dict(self.dicts))
+
+
+def _bcast(data, valid, capacity):
+    if jnp.ndim(data) == 0:
+        data = jnp.broadcast_to(data, (capacity,))
+    if valid is not None and jnp.ndim(valid) == 0:
+        valid = jnp.broadcast_to(valid, (capacity,))
+    return data, valid
+
+
+def plan_capacities(chain: list[P.PlanNode], in_capacity: int) -> dict[int, list[int]]:
+    """Initial [capacity, max_capacity] per Aggregate position."""
+    caps: dict[int, list[int]] = {}
+    cap = in_capacity
+    for i, nd in enumerate(chain):
+        if isinstance(nd, P.Aggregate):
+            if not nd.group_keys:
+                caps[i] = [1, 1]
+                cap = 8
+            else:
+                max_cap = pad_capacity(max(2 * cap, 8))
+                start = min(pad_capacity(max(cap // 16, 1024)), max_cap)
+                caps[i] = [start, max_cap]
+                cap = start
+        elif isinstance(nd, P.TopN):
+            cap = pad_capacity(min(nd.count, cap))
+    return caps
+
+
+def build_chain(chain: list[P.PlanNode], layout: ChainLayout, caps: dict[int, list[int]]):
+    """Build (fn, out_layout): ``fn(env, mask) -> (env', mask', flags)``
+    is pure and jittable; ``flags`` maps chain position -> overflow
+    scalar for each grouped Aggregate."""
+    steps = []
+    for i, nd in enumerate(chain):
+        if isinstance(nd, P.Exchange):
+            continue
+        if isinstance(nd, P.Filter):
+            steps.append(_filter_step(nd, layout))
+        elif isinstance(nd, P.Project):
+            step, layout = _project_step(nd, layout)
+            steps.append(step)
+        elif isinstance(nd, P.Aggregate):
+            step, layout = _aggregate_step(nd, layout, caps[i][0], i)
+            steps.append(step)
+        elif isinstance(nd, (P.Sort, P.TopN)):
+            step, layout = _sort_step(nd, layout)
+            steps.append(step)
+        elif isinstance(nd, P.Limit):
+            steps.append(_limit_step(nd))
+        else:
+            raise NotImplementedError(type(nd).__name__)
+
+    def fn(env, mask):
+        flags = {}
+        for step in steps:
+            env, mask, flags = step(env, mask, flags)
+        return env, mask, flags
+
+    return fn, layout
+
+
+def _filter_step(nd: P.Filter, layout: ChainLayout):
+    compiled = compile_expr(nd.predicate, layout.expr_layout())
+
+    def step(env, mask, flags):
+        data, valid = compiled.fn(env)
+        keep = data if valid is None else (data & valid)
+        return env, mask & keep, flags
+
+    return step
+
+
+def _project_step(nd: P.Project, layout: ChainLayout):
+    compiled = {
+        sym: compile_expr(e, layout.expr_layout())
+        for sym, e in nd.assignments.items()
+    }
+    cap = layout.capacity
+    out_layout = ChainLayout(
+        names=list(nd.assignments),
+        types={s: e.type for s, e in nd.assignments.items()},
+        dicts={s: c.dictionary for s, c in compiled.items()},
+        capacity=cap,
+    )
+
+    def step(env, mask, flags):
+        env2 = {
+            sym: _bcast(*c.fn(env), cap) for sym, c in compiled.items()
+        }
+        return env2, mask, flags
+
+    return step, out_layout
+
+
+def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: int):
+    is_global = not nd.group_keys
+    expr_layout = layout.expr_layout()
+    agg_meta = []
+    for sym, call in nd.aggregates.items():
+        arg_c = compile_expr(call.args[0], expr_layout) if call.args else None
+        filter_c = (
+            compile_expr(call.filter, expr_layout)
+            if call.filter is not None else None
+        )
+        agg_meta.append((sym, call, arg_c, filter_c))
+    group_keys = list(nd.group_keys)
+    in_cap = layout.capacity
+    out_cap = 8 if is_global else capacity
+
+    out_layout = ChainLayout(
+        names=group_keys + [sym for sym, *_ in agg_meta],
+        types={
+            **{s: layout.types[s] for s in group_keys},
+            **{sym: call.type for sym, call, *_ in agg_meta},
+        },
+        dicts={
+            **{s: layout.dicts[s] for s in group_keys},
+            **{
+                sym: (arg_c.dictionary if isinstance(call.type, T.VarcharType) and arg_c else None)
+                for sym, call, arg_c, _ in agg_meta
+            },
+        },
+        capacity=out_cap,
+    )
+
+    def step(env, mask, flags):
+        if is_global:
+            group = jnp.where(mask, 0, 1).astype(jnp.int32)
+            owner = None
+        else:
+            norm = [K.normalize_key(*env[s]) for s in group_keys]
+            group, owner = K.assign_groups(
+                tuple(b for b, _ in norm),
+                tuple(fl for _, fl in norm),
+                mask, capacity,
+            )
+            flags = {**flags, pos: jnp.any(mask & (group == capacity))}
+        env2 = {}
+        if is_global:
+            out_mask = jnp.zeros((8,), dtype=jnp.bool_).at[0].set(True)
+        else:
+            occupied = owner < in_cap
+            own = jnp.clip(owner, 0, in_cap - 1)
+            for s in group_keys:
+                data, valid = env[s]
+                env2[s] = (
+                    data[own],
+                    None if valid is None else (valid[own] & occupied),
+                )
+            out_mask = occupied
+        cap_seg = 1 if is_global else capacity
+        for sym, call, arg_c, filter_c in agg_meta:
+            arg = None
+            contrib = mask
+            if arg_c is not None:
+                arg = _bcast(*arg_c.fn(env), in_cap)
+            if filter_c is not None:
+                fd, fv = filter_c.fn(env)
+                contrib = contrib & (fd if fv is None else (fd & fv))
+            g = group
+            if call.distinct:
+                g, contrib = _dedupe(
+                    [env[s] for s in group_keys], arg, group, contrib, in_cap
+                )
+            g = jnp.where(contrib, g, cap_seg)
+            data, valid = compute_aggregate(
+                call.name, call.type, arg, g, cap_seg, contrib
+            )
+            if is_global:
+                data = _pad_to(data, 8)
+                valid = None if valid is None else _pad_to(valid, 8)
+            env2[sym] = (data, valid)
+        return env2, out_mask, flags
+
+    return step, out_layout
+
+
+def _dedupe(key_cols, arg, group, live, page_capacity):
+    """DISTINCT: keep one representative row per (group, value)."""
+    data, valid = arg
+    live_d = live if valid is None else (live & valid)
+    norm = [K.normalize_key(d, v) for d, v in key_cols]
+    norm.append(K.normalize_key(data, valid))
+    cap2 = pad_capacity(max(2 * page_capacity, 8))
+    g2, owner2 = K.assign_groups(
+        tuple(b for b, _ in norm), tuple(fl for _, fl in norm), live_d, cap2
+    )
+    row_idx = jnp.arange(page_capacity, dtype=jnp.int32)
+    rep = live_d & (owner2[jnp.clip(g2, 0, cap2 - 1)] == row_idx)
+    return group, rep
+
+
+def _sort_step(nd, layout: ChainLayout):
+    keys = []
+    for k in nd.keys:
+        nulls_first = k.nulls_first
+        if nulls_first is None:
+            # reference default: nulls are largest (ASC last, DESC first)
+            nulls_first = not k.ascending
+        keys.append((k.symbol, k.ascending, nulls_first))
+    is_topn = isinstance(nd, P.TopN)
+    in_cap = layout.capacity
+    out_cap = pad_capacity(min(nd.count, in_cap)) if is_topn else in_cap
+    out_layout = dc_replace(layout, capacity=out_cap) if is_topn else layout
+    limit = out_cap if out_cap < in_cap else None
+    count = nd.count if is_topn else None
+
+    def step(env, mask, flags):
+        sort_keys = [
+            (env[s][0], env[s][1], asc, nf) for s, asc, nf in keys
+        ]
+        perm = K.sort_perm(sort_keys, mask)
+        if limit is not None:
+            perm = perm[:limit]
+        env2 = {}
+        for s, (data, valid) in env.items():
+            env2[s] = (data[perm], None if valid is None else valid[perm])
+        mask2 = mask[perm]
+        if count is not None:
+            mask2 = mask2 & (jnp.arange(mask2.shape[0]) < count)
+        return env2, mask2, flags
+
+    return step, out_layout
+
+
+def _limit_step(nd: P.Limit):
+    def step(env, mask, flags):
+        rank = jnp.cumsum(mask.astype(jnp.int64))
+        keep = mask & (rank > nd.offset)
+        if nd.count >= 0:
+            keep = keep & (rank <= nd.offset + nd.count)
+        return env, keep, flags
+
+    return step
+
+
+def _pad_to(arr: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    n = arr.shape[0]
+    if n >= capacity:
+        return arr[:capacity]
+    return jnp.concatenate([arr, jnp.zeros((capacity - n,), dtype=arr.dtype)])

@@ -186,15 +186,36 @@ class Page:
         return Page(names, cols, jnp.asarray(mask))
 
     def to_pylist(self) -> list[tuple]:
-        """Materialize live rows on host as python tuples (result fetch)."""
-        mask = np.asarray(self.mask)
+        """Materialize live rows on host as python tuples (result fetch).
+
+        One batched device->host transfer for the whole page (the
+        serialized-results fetch of the client protocol; batching
+        matters when the device link has per-call latency)."""
+        import jax
+
+        device_arrays = [self.mask]
+        for c in self.columns:
+            device_arrays.append(c.data)
+            if c.valid is not None:
+                device_arrays.append(c.valid)
+        host = jax.device_get(device_arrays)
+        mask = host[0]
         sel = np.nonzero(mask)[0]
+        i = 1
         cols = []
         for c in self.columns:
-            data, valid = c.to_numpy(sel)
+            data = host[i]
+            i += 1
+            valid = None
+            if c.valid is not None:
+                valid = host[i][sel]
+                i += 1
+            data = data[sel]
+            if c.dictionary is not None:
+                data = c.dictionary.decode(data).astype(object)
             vals = [
-                None if (valid is not None and not valid[i]) else _pyvalue(c.type, data[i])
-                for i in range(len(sel))
+                None if (valid is not None and not valid[j]) else _pyvalue(c.type, data[j])
+                for j in range(len(sel))
             ]
             cols.append(vals)
         return [tuple(col[i] for col in cols) for i in range(len(sel))]
