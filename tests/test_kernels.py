@@ -114,3 +114,37 @@ def test_hash_columns_null_vs_zero():
     valid = jnp.asarray([True, False])
     h = _np(K.hash_columns([(data, valid)]))
     assert h[0] != h[1]  # NULL hashes differently from 0
+
+
+def test_sort_perm_desc_float_nan_first():
+    # reference treats NaN as largest: last for ASC, first for DESC
+    data = jnp.asarray([1.5, float("nan"), -2.0, 0.0, float("inf")],
+                       dtype=jnp.float64)
+    live = jnp.ones(5, dtype=jnp.bool_)
+    perm = K.sort_perm([(data, None, False, False)], live)
+    got = _np(data)[_np(perm)]
+    assert np.isnan(got[0])
+    assert got[1] == np.inf and got[2] == 1.5 and got[3] == 0.0
+    perm_asc = K.sort_perm([(data, None, True, False)], live)
+    got_asc = _np(data)[_np(perm_asc)]
+    assert np.isnan(got_asc[-1]) and got_asc[0] == -2.0
+
+
+def test_sort_perm_negative_zero_equals_zero():
+    data = jnp.asarray([-0.0, 3.0, 0.0, -1.0], dtype=jnp.float64)
+    tie = jnp.asarray([9, 0, 1, 0], dtype=jnp.int64)
+    live = jnp.ones(4, dtype=jnp.bool_)
+    # primary key has -0.0 == 0.0; secondary breaks the tie
+    perm = K.sort_perm(
+        [(data, None, True, False), (tie, None, True, False)], live
+    )
+    got_tie = _np(tie)[_np(perm)]
+    assert got_tie.tolist() == [0, 1, 9, 0]
+
+
+def test_normalize_key_float_canonicalization():
+    a = jnp.asarray([-0.0, float("nan")], dtype=jnp.float64)
+    b = jnp.asarray([0.0, float("nan")], dtype=jnp.float64)
+    ba, _ = K.normalize_key(a, None)
+    bb, _ = K.normalize_key(b, None)
+    assert _np(ba == bb).all()

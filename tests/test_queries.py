@@ -296,3 +296,35 @@ def test_order_by_multi(runner, oracle):
         "group by l_returnflag, l_linestatus "
         "order by l_returnflag, l_linestatus",
     )
+
+
+def test_not_in_empty_subquery_with_null_keys(runner, oracle):
+    # x NOT IN (empty) is TRUE even for NULL x (no 3VL mask applies
+    # over an empty build side — reference SemiJoin semantics)
+    check(
+        runner, oracle,
+        "select count(*) from nation where "
+        "(case when n_regionkey = 1 then null else n_regionkey end) "
+        "not in (select n_regionkey from nation where n_regionkey > 99)",
+    )
+
+
+def test_not_in_correlated_empty_per_probe_set(runner, oracle):
+    # NULL probe key whose *correlated* set is empty must be TRUE under
+    # NOT IN (FALSE under IN), not NULL: region keys 0..4, the probe for
+    # r_regionkey=4 is NULL and no nation row passes n_regionkey > 90
+    check(
+        runner, oracle,
+        "select count(*) from region where "
+        "(case when r_regionkey = 4 then null else r_regionkey end) "
+        "not in (select n_regionkey from nation "
+        "where n_regionkey > 90 + r_regionkey)",
+    )
+    # and the nonempty-set case still yields NULL (row dropped)
+    check(
+        runner, oracle,
+        "select count(*) from region where "
+        "(case when r_regionkey = 4 then null else r_regionkey end) "
+        "not in (select n_regionkey from nation "
+        "where n_regionkey >= r_regionkey)",
+    )

@@ -56,12 +56,23 @@ def _mix64(h: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+def _canonical_float(data: jnp.ndarray) -> jnp.ndarray:
+    """Canonicalize float values so equal keys have equal bits:
+    -0.0 -> +0.0, every NaN payload -> the canonical quiet NaN
+    (grouping/join semantics treat NaN as one value, reference
+    TypeOperators equality)."""
+    data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
+    return jnp.where(jnp.isnan(data), jnp.full_like(data, jnp.nan), data)
+
+
 def _to_bits(data: jnp.ndarray) -> jnp.ndarray:
-    """Reinterpret a key column as uint64 bits."""
+    """Reinterpret a key column as uint64 bits (floats canonicalized)."""
     if data.dtype == jnp.float64:
-        return jax.lax.bitcast_convert_type(data, jnp.uint64)
+        return jax.lax.bitcast_convert_type(_canonical_float(data), jnp.uint64)
     if data.dtype == jnp.float32:
-        return jax.lax.bitcast_convert_type(data, jnp.uint32).astype(jnp.uint64)
+        return jax.lax.bitcast_convert_type(
+            _canonical_float(data), jnp.uint32
+        ).astype(jnp.uint64)
     if data.dtype == jnp.bool_:
         return data.astype(jnp.uint64)
     return data.astype(jnp.uint64)
@@ -172,7 +183,16 @@ def sort_perm(
     n = live.shape[0]
     perm = jnp.arange(n, dtype=jnp.int32)
     for data, valid, ascending, nulls_first in reversed(keys):
-        kd = data if ascending else _invert(data)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            # order-preserving bit transform: NaN sorts as the largest
+            # value under both directions (reference treats NaN as
+            # largest: last for ASC, first for DESC) — negating would
+            # leave NaN last either way
+            kd = _float_sort_bits(data)
+            if not ascending:
+                kd = ~kd
+        else:
+            kd = data if ascending else _invert(data)
         perm = perm[jnp.argsort(kd[perm], stable=True)]
         if valid is not None:
             flag = (~valid).astype(jnp.int8)  # 1 = null
@@ -188,6 +208,22 @@ def _invert(data: jnp.ndarray) -> jnp.ndarray:
     if data.dtype == jnp.bool_:
         return ~data
     return -data  # int64 min overflow is accepted (reference wraps too)
+
+
+def _float_sort_bits(data: jnp.ndarray) -> jnp.ndarray:
+    """Monotone unsigned encoding of a float column: flips the
+    sign-magnitude representation so unsigned compare == float total
+    order, with -0.0 == +0.0 and NaN canonicalized above +inf."""
+    if data.dtype == jnp.float32:
+        bits = jax.lax.bitcast_convert_type(_canonical_float(data), jnp.uint32)
+        sign_mask = jnp.uint32(0x80000000)
+        full_mask = jnp.uint32(0xFFFFFFFF)
+    else:
+        bits = jax.lax.bitcast_convert_type(_canonical_float(data), jnp.uint64)
+        sign_mask = jnp.uint64(0x8000000000000000)
+        full_mask = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    negative = (bits & sign_mask) != 0
+    return bits ^ jnp.where(negative, full_mask, sign_mask)
 
 
 # ---- equi-join -------------------------------------------------------------

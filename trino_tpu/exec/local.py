@@ -18,6 +18,7 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
+from trino_tpu.exec import stage
 from trino_tpu.exec.aggregates import compute_aggregate
 from trino_tpu.expr.compiler import ColumnLayout, compile_expr
 from trino_tpu.expr.ir import AggCall, RowExpression
@@ -324,8 +325,7 @@ class LocalExecutor:
             order, lo, cnt, out_cap
         )
         if verify:
-            for pc, bc in pairs:
-                out_live = out_live & (pc.data[probe_idx] == bc.data[build_idx])
+            out_live = _verify_matches(pairs, probe_idx, build_idx, out_live)
 
         inner = self._gather_join_columns(
             node, probe, build, probe_idx, build_idx, out_live
@@ -420,8 +420,7 @@ class LocalExecutor:
             probe_idx, build_idx, out_live = K.expand_matches(
                 order, lo, cnt, out_cap
             )
-            for pc, bc in pairs:
-                out_live = out_live & (pc.data[probe_idx] == bc.data[build_idx])
+            out_live = _verify_matches(pairs, probe_idx, build_idx, out_live)
             if node.filter is not None:
                 # residual correlated predicate over (source, filter) pairs
                 pair_page = self._gather_pair_page(
@@ -435,15 +434,30 @@ class LocalExecutor:
         else:
             matched = cnt > 0
         valid = None
-        if node.null_aware:
-            # IN 3VL: NULL probe key, or no match while the build side
-            # has NULLs -> NULL (reference SemiJoinNode semantics).
-            # EXISTS is 2-valued: match is plain TRUE/FALSE.
+        if node.null_aware and filt.num_rows() == 0:
+            # x IN (empty) is FALSE — even for NULL x (and NOT IN TRUE);
+            # the 3VL valid mask must not apply over an empty build side.
+            pass
+        elif node.null_aware:
+            # IN 3VL: NULL probe key with a nonempty (per-probe) set,
+            # or no match while the set has NULLs -> NULL (reference
+            # SemiJoinNode semantics). EXISTS is 2-valued.
             build_null_for = self._in_build_nulls(node, source, filt, bv)
             if pv is not None or build_null_for is not None:
-                valid = pv if pv is not None else jnp.ones_like(matched)
+                valid = jnp.ones_like(matched)
                 if build_null_for is not None:
                     valid = valid & (matched | ~build_null_for)
+                if pv is not None:
+                    if node.filter is None:
+                        # the set is the whole (nonempty) build side
+                        valid = valid & pv
+                    else:
+                        # NULL probe key is FALSE, not NULL, when its
+                        # correlated set filters down to empty
+                        nonempty = self._correlated_nonempty(
+                            node, source, filt, pv
+                        )
+                        valid = valid & (pv | ~nonempty)
         names = list(source.names) + [node.match_symbol]
         cols = list(source.columns) + [
             Column(T.BOOLEAN, matched, valid, None)
@@ -476,6 +490,27 @@ class LocalExecutor:
             any_null = any_null | passes
         return any_null
 
+    def _correlated_nonempty(self, node: P.SemiJoin, source: Page, filt: Page, pv):
+        """Per-probe 'some live build row passes the residual filter'
+        vector — the per-probe set of a correlated IN is empty when no
+        build row passes against that probe row. Only NULL-key probe
+        rows need it, so loop over those (usually few), evaluating the
+        filter against the whole build page per row."""
+        nonempty = np.zeros((source.capacity,), dtype=np.bool_)
+        need = np.nonzero(np.asarray(source.mask & ~pv))[0]
+        if len(need) == 0:
+            return jnp.asarray(nonempty)
+        build_idx = jnp.arange(filt.capacity, dtype=jnp.int32)
+        for i in need.tolist():
+            probe_idx = jnp.full((filt.capacity,), i, dtype=jnp.int32)
+            pair = self._gather_pair_page(
+                source, filt, probe_idx, build_idx, filt.mask
+            )
+            fd, fv, _ = self._eval(pair, node.filter)
+            passes = fd if fv is None else (fd & fv)
+            nonempty[i] = bool(np.asarray(jnp.any(passes & filt.mask)))
+        return jnp.asarray(nonempty)
+
     @staticmethod
     def _gather_pair_page(probe: Page, build: Page, probe_idx, build_idx, live) -> Page:
         names, cols = [], []
@@ -491,6 +526,19 @@ class LocalExecutor:
                     )
                 )
         return Page(names, cols, live)
+
+
+def _verify_matches(pairs, probe_idx, build_idx, out_live):
+    """Re-check hash-combined multi-column matches by exact key bits.
+
+    Compares normalized bits rather than raw values so float keys keep
+    canonical semantics (-0.0 == +0.0, NaN == NaN) consistently with
+    the single-column bit-key path."""
+    for pc, bc in pairs:
+        pb, _ = K.normalize_key(pc.data, None)
+        bb, _ = K.normalize_key(bc.data, None)
+        out_live = out_live & (pb[probe_idx] == bb[build_idx])
+    return out_live
 
 
 def _and_mask(a, b):

@@ -32,8 +32,14 @@ __all__ = ["distributed_group_sums", "make_group_sums_step"]
 
 
 def _local_partial(key_bits, key_null, vals, live, capacity):
-    """Shard-local partial aggregation: slot table + per-slot sums."""
+    """Shard-local partial aggregation: slot table + per-slot sums.
+
+    Returns an ``overflow`` flag: live rows that ``assign_groups``
+    could not place (group == capacity) would otherwise be routed into
+    the drop slot and silently vanish — callers must retry with a
+    larger capacity when it trips."""
     group, owner = K.assign_groups((key_bits,), (key_null,), live, capacity)
+    overflow = jnp.any(live & (group == capacity))
     g = jnp.where(live, group, capacity)
     sums = [K.seg_sum(jnp.where(live, v, 0), g, capacity) for v in vals]
     counts = K.seg_sum(live.astype(jnp.int64), g, capacity)
@@ -42,7 +48,7 @@ def _local_partial(key_bits, key_null, vals, live, capacity):
     slot_key = key_bits[own]
     slot_null = key_null[own]
     slot_live = owner < n
-    return slot_key, slot_null, sums, counts, slot_live
+    return slot_key, slot_null, sums, counts, slot_live, overflow
 
 
 def make_group_sums_step(
@@ -64,7 +70,7 @@ def make_group_sums_step(
 
     def step(key_bits, key_null, live, *vals):
         # PARTIAL: local slot table
-        sk, sn, sums, counts, slive = _local_partial(
+        sk, sn, sums, counts, slive, part_ovf = _local_partial(
             key_bits, key_null, list(vals), live, local_capacity
         )
         # route each surviving group to its owning device by key hash
@@ -82,6 +88,7 @@ def make_group_sums_step(
         group, owner = K.assign_groups(
             (rk,), (rn,), rlive, final_capacity
         )
+        final_ovf = jnp.any(rlive & (group == final_capacity))
         g = jnp.where(rlive, group, final_capacity)
         fsums = [
             K.seg_sum(jnp.where(rlive, recv[f"v{i}"], 0), g, final_capacity)
@@ -95,7 +102,9 @@ def make_group_sums_step(
         out_key = rk[own]
         out_null = rn[own]
         out_live = owner < nr
-        # overflow is per-shard; reduce so the replicated output is sound
+        # overflow covers exchange-bucket AND slot-table overflow on any
+        # shard; reduce so the replicated output is sound
+        overflow = overflow | part_ovf | final_ovf
         overflow = jax.lax.pmax(overflow.astype(jnp.int32), axis) > 0
         return (out_key, out_null, *fsums, fcount, out_live, overflow)
 
