@@ -33,22 +33,36 @@ class QueryResult:
 
 
 class QueryRunner:
-    """SQL in, rows out — the LocalQueryRunner analog."""
+    """SQL in, rows out — the LocalQueryRunner analog. With a ``mesh``,
+    plans are distribution-planned and executed SPMD over the device
+    mesh (the DistributedQueryRunner analog,
+    TESTING/DistributedQueryRunner.java:98)."""
 
-    def __init__(self, metadata: Metadata | None = None, session: Session | None = None):
+    def __init__(
+        self,
+        metadata: Metadata | None = None,
+        session: Session | None = None,
+        mesh=None,
+    ):
         self.metadata = metadata or Metadata()
         self.session = session or Session()
+        self.mesh = mesh
         # one executor across queries: keeps the jit-program cache and
         # device-resident scanned tables warm (a Trino worker's lifetime)
-        self.executor = LocalExecutor(self.metadata, self.session)
+        if mesh is not None:
+            from trino_tpu.exec.mesh import MeshExecutor
+
+            self.executor = MeshExecutor(self.metadata, self.session, mesh)
+        else:
+            self.executor = LocalExecutor(self.metadata, self.session)
 
     @staticmethod
-    def tpch(schema: str = "tiny") -> "QueryRunner":
+    def tpch(schema: str = "tiny", mesh=None) -> "QueryRunner":
         """Runner with the TPC-H catalog mounted (TpchQueryRunner analog,
         testing/trino-tests/.../TpchQueryRunner.java:21)."""
         md = Metadata()
         md.register_catalog("tpch", TpchConnector())
-        return QueryRunner(md, Session(catalog="tpch", schema=schema))
+        return QueryRunner(md, Session(catalog="tpch", schema=schema), mesh=mesh)
 
     def plan_sql(self, sql: str, optimized: bool = True) -> P.PlanNode:
         stmt = parse_statement(sql)
@@ -56,6 +70,10 @@ class QueryRunner:
         plan = analyzer.analyze(stmt)
         if optimized:
             plan = optimize(plan, self.metadata, self.session)
+        if self.mesh is not None:
+            from trino_tpu.plan.distribute import add_exchanges
+
+            plan = add_exchanges(plan, self.metadata)
         return plan
 
     def execute_page(self, sql: str) -> tuple[P.PlanNode, Page]:

@@ -33,7 +33,7 @@ VARIANCE_FNS = {
 def compute_aggregate(
     name: str,
     out_type: T.DataType,
-    arg: tuple[jnp.ndarray, jnp.ndarray | None] | None,
+    arg,
     group: jnp.ndarray,
     capacity: int,
     live: jnp.ndarray,
@@ -41,9 +41,16 @@ def compute_aggregate(
     """Evaluate one aggregate over group ids.
 
     ``group[i]`` in [0, capacity) for rows that aggregate, ``capacity``
-    for rows that don't (dead rows / later: filtered rows). Returns
-    (data[capacity], valid[capacity] | None).
+    for rows that don't (dead rows / later: filtered rows). ``arg`` is
+    one (data, valid) pair, or a list of pairs for the multi-state
+    FINAL combines below. Returns (data[capacity], valid[capacity] | None).
     """
+    if name in _FINAL_COMBINES:
+        return _FINAL_COMBINES[name](out_type, arg, group, capacity, live)
+    if isinstance(name, str) and name.startswith("var_final:"):
+        return _var_final(name[10:], arg, group, capacity, live)
+    if isinstance(arg, list) and len(arg) == 1:
+        arg = arg[0]
     if name == "count_all":
         cnt = K.seg_sum(live.astype(jnp.int64), group, capacity)
         return cnt, None
@@ -126,3 +133,52 @@ def compute_aggregate(
         return var, ok
 
     raise NotImplementedError(f"aggregate {name}")
+
+
+# ---- FINAL-step combines ---------------------------------------------------
+# The distributed split (plan.distribute._split_aggregate) produces
+# shard-local PARTIAL states which these combine after the hash
+# exchange — the reference's final Accumulator step over serialized
+# intermediate state (MAIN/operator/aggregation/ state serializers).
+
+
+def _state_sum(pair, group, capacity, live):
+    data, valid = pair
+    contrib = live if valid is None else (live & valid)
+    z = jnp.zeros((), dtype=data.dtype)
+    return K.seg_sum(jnp.where(contrib, data, z), group, capacity)
+
+
+def _count_final(out_type, args, group, capacity, live):
+    """Sum of partial counts; never NULL (COUNT semantics)."""
+    pair = args[0] if isinstance(args, list) else args
+    return _state_sum(pair, group, capacity, live), None
+
+
+def _avg_final(out_type, args, group, capacity, live):
+    s = _state_sum(args[0], group, capacity, live)
+    c = _state_sum(args[1], group, capacity, live)
+    nonempty = c > 0
+    if isinstance(out_type, T.DecimalType):
+        return _div_round_half_up(s, jnp.maximum(c, 1)), nonempty
+    return s.astype(jnp.float64) / jnp.maximum(c, 1), nonempty
+
+
+def _var_final(kind, args, group, capacity, live):
+    n = _state_sum(args[0], group, capacity, live).astype(jnp.float64)
+    s1 = _state_sum(args[1], group, capacity, live)
+    s2 = _state_sum(args[2], group, capacity, live)
+    m2 = jnp.maximum(s2 - (s1 * s1) / jnp.maximum(n, 1.0), 0.0)
+    pop = kind.endswith("_pop")
+    denom = n if pop else n - 1.0
+    ok = n >= (1 if pop else 2)
+    var = m2 / jnp.maximum(denom, 1.0)
+    if kind.startswith("stddev"):
+        var = jnp.sqrt(var)
+    return var, ok
+
+
+_FINAL_COMBINES = {
+    "count_final": _count_final,
+    "avg_final": _avg_final,
+}

@@ -87,8 +87,6 @@ class LocalExecutor:
             )
         if isinstance(n, P.Limit):
             return ("L", n.count, n.offset)
-        if isinstance(n, P.Exchange):
-            return ("E",)
         raise NotImplementedError(type(n).__name__)
 
     def _run_chain(self, chain: list[P.PlanNode], page: Page) -> Page:
@@ -201,6 +199,11 @@ class LocalExecutor:
         names = list(node.assignments)
         columns = [cache[c] for c in node.assignments.values()]
         return Page(names, columns, cache[""])
+
+    def _Exchange(self, node: P.Exchange) -> Page:
+        # single-device execution: every exchange is the identity (the
+        # mesh executor overrides this with collectives/gathers)
+        return self.execute(node.source)
 
     def _Values(self, node: P.Values) -> Page:
         # only the zero-column single-row form (SELECT without FROM)
@@ -408,6 +411,9 @@ class LocalExecutor:
     def _SemiJoin(self, node: P.SemiJoin) -> Page:
         source = self.execute(node.source)
         filt = self._compact(self.execute(node.filter_source))
+        return self._semi_join_pages(node, source, filt)
+
+    def _semi_join_pages(self, node: P.SemiJoin, source: Page, filt: Page) -> Page:
         pk, bk, pv, bv, pairs, verify = self._join_key(
             source, filt, node.keys
         )

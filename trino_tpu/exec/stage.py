@@ -27,15 +27,16 @@ import jax.numpy as jnp
 
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
-from trino_tpu.exec.aggregates import compute_aggregate
+from trino_tpu.exec.aggregates import VARIANCE_FNS, compute_aggregate
 from trino_tpu.expr.compiler import ColumnLayout, compile_expr
 from trino_tpu.page import StringDictionary, pad_capacity
 from trino_tpu.plan import nodes as P
 
 __all__ = ["FUSABLE", "ChainLayout", "plan_capacities", "build_chain"]
 
-#: node types that fuse into one program (single-source, static shapes)
-FUSABLE = (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN, P.Limit, P.Exchange)
+#: node types that fuse into one program (single-source, static shapes).
+#: Exchange is a stage boundary (collective / gather), never fused.
+FUSABLE = (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN, P.Limit)
 
 
 @dataclass
@@ -84,8 +85,6 @@ def build_chain(chain: list[P.PlanNode], layout: ChainLayout, caps: dict[int, li
     scalar for each grouped Aggregate."""
     steps = []
     for i, nd in enumerate(chain):
-        if isinstance(nd, P.Exchange):
-            continue
         if isinstance(nd, P.Filter):
             steps.append(_filter_step(nd, layout))
         elif isinstance(nd, P.Project):
@@ -149,7 +148,7 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
     expr_layout = layout.expr_layout()
     agg_meta = []
     for sym, call in nd.aggregates.items():
-        arg_c = compile_expr(call.args[0], expr_layout) if call.args else None
+        arg_c = [compile_expr(a, expr_layout) for a in call.args] or None
         filter_c = (
             compile_expr(call.filter, expr_layout)
             if call.filter is not None else None
@@ -168,7 +167,7 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
         dicts={
             **{s: layout.dicts[s] for s in group_keys},
             **{
-                sym: (arg_c.dictionary if isinstance(call.type, T.VarcharType) and arg_c else None)
+                sym: (arg_c[0].dictionary if isinstance(call.type, T.VarcharType) and arg_c else None)
                 for sym, call, arg_c, _ in agg_meta
             },
         },
@@ -205,7 +204,21 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
             arg = None
             contrib = mask
             if arg_c is not None:
-                arg = _bcast(*arg_c.fn(env), in_cap)
+                vals = [_bcast(*c.fn(env), in_cap) for c in arg_c]
+                arg = vals[0] if len(vals) == 1 else vals
+            if (
+                call.name in VARIANCE_FNS
+                and isinstance(call.args[0].type, T.DecimalType)
+            ):
+                # variance of DECIMAL is computed as DOUBLE over true
+                # values, not unscaled ints (reference:
+                # DoubleVarianceAggregation via implicit cast)
+                d, v = arg
+                arg = (
+                    d.astype(jnp.float64)
+                    / (10.0 ** call.args[0].type.scale),
+                    v,
+                )
             if filter_c is not None:
                 fd, fv = filter_c.fn(env)
                 contrib = contrib & (fd if fv is None else (fd & fv))

@@ -1,0 +1,309 @@
+"""Distribution planning: turn a single-node logical plan into a
+mesh-distributed plan.
+
+The analog of the reference's exchange placement + fragmentation
+(AddExchanges, MAIN/sql/planner/optimizations/AddExchanges.java:142;
+PlanFragmenter, MAIN/sql/planner/PlanFragmenter.java:91), collapsed
+into one bottom-up pass suited to a batch-synchronous SPMD engine:
+
+- every node is assigned a distribution property: ``dist`` (rows
+  sharded over the mesh axis) or ``single`` (one ordinary device page);
+- grouped aggregations over distributed inputs split into a shard-local
+  PARTIAL step, a hash ``Exchange`` on the group keys (one all_to_all
+  on ICI), and a FINAL combine step — the reference's
+  partial/final HashAggregationOperator pair;
+- TopN/Limit split into shard-local partials and a gathered final;
+- joins get a ``distribution``: BROADCAST (build side replicated to
+  every shard — FIXED_BROADCAST_DISTRIBUTION) when the build side is
+  estimated small, else PARTITIONED (both sides hash-exchanged on the
+  join keys — FIXED_HASH_DISTRIBUTION). Joins repartition *inside* the
+  executor so varchar join keys are hashed on unified dictionary codes;
+- ``Exchange(single)`` marks the gather boundary; above it the plan
+  runs on the ordinary local executor (the coordinator-side final
+  stage).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+
+from trino_tpu import types as T
+from trino_tpu.exec.aggregates import VARIANCE_FNS
+from trino_tpu.expr.ir import AggCall, Call, Cast, InputRef
+from trino_tpu.metadata import Metadata
+from trino_tpu.plan import nodes as P
+from trino_tpu.plan.optimizer import _estimate_rows
+
+__all__ = ["add_exchanges", "BROADCAST_ROW_LIMIT"]
+
+#: build sides estimated below this replicate instead of repartitioning
+#: (DetermineJoinDistributionType's size cutoff stand-in)
+BROADCAST_ROW_LIMIT = 10_000
+
+#: aggregate functions whose partial state combines with the same
+#: function (min of mins, etc.)
+_SELF_COMBINING = {
+    "min", "max", "any_value", "arbitrary", "bool_and", "bool_or",
+}
+
+
+def add_exchanges(plan: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    node, _ = _walk(plan, metadata)
+    return node
+
+
+def _gather(node: P.PlanNode) -> P.PlanNode:
+    return P.Exchange(
+        dict(node.outputs), source=node, partitioning="single",
+    )
+
+
+def _walk(node: P.PlanNode, md: Metadata) -> tuple[P.PlanNode, str]:
+    """Returns (rewritten node, distribution in {'dist', 'single'})."""
+    if isinstance(node, P.TableScan):
+        return node, "dist"
+    if isinstance(node, P.Values):
+        return node, "single"
+
+    if isinstance(node, (P.Filter, P.Project)):
+        src, d = _walk(node.source, md)
+        return dc_replace(node, source=src), d
+
+    if isinstance(node, P.Output):
+        src, d = _walk(node.source, md)
+        if d == "dist":
+            src = _gather(src)
+        return dc_replace(node, source=src), "single"
+
+    if isinstance(node, P.Sort):
+        src, d = _walk(node.source, md)
+        if d == "dist":
+            src = _gather(src)
+        return dc_replace(node, source=src), "single"
+
+    if isinstance(node, P.TopN):
+        src, d = _walk(node.source, md)
+        if d == "dist":
+            partial = dc_replace(node, source=src)
+            return dc_replace(node, source=_gather(partial)), "single"
+        return dc_replace(node, source=src), "single"
+
+    if isinstance(node, P.Limit):
+        src, d = _walk(node.source, md)
+        if d == "dist":
+            partial = P.Limit(
+                dict(node.outputs), source=src,
+                count=node.count + node.offset if node.count >= 0 else -1,
+                offset=0,
+            )
+            return dc_replace(node, source=_gather(partial)), "single"
+        return dc_replace(node, source=src), "single"
+
+    if isinstance(node, P.Aggregate):
+        return _walk_aggregate(node, md)
+
+    if isinstance(node, P.Join):
+        return _walk_join(node, md)
+
+    if isinstance(node, P.SemiJoin):
+        src, sd = _walk(node.source, md)
+        filt, fd = _walk(node.filter_source, md)
+        if sd == "single":
+            if fd == "dist":
+                filt = _gather(filt)
+            return dc_replace(node, source=src, filter_source=filt), "single"
+        # source sharded; replicate the filter side to every shard
+        bcast = P.Exchange(
+            dict(filt.outputs), source=filt, partitioning="broadcast",
+            input_dist=fd,
+        )
+        return dc_replace(node, source=src, filter_source=bcast), "dist"
+
+    # unknown nodes: force single execution of every source
+    srcs = []
+    for s in node.sources:
+        s2, d = _walk(s, md)
+        srcs.append(_gather(s2) if d == "dist" else s2)
+    if srcs:
+        from trino_tpu.plan.optimizer import _replace_sources
+
+        node = _replace_sources(node, srcs)
+    return node, "single"
+
+
+# ---- joins -----------------------------------------------------------------
+
+def _flip(node: P.Join) -> P.Join:
+    return dc_replace(
+        node, left=node.right, right=node.left,
+        criteria=[(b, a) for a, b in node.criteria],
+    )
+
+
+def _walk_join(node: P.Join, md: Metadata) -> tuple[P.PlanNode, str]:
+    left, ld = _walk(node.left, md)
+    right, rd = _walk(node.right, md)
+
+    if ld == "single" and rd == "single":
+        return dc_replace(node, left=left, right=right), "single"
+
+    if node.kind == "cross":
+        if ld == "single":
+            # keep the sharded side streaming; replicate the single one
+            # by flipping (cross join output columns come from
+            # node.outputs, so side order is cosmetic)
+            node, left, ld, right, rd = _flip(node), right, rd, left, ld
+        bcast = P.Exchange(
+            dict(right.outputs), source=right, partitioning="broadcast",
+            input_dist=rd,
+        )
+        return dc_replace(
+            node, left=left, right=bcast, distribution="BROADCAST"
+        ), "dist"
+
+    if node.kind in ("right", "full"):
+        # both sides must be co-partitioned: a replicated build side
+        # would emit its unmatched rows once per shard
+        if ld == "single" or rd == "single":
+            if ld == "dist":
+                left = _gather(left)
+            if rd == "dist":
+                right = _gather(right)
+            return dc_replace(node, left=left, right=right), "single"
+        return dc_replace(
+            node, left=left, right=right, distribution="PARTITIONED"
+        ), "dist"
+
+    if node.kind == "inner" and ld == "single":
+        node, left, ld, right, rd = _flip(node), right, rd, left, ld
+    if node.kind == "left" and ld == "single":
+        # probe side must stay partitioned-or-single; gather the build
+        if rd == "dist":
+            right = _gather(right)
+        return dc_replace(node, left=left, right=right), "single"
+
+    small_build = (
+        rd == "single" or _estimate_rows(right, md) <= BROADCAST_ROW_LIMIT
+    )
+    if small_build:
+        bcast = P.Exchange(
+            dict(right.outputs), source=right, partitioning="broadcast",
+            input_dist=rd,
+        )
+        return dc_replace(
+            node, left=left, right=bcast, distribution="BROADCAST"
+        ), "dist"
+    return dc_replace(
+        node, left=left, right=right, distribution="PARTITIONED"
+    ), "dist"
+
+
+# ---- aggregates ------------------------------------------------------------
+
+def _walk_aggregate(node: P.Aggregate, md: Metadata) -> tuple[P.PlanNode, str]:
+    src, d = _walk(node.source, md)
+    if d == "single":
+        return dc_replace(node, source=src), "single"
+
+    if any(c.distinct for c in node.aggregates.values()):
+        # DISTINCT needs every row of a group on one shard: route raw
+        # rows by group-key hash, then aggregate in one step
+        # (MarkDistinct-over-repartitioned-input analog)
+        if node.group_keys:
+            ex = P.Exchange(
+                dict(src.outputs), source=src, partitioning="hash",
+                hash_symbols=list(node.group_keys),
+            )
+            return dc_replace(node, source=ex), "dist"
+        return dc_replace(node, source=_gather(src)), "single"
+
+    partial, final = _split_aggregate(node)
+    partial = dc_replace(partial, source=src)
+    if node.group_keys:
+        ex = P.Exchange(
+            dict(partial.outputs), source=partial, partitioning="hash",
+            hash_symbols=list(node.group_keys),
+        )
+        return dc_replace(final, source=ex), "dist"
+    return dc_replace(final, source=_gather(partial)), "single"
+
+
+def _split_aggregate(node: P.Aggregate) -> tuple[P.Aggregate, P.Aggregate]:
+    """Decompose SINGLE aggregates into PARTIAL states + FINAL combines
+    (the reference's partial/intermediate/final accumulator steps,
+    MAIN/operator/aggregation/; AddExchanges splits the step the same
+    way)."""
+    partial_aggs: dict[str, AggCall] = {}
+    final_aggs: dict[str, AggCall] = {}
+    for sym, call in node.aggregates.items():
+        name = call.name
+        if name in ("count", "count_all"):
+            partial_aggs[sym] = call
+            final_aggs[sym] = AggCall(
+                "count_final", (InputRef(T.BIGINT, sym),), call.type
+            )
+        elif name == "sum":
+            partial_aggs[sym] = call
+            final_aggs[sym] = AggCall(
+                "sum", (InputRef(call.type, sym),), call.type
+            )
+        elif name in _SELF_COMBINING:
+            partial_aggs[sym] = call
+            final_aggs[sym] = AggCall(
+                name, (InputRef(call.type, sym),), call.type
+            )
+        elif name == "avg":
+            state_t = call.type if isinstance(call.type, T.DecimalType) else T.DOUBLE
+            s_sum, s_cnt = f"{sym}$sum", f"{sym}$cnt"
+            partial_aggs[s_sum] = AggCall(
+                "sum", call.args, state_t, filter=call.filter
+            )
+            partial_aggs[s_cnt] = AggCall(
+                "count", call.args, T.BIGINT, filter=call.filter
+            )
+            final_aggs[sym] = AggCall(
+                "avg_final",
+                (InputRef(state_t, s_sum), InputRef(T.BIGINT, s_cnt)),
+                call.type,
+            )
+        elif name in VARIANCE_FNS:
+            xd = Cast(T.DOUBLE, call.args[0])
+            xx = Call(T.DOUBLE, "multiply", (xd, xd))
+            s_n, s_1, s_2 = f"{sym}$n", f"{sym}$s1", f"{sym}$s2"
+            partial_aggs[s_n] = AggCall(
+                "count", call.args, T.BIGINT, filter=call.filter
+            )
+            partial_aggs[s_1] = AggCall(
+                "sum", (xd,), T.DOUBLE, filter=call.filter
+            )
+            partial_aggs[s_2] = AggCall(
+                "sum", (xx,), T.DOUBLE, filter=call.filter
+            )
+            final_aggs[sym] = AggCall(
+                f"var_final:{name}",
+                (
+                    InputRef(T.BIGINT, s_n),
+                    InputRef(T.DOUBLE, s_1),
+                    InputRef(T.DOUBLE, s_2),
+                ),
+                call.type,
+            )
+        else:
+            raise NotImplementedError(f"no partial split for {name}")
+
+    key_types = {k: node.outputs[k] for k in node.group_keys}
+    partial = P.Aggregate(
+        {**key_types, **{s: a.type for s, a in partial_aggs.items()}},
+        source=None,
+        group_keys=list(node.group_keys),
+        aggregates=partial_aggs,
+        step="PARTIAL",
+    )
+    final = P.Aggregate(
+        dict(node.outputs),
+        source=None,
+        group_keys=list(node.group_keys),
+        aggregates=final_aggs,
+        step="FINAL",
+    )
+    return partial, final

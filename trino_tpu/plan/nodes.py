@@ -173,6 +173,9 @@ class Exchange(PlanNode):
     partitioning: str = "single"  # single | hash | broadcast | source
     hash_symbols: list[str] = field(default_factory=list)
     scope: str = "REMOTE"
+    #: whether the source subtree executes distributed ("dist") or as a
+    #: single local page ("single") — set by plan.distribute
+    input_dist: str = "dist"
 
     @property
     def sources(self):
