@@ -1,14 +1,23 @@
-"""Aggregate function evaluation as segment reductions.
+"""Aggregate function evaluation as sorted segment reductions.
 
 The analog of the reference's accumulator layer
-(MAIN/operator/aggregation/, AccumulatorCompiler): each aggregate is a
-(masked) segment reduction over group ids produced by
-``kernels.assign_groups``. Per-row accumulate loops become one
-``segment_sum``/``segment_min``/``segment_max`` per aggregate, which
-XLA lowers to sorted-scatter updates — the whole group-by runs as a
-handful of fused device ops.
+(MAIN/operator/aggregation/, AccumulatorCompiler) — but scatter-free:
+``kernels.sort_group`` leaves each group as one contiguous run of the
+sorted row order, so every aggregate is a gather (into sorted order) +
+cumsum + boundary-difference, or a segmented associative scan for
+min/max. On TPU this replaces the serializing scatter that
+``segment_sum`` lowers to (~100 ms/op at 1M rows on v5e) with sorts,
+gathers and scans that each cost single-digit milliseconds.
 
-Distinct aggregates dedupe first: a second ``assign_groups`` over
+Work shared between the aggregates of one GROUP BY (the gather of a
+column into group order, the per-group row count, contribution masks)
+is deduplicated through a per-step ``share`` cache, the analog of the
+reference's shared GroupByHash + per-aggregate accumulators split.
+
+Global (ungrouped) aggregates skip the grouping entirely and lower to
+dense masked reductions.
+
+Distinct aggregates dedupe first: a second ``sort_group`` over
 (group keys + argument) keeps one representative row per distinct
 value, then the plain path aggregates the representatives
 (the reference routes this through MarkDistinct / DistinctAccumulator).
@@ -30,97 +39,176 @@ VARIANCE_FNS = {
 }
 
 
+class _Reducer:
+    """Segment reductions for one GROUP BY (``info`` set) or one global
+    aggregate (``info`` None -> [1]-shaped dense reductions).
+
+    ``share`` caches device intermediates across the aggregates of one
+    step, keyed by object identity (keys hold a reference to the keyed
+    array so ids stay valid for the cache's lifetime).
+    """
+
+    def __init__(self, info: K.GroupInfo | None, capacity: int, contrib,
+                 share: dict | None = None):
+        self.info = info
+        self.capacity = capacity
+        self.contrib = contrib
+        self.share = share if share is not None else {}
+        self.contrib_s = (
+            None if info is None else self._sorted(contrib)
+        )
+
+    def _sorted(self, x):
+        key = ("sorted", id(x))
+        hit = self.share.get(key)
+        if hit is None or hit[0] is not x:
+            hit = (x, x[self.info.perm])
+            self.share[key] = hit
+        return hit[1]
+
+    def with_valid(self, valid):
+        """Reducer whose contribution also requires ``valid`` (cached)."""
+        if valid is None:
+            return self
+        key = ("and", id(self.contrib), id(valid))
+        hit = self.share.get(key)
+        if hit is None or hit[0] is not self.contrib or hit[1] is not valid:
+            hit = (self.contrib, valid, self.contrib & valid)
+            self.share[key] = hit
+        return _Reducer(self.info, self.capacity, hit[2], self.share)
+
+    def sum(self, data, dtype=None):
+        """Masked per-group sum; ``dtype`` casts AFTER the gather so the
+        gathered column is shared with other aggregates of the step."""
+        if self.info is None:
+            x = data if dtype is None else data.astype(dtype)
+            zero = jnp.zeros((), dtype=x.dtype)
+            return jnp.sum(jnp.where(self.contrib, x, zero))[None]
+        xs = self._sorted(data)
+        if dtype is not None:
+            xs = xs.astype(dtype)
+        zero = jnp.zeros((), dtype=xs.dtype)
+        masked = jnp.where(self.contrib_s, xs, zero)
+        return K.seg_sum_ranges(masked, self.info, zero)
+
+    def count(self):
+        key = ("count", id(self.contrib))
+        hit = self.share.get(key)
+        if hit is None or hit[0] is not self.contrib:
+            if self.info is None:
+                cnt = jnp.sum(self.contrib.astype(jnp.int64))[None]
+            else:
+                cnt = K.seg_sum_ranges(
+                    self.contrib_s.astype(jnp.int64), self.info,
+                    jnp.int64(0),
+                )
+            hit = (self.contrib, cnt)
+            self.share[key] = hit
+        return hit[1]
+
+    def minmax(self, data, fill, is_min: bool):
+        if self.info is None:
+            masked = jnp.where(self.contrib, data, fill)
+            red = jnp.min if is_min else jnp.max
+            return red(masked)[None]
+        masked = jnp.where(self.contrib_s, self._sorted(data), fill)
+        return K.seg_minmax_scan(masked, self.info, fill, is_min)
+
+    def first_value(self, data):
+        """Value of the first contributing row per group."""
+        n = data.shape[0]
+        if self.info is None:
+            idx = jnp.arange(n, dtype=jnp.int32)
+            first = jnp.min(jnp.where(self.contrib, idx, n))[None]
+            return data[jnp.clip(first, 0, max(n - 1, 0))]
+        rows, _ = K.seg_first_index(self.contrib_s, self.info)
+        return data[jnp.clip(rows, 0, max(n - 1, 0))]
+
+
 def compute_aggregate(
     name: str,
     out_type: T.DataType,
     arg,
-    group: jnp.ndarray,
+    info: K.GroupInfo | None,
     capacity: int,
-    live: jnp.ndarray,
+    contrib: jnp.ndarray,
+    share: dict | None = None,
 ):
-    """Evaluate one aggregate over group ids.
+    """Evaluate one aggregate.
 
-    ``group[i]`` in [0, capacity) for rows that aggregate, ``capacity``
-    for rows that don't (dead rows / later: filtered rows). ``arg`` is
-    one (data, valid) pair, or a list of pairs for the multi-state
-    FINAL combines below. Returns (data[capacity], valid[capacity] | None).
+    ``info`` carries the sorted-group context (None for a global
+    aggregate — output shape [1]); ``contrib`` masks the rows that
+    feed this aggregate (liveness & FILTER & DISTINCT dedupe), in
+    original row order, as are the ``arg`` (data, valid) pairs.
+    Returns (data[capacity|1], valid[...] | None).
     """
+    red = _Reducer(info, capacity, contrib, share)
+
     if name in _FINAL_COMBINES:
-        return _FINAL_COMBINES[name](out_type, arg, group, capacity, live)
+        return _FINAL_COMBINES[name](out_type, arg, red)
     if isinstance(name, str) and name.startswith("var_final:"):
-        return _var_final(name[10:], arg, group, capacity, live)
+        return _var_final(name[10:], arg, red)
     if isinstance(arg, list) and len(arg) == 1:
         arg = arg[0]
     if name == "count_all":
-        cnt = K.seg_sum(live.astype(jnp.int64), group, capacity)
-        return cnt, None
+        return red.count(), None
 
     data, valid = arg
-    contrib = live if valid is None else (live & valid)
+    red = red.with_valid(valid)
 
     if name == "count":
-        cnt = K.seg_sum(contrib.astype(jnp.int64), group, capacity)
-        return cnt, None
+        return red.count(), None
 
-    cnt = K.seg_sum(contrib.astype(jnp.int64), group, capacity)
+    cnt = red.count()
     nonempty = cnt > 0
 
     if name == "sum":
-        z = jnp.zeros((), dtype=data.dtype)
-        s = K.seg_sum(jnp.where(contrib, data, z), group, capacity)
-        if isinstance(out_type, (T.DoubleType, T.RealType)):
-            s = s.astype(out_type.np_dtype)
-        return s, nonempty
+        cast = (
+            out_type.np_dtype
+            if isinstance(out_type, (T.DoubleType, T.RealType))
+            else None
+        )
+        return red.sum(data, dtype=cast), nonempty
 
     if name == "avg":
         if isinstance(out_type, T.DecimalType):
             # unscaled int sum / count, rounded half away from zero
             # (reference: DecimalAverageAggregation)
-            s = K.seg_sum(jnp.where(contrib, data, 0), group, capacity)
-            d = _div_round_half_up(s, jnp.maximum(cnt, 1))
-            return d, nonempty
-        s = K.seg_sum(
-            jnp.where(contrib, data.astype(jnp.float64), 0.0), group, capacity
-        )
+            s = red.sum(data)
+            return _div_round_half_up(s, jnp.maximum(cnt, 1)), nonempty
+        s = red.sum(data, dtype=jnp.float64)
         return s / jnp.maximum(cnt, 1), nonempty
 
     if name in ("min", "max"):
+        is_min = name == "min"
         if data.dtype == jnp.bool_:
-            d8 = data.astype(jnp.int8)
-            fill = jnp.int8(1 if name == "min" else 0)
-            masked = jnp.where(contrib, d8, fill)
-            red = K.seg_min if name == "min" else K.seg_max
-            return red(masked, group, capacity).astype(jnp.bool_), nonempty
+            fill = jnp.int8(1 if is_min else 0)
+            out = red.minmax(data.astype(jnp.int8), fill, is_min)
+            return out.astype(jnp.bool_), nonempty
         if jnp.issubdtype(data.dtype, jnp.floating):
             fill = jnp.array(
-                np.inf if name == "min" else -np.inf, dtype=data.dtype
+                np.inf if is_min else -np.inf, dtype=data.dtype
             )
         else:
-            info = jnp.iinfo(data.dtype)
+            iinfo = jnp.iinfo(data.dtype)
             fill = jnp.array(
-                info.max if name == "min" else info.min, dtype=data.dtype
+                iinfo.max if is_min else iinfo.min, dtype=data.dtype
             )
-        masked = jnp.where(contrib, data, fill)
-        red = K.seg_min if name == "min" else K.seg_max
-        return red(masked, group, capacity), nonempty
+        return red.minmax(data, fill, is_min), nonempty
 
     if name in ("any_value", "arbitrary"):
-        n = data.shape[0]
-        idx = jnp.arange(n, dtype=jnp.int64)
-        first = K.seg_min(jnp.where(contrib, idx, n), group, capacity)
-        return data[jnp.clip(first, 0, n - 1)], nonempty
+        return red.first_value(data), nonempty
 
     if name in ("bool_and", "bool_or"):
-        d8 = data.astype(jnp.int8)
-        fill = jnp.int8(1 if name == "bool_and" else 0)
-        masked = jnp.where(contrib, d8, fill)
-        red = K.seg_min if name == "bool_and" else K.seg_max
-        return red(masked, group, capacity).astype(jnp.bool_), nonempty
+        is_min = name == "bool_and"
+        fill = jnp.int8(1 if is_min else 0)
+        out = red.minmax(data.astype(jnp.int8), fill, is_min)
+        return out.astype(jnp.bool_), nonempty
 
     if name in VARIANCE_FNS:
-        x = jnp.where(contrib, data.astype(jnp.float64), 0.0)
-        s1 = K.seg_sum(x, group, capacity)
-        s2 = K.seg_sum(x * x, group, capacity)
+        s1 = red.sum(data, dtype=jnp.float64)
+        x = data.astype(jnp.float64)
+        s2 = red.sum(x * x)
         n = cnt.astype(jnp.float64)
         m2 = s2 - (s1 * s1) / jnp.maximum(n, 1.0)
         m2 = jnp.maximum(m2, 0.0)  # clamp fp cancellation
@@ -142,32 +230,40 @@ def compute_aggregate(
 # intermediate state (MAIN/operator/aggregation/ state serializers).
 
 
-def _state_sum(pair, group, capacity, live):
+def _state_sum(pair, red: _Reducer):
     data, valid = pair
-    contrib = live if valid is None else (live & valid)
-    z = jnp.zeros((), dtype=data.dtype)
-    return K.seg_sum(jnp.where(contrib, data, z), group, capacity)
+    if valid is not None:
+        key = ("nulled", id(data), id(valid))
+        hit = red.share.get(key)
+        if hit is None or hit[0] is not data or hit[1] is not valid:
+            hit = (
+                data, valid,
+                jnp.where(valid, data, jnp.zeros((), dtype=data.dtype)),
+            )
+            red.share[key] = hit
+        data = hit[2]
+    return red.sum(data)
 
 
-def _count_final(out_type, args, group, capacity, live):
+def _count_final(out_type, args, red: _Reducer):
     """Sum of partial counts; never NULL (COUNT semantics)."""
     pair = args[0] if isinstance(args, list) else args
-    return _state_sum(pair, group, capacity, live), None
+    return _state_sum(pair, red), None
 
 
-def _avg_final(out_type, args, group, capacity, live):
-    s = _state_sum(args[0], group, capacity, live)
-    c = _state_sum(args[1], group, capacity, live)
+def _avg_final(out_type, args, red: _Reducer):
+    s = _state_sum(args[0], red)
+    c = _state_sum(args[1], red)
     nonempty = c > 0
     if isinstance(out_type, T.DecimalType):
         return _div_round_half_up(s, jnp.maximum(c, 1)), nonempty
     return s.astype(jnp.float64) / jnp.maximum(c, 1), nonempty
 
 
-def _var_final(kind, args, group, capacity, live):
-    n = _state_sum(args[0], group, capacity, live).astype(jnp.float64)
-    s1 = _state_sum(args[1], group, capacity, live)
-    s2 = _state_sum(args[2], group, capacity, live)
+def _var_final(kind, args, red: _Reducer):
+    n = _state_sum(args[0], red).astype(jnp.float64)
+    s1 = _state_sum(args[1], red)
+    s2 = _state_sum(args[2], red)
     m2 = jnp.maximum(s2 - (s1 * s1) / jnp.maximum(n, 1.0), 0.0)
     pop = kind.endswith("_pop")
     denom = n if pop else n - 1.0

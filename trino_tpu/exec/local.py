@@ -117,12 +117,20 @@ class LocalExecutor:
                     capacity=page.capacity,
                 )
                 fn, out_layout = stage.build_chain(chain, in_layout, caps)
-                hit = (jax.jit(fn), out_layout)
+
+                def counted(env, mask, _fn=fn):
+                    env2, mask2, flags = _fn(env, mask)
+                    return env2, mask2, flags, K.count_true(mask2)
+
+                hit = (jax.jit(counted), out_layout)
                 self._jit_cache[key] = hit
             fn, out_layout = hit
-            env, mask, flags = fn(self._env(page), page.mask)
-            if flags:
-                vals = jax.device_get(flags)
+            env, mask, flags, n_live_dev = fn(self._env(page), page.mask)
+            # one host sync fetches overflow flags AND the live count,
+            # so downstream consumers (compact, joins, result fetch)
+            # never re-sync
+            vals, n_live = jax.device_get((flags, n_live_dev))
+            if vals:
                 overflowed = [i for i, v in vals.items() if v]
                 if overflowed:
                     for i in overflowed:
@@ -142,7 +150,14 @@ class LocalExecutor:
                 )
                 for s in out_layout.names
             ]
-            return Page(list(out_layout.names), cols, mask)
+            out = Page(list(out_layout.names), cols, mask)
+            out.known_rows = int(n_live)
+            # chains ending in a sort emit live rows first (sort_perm
+            # pushes dead rows last)
+            out.packed = isinstance(chain[-1], (P.Sort, P.TopN))
+            if pad_capacity(out.known_rows) < out.capacity:
+                out = self._compact(out)
+            return out
 
     # ---- expression evaluation ------------------------------------------
 
@@ -196,9 +211,13 @@ class LocalExecutor:
                 cache[cname] = Column.from_numpy(
                     node.outputs[by_col[cname]], cols[cname], capacity=cap
                 )
+            cache["#rows"] = n
         names = list(node.assignments)
         columns = [cache[c] for c in node.assignments.values()]
-        return Page(names, columns, cache[""])
+        return Page(
+            names, columns, cache[""],
+            known_rows=cache["#rows"], packed=True,
+        )
 
     def _Exchange(self, node: P.Exchange) -> Page:
         # single-device execution: every exchange is the identity (the
@@ -211,38 +230,58 @@ class LocalExecutor:
             raise NotImplementedError("general VALUES is not supported yet")
         mask = np.zeros(8, dtype=np.bool_)
         mask[: len(node.rows)] = True
-        return Page([], [], jnp.asarray(mask))
+        return Page(
+            [], [], jnp.asarray(mask),
+            known_rows=len(node.rows), packed=True,
+        )
 
     # ---- row-level nodes -------------------------------------------------
 
     def _Output(self, node: P.Output) -> Page:
         page = self.execute(node.source)
         cols = [page.column(s) for s in node.symbols]
-        return Page(list(node.names), cols, page.mask)
-
-    def _apply_perm(self, page: Page, perm: jnp.ndarray, limit: int | None = None) -> Page:
-        cols = []
-        for c in page.columns:
-            data = c.data[perm]
-            valid = None if c.valid is None else c.valid[perm]
-            if limit is not None:
-                data = data[:limit]
-                valid = None if valid is None else valid[:limit]
-            cols.append(Column(c.type, data, valid, c.dictionary))
-        mask = page.mask[perm]
-        if limit is not None:
-            mask = mask[:limit]
-        return Page(page.names, cols, mask)
+        return Page(
+            list(node.names), cols, page.mask,
+            known_rows=page.known_rows, packed=page.packed,
+        )
 
     def _compact(self, page: Page, extra_capacity: int = 0) -> Page:
         """Gather live rows to the front and shrink capacity
-        (Page.compact analog, SPI/Page.java:180). Host-syncs the count."""
+        (Page.compact analog, SPI/Page.java:180) — one jitted program
+        per (layout, capacity) so the device sees a single dispatch.
+        Syncs the count only when the producer did not record it."""
         n_live = page.num_rows()
         cap = pad_capacity(n_live + extra_capacity)
-        perm = jnp.argsort((~page.mask).astype(jnp.int8), stable=True)
-        if cap >= page.capacity:
-            return self._apply_perm(page, perm)
-        return self._apply_perm(page, perm, limit=cap)
+        if page.packed and cap >= page.capacity:
+            return page
+        limit = cap if cap < page.capacity else page.capacity
+        key = ("compact", self._layout_sig(page), limit)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def compact_fn(env, mask):
+                perm = jnp.argsort(
+                    (~mask).astype(jnp.int8), stable=True
+                )[:limit]
+                env2 = {
+                    s: (
+                        d[perm],
+                        None if v is None else v[perm],
+                    )
+                    for s, (d, v) in env.items()
+                }
+                return env2, mask[perm]
+
+            fn = jax.jit(compact_fn)
+            self._jit_cache[key] = fn
+        env2, mask2 = fn(self._env(page), page.mask)
+        cols = [
+            Column(c.type, *env2[s], c.dictionary)
+            for s, c in zip(page.names, page.columns)
+        ]
+        out = Page(list(page.names), cols, mask2)
+        out.known_rows = n_live
+        out.packed = True
+        return out
 
     # ---- aggregation -----------------------------------------------------
 
@@ -327,12 +366,18 @@ class LocalExecutor:
         probe_idx, build_idx, out_live = K.expand_matches(
             order, lo, cnt, out_cap
         )
+        exact = not verify
         if verify:
             out_live = _verify_matches(pairs, probe_idx, build_idx, out_live)
 
         inner = self._gather_join_columns(
             node, probe, build, probe_idx, build_idx, out_live
         )
+        if exact and node.filter is None:
+            # the expansion emits matches as a dense prefix of length
+            # ``total`` — record it so downstream never re-syncs
+            inner.known_rows = total
+            inner.packed = True
         if node.filter is not None:
             fd, fv, _ = self._eval(inner, node.filter)
             out_live = inner.mask & (fd if fv is None else (fd & fv))
@@ -340,17 +385,13 @@ class LocalExecutor:
         if node.kind == "inner":
             return inner
         if node.kind in ("left", "full"):
-            matched = K.seg_sum(
-                inner.mask.astype(jnp.int32), probe_idx, probe.capacity
-            ) > 0
+            matched = K.range_any(cnt, inner.mask)
             unmatched = probe.mask & ~matched
             out = self._append_outer_rows(node, inner, probe, unmatched, side="probe")
             if node.kind == "full":
-                bmatched = K.seg_sum(
-                    inner.mask.astype(jnp.int32),
-                    jnp.where(inner.mask, build_idx, build.capacity),
-                    build.capacity,
-                ) > 0
+                bmatched = K.scatter_any(
+                    build_idx, inner.mask, build.capacity
+                )
                 bunmatched = build.mask & ~bmatched
                 out = self._append_outer_rows(node, out, build, bunmatched, side="build")
             return out
@@ -434,9 +475,7 @@ class LocalExecutor:
                 )
                 fd, fv, _ = self._eval(pair_page, node.filter)
                 out_live = out_live & (fd if fv is None else (fd & fv))
-            matched = K.seg_sum(
-                out_live.astype(jnp.int32), probe_idx, source.capacity
-            ) > 0
+            matched = K.range_any(cnt, out_live)
         else:
             matched = cnt > 0
         valid = None
@@ -468,7 +507,10 @@ class LocalExecutor:
         cols = list(source.columns) + [
             Column(T.BOOLEAN, matched, valid, None)
         ]
-        return Page(names, cols, source.mask)
+        return Page(
+            names, cols, source.mask,
+            known_rows=source.known_rows, packed=source.packed,
+        )
 
     def _in_build_nulls(self, node: P.SemiJoin, source: Page, filt: Page, bv):
         """Per-probe 'the build side contributed a NULL key' vector for

@@ -249,7 +249,10 @@ class MeshExecutor(LocalExecutor):
             cols.append(Column(c.type, jnp.asarray(data), valid, c.dictionary))
         out_mask = np.zeros(cap, dtype=np.bool_)
         out_mask[: len(idx)] = True
-        return Page(list(sp.names), cols, jnp.asarray(out_mask))
+        return Page(
+            list(sp.names), cols, jnp.asarray(out_mask),
+            known_rows=len(idx), packed=True,
+        )
 
     def scatter(self, page: Page) -> ShardedPage:
         """Split a local Page's live rows contiguously over the mesh."""
@@ -629,9 +632,7 @@ class MeshExecutor(LocalExecutor):
                 }
                 mask_sections = [out_live]
                 if kind in ("left", "full"):
-                    matched = K.seg_sum(
-                        out_live.astype(jnp.int32), probe_idx, p_cap
-                    ) > 0
+                    matched = K.range_any(cnt, out_live)
                     unmatched = p_mask & ~matched
                     for s, from_probe, _ in out_meta:
                         if from_probe:
@@ -645,11 +646,7 @@ class MeshExecutor(LocalExecutor):
                             ))
                     mask_sections.append(unmatched)
                 if kind == "full":
-                    bmatched = K.seg_sum(
-                        out_live.astype(jnp.int32),
-                        jnp.where(out_live, build_idx, b_cap),
-                        b_cap,
-                    ) > 0
+                    bmatched = K.scatter_any(build_idx, out_live, b_cap)
                     bunmatched = b_mask & ~bmatched
                     for s, from_probe, _ in out_meta:
                         if from_probe:
@@ -881,9 +878,7 @@ class MeshExecutor(LocalExecutor):
                         out_live = out_live & (
                             fd if fv is None else (fd & fv)
                         )
-                    matched = K.seg_sum(
-                        out_live.astype(jnp.int32), probe_idx, p_cap
-                    ) > 0
+                    matched = K.range_any(cnt, out_live)
                 else:
                     matched = cnt > 0
                 return matched

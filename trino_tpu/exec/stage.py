@@ -24,6 +24,7 @@ from __future__ import annotations
 from dataclasses import dataclass, replace as dc_replace
 
 import jax.numpy as jnp
+import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
@@ -50,6 +51,24 @@ class ChainLayout:
 
     def expr_layout(self) -> ColumnLayout:
         return ColumnLayout(types=dict(self.types), dictionaries=dict(self.dicts))
+
+
+def _norm_opt(data, valid):
+    """normalize_key, with the null flag elided (None) for columns
+    that cannot be NULL — saves a sort pass + compare in sort_group."""
+    bits, flag = K.normalize_key(data, valid)
+    return bits, (None if valid is None else flag)
+
+
+def _key_width(t: T.DataType, dictionary) -> int:
+    """Bit width that injectively covers a key column's values — lets
+    sort_group pack several keys into one u64 sort pass."""
+    if dictionary is not None:
+        return max(1, len(dictionary).bit_length())
+    if isinstance(t, T.BooleanType):
+        return 1
+    dt = np.dtype(t.np_dtype)
+    return min(dt.itemsize * 8, 64)
 
 
 def _bcast(data, valid, capacity):
@@ -176,22 +195,27 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
 
     def step(env, mask, flags):
         if is_global:
-            group = jnp.where(mask, 0, 1).astype(jnp.int32)
-            owner = None
+            info = None
+            widths = ()
+            out_mask = jnp.zeros((8,), dtype=jnp.bool_).at[0].set(True)
+            env2 = {}
         else:
-            norm = [K.normalize_key(*env[s]) for s in group_keys]
-            group, owner = K.assign_groups(
+            norm = [_norm_opt(*env[s]) for s in group_keys]
+            widths = tuple(
+                _key_width(layout.types[s], layout.dicts.get(s))
+                for s in group_keys
+            )
+            info = K.sort_group(
                 tuple(b for b, _ in norm),
                 tuple(fl for _, fl in norm),
-                mask, capacity,
+                mask, capacity, widths=widths,
             )
-            flags = {**flags, pos: jnp.any(mask & (group == capacity))}
-        env2 = {}
-        if is_global:
-            out_mask = jnp.zeros((8,), dtype=jnp.bool_).at[0].set(True)
-        else:
-            occupied = owner < in_cap
-            own = jnp.clip(owner, 0, in_cap - 1)
+            flags = {**flags, pos: info.num_groups > capacity}
+            env2 = {}
+            occupied = (
+                jnp.arange(capacity, dtype=jnp.int32) < info.num_groups
+            )
+            own = jnp.clip(info.owner, 0, in_cap - 1)
             for s in group_keys:
                 data, valid = env[s]
                 env2[s] = (
@@ -200,6 +224,8 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
                 )
             out_mask = occupied
         cap_seg = 1 if is_global else capacity
+        share = {"#mask": mask}  # per-step cache of sorted cols/counts
+        prepared = []
         for sym, call, arg_c, filter_c in agg_meta:
             arg = None
             contrib = mask
@@ -222,14 +248,21 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
             if filter_c is not None:
                 fd, fv = filter_c.fn(env)
                 contrib = contrib & (fd if fv is None else (fd & fv))
-            g = group
             if call.distinct:
-                g, contrib = _dedupe(
-                    [env[s] for s in group_keys], arg, group, contrib, in_cap
+                dwidths = widths + (
+                    _key_width(call.args[0].type, arg_c[0].dictionary),
                 )
-            g = jnp.where(contrib, g, cap_seg)
+                contrib = _dedupe(
+                    [env[s] for s in group_keys], arg, contrib, in_cap,
+                    dwidths,
+                )
+            prepared.append((sym, call, arg, contrib))
+        if info is not None:
+            _presort_shared(prepared, info, share)
+        for sym, call, arg, contrib in prepared:
             data, valid = compute_aggregate(
-                call.name, call.type, arg, g, cap_seg, contrib
+                call.name, call.type, arg, info, cap_seg, contrib,
+                share=share,
             )
             if is_global:
                 data = _pad_to(data, 8)
@@ -240,19 +273,82 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
     return step, out_layout
 
 
-def _dedupe(key_cols, arg, group, live, page_capacity):
-    """DISTINCT: keep one representative row per (group, value)."""
+def _presort_shared(prepared, info, share):
+    """Gather every column the step's aggregates need into group-sorted
+    order in as few device gathers as possible: same-dtype columns are
+    stacked [n, k] and gathered once (a stacked gather costs barely
+    more than a single-column one on TPU), then unstacked into the
+    ``share`` cache that ``compute_aggregate``'s reducers consult.
+    Mirrors the cache keys of aggregates._Reducer exactly."""
+    items: dict[int, object] = {}
+
+    def want(x):
+        if x is not None and id(x) not in items:
+            items[id(x)] = x
+
+    for _sym, _call, arg, contrib in prepared:
+        eff = contrib
+        if isinstance(arg, list):
+            for pair in arg:
+                d, v = pair
+                if v is not None:
+                    key = ("nulled", id(d), id(v))
+                    hit = share.get(key)
+                    if hit is None:
+                        hit = (
+                            d, v,
+                            jnp.where(v, d, jnp.zeros((), dtype=d.dtype)),
+                        )
+                        share[key] = hit
+                    want(hit[2])
+                else:
+                    want(d)
+        elif arg is not None:
+            d, v = arg
+            want(d)
+            if v is not None:
+                key = ("and", id(contrib), id(v))
+                hit = share.get(key)
+                if hit is None:
+                    hit = (contrib, v, contrib & v)
+                    share[key] = hit
+                eff = hit[2]
+        want(eff)
+
+    by_dtype: dict[str, list] = {}
+    for x in items.values():
+        by_dtype.setdefault(str(x.dtype), []).append(x)
+    for xs in by_dtype.values():
+        if len(xs) == 1:
+            x = xs[0]
+            share[("sorted", id(x))] = (x, x[info.perm])
+        else:
+            stacked = jnp.stack(xs, axis=1)[info.perm]
+            for i, x in enumerate(xs):
+                share[("sorted", id(x))] = (x, stacked[:, i])
+
+
+def _dedupe(key_cols, arg, live, page_capacity, widths=None):
+    """DISTINCT: keep one representative row per (group keys, value).
+
+    Sort-based grouping is exact and dense, so a capacity equal to the
+    page capacity can never overflow (num_groups <= live rows)."""
     data, valid = arg
     live_d = live if valid is None else (live & valid)
-    norm = [K.normalize_key(d, v) for d, v in key_cols]
-    norm.append(K.normalize_key(data, valid))
-    cap2 = pad_capacity(max(2 * page_capacity, 8))
-    g2, owner2 = K.assign_groups(
-        tuple(b for b, _ in norm), tuple(fl for _, fl in norm), live_d, cap2
+    norm = [_norm_opt(d, v) for d, v in key_cols]
+    # the dedupe key value itself: NULL rows are excluded via live_d,
+    # so the flag is never needed
+    norm.append((_norm_opt(data, valid)[0], None))
+    cap2 = page_capacity
+    info2 = K.sort_group(
+        tuple(b for b, _ in norm), tuple(fl for _, fl in norm), live_d, cap2,
+        widths=widths,
     )
     row_idx = jnp.arange(page_capacity, dtype=jnp.int32)
-    rep = live_d & (owner2[jnp.clip(g2, 0, cap2 - 1)] == row_idx)
-    return group, rep
+    rep = live_d & (
+        info2.owner[jnp.clip(info2.group, 0, cap2 - 1)] == row_idx
+    )
+    return rep
 
 
 def _sort_step(nd, layout: ChainLayout):

@@ -34,9 +34,17 @@ __all__ = ["StringDictionary", "Column", "Page", "pad_capacity"]
 
 
 def pad_capacity(n: int, minimum: int = 8) -> int:
-    """Round up to a power of two (bounds the number of XLA programs)."""
+    """Round up to a power of two or 1.5x a power of two (>= 96).
+
+    The bucket family bounds the number of XLA programs while keeping
+    worst-case padding waste at 33% instead of 100%; every bucket stays
+    divisible by 8 so mesh sharding divides evenly."""
     c = max(int(n), minimum)
-    return 1 << (c - 1).bit_length()
+    p = 1 << (c - 1).bit_length()
+    mid = (p // 4) * 3
+    if mid >= max(c, 96):
+        return mid
+    return p
 
 
 class StringDictionary:
@@ -152,6 +160,10 @@ class Page:
     names: list[str]
     columns: list[Column]
     mask: jnp.ndarray  # bool[capacity]; True = live row
+    #: host-known live-row count (avoids a device sync when set)
+    known_rows: int | None = None
+    #: True when live rows occupy positions [0, known_rows) exactly
+    packed: bool = False
 
     def __post_init__(self):
         assert len(self.names) == len(self.columns)
@@ -164,7 +176,9 @@ class Page:
         return self.columns[self.names.index(name)]
 
     def num_rows(self) -> int:
-        """Live row count (forces a device sync — host/debug use only)."""
+        """Live row count (device sync unless already host-known)."""
+        if self.known_rows is not None:
+            return self.known_rows
         return int(jnp.sum(self.mask))
 
     @staticmethod
@@ -190,18 +204,31 @@ class Page:
 
         One batched device->host transfer for the whole page (the
         serialized-results fetch of the client protocol; batching
-        matters when the device link has per-call latency)."""
+        matters when the device link has per-call latency). Packed
+        pages with a host-known row count transfer only the live
+        prefix — the capacity padding never crosses the link."""
         import jax
 
-        device_arrays = [self.mask]
-        for c in self.columns:
-            device_arrays.append(c.data)
-            if c.valid is not None:
-                device_arrays.append(c.valid)
-        host = jax.device_get(device_arrays)
-        mask = host[0]
-        sel = np.nonzero(mask)[0]
-        i = 1
+        k = self.known_rows if self.packed else None
+        if k is not None:
+            device_arrays = []
+            for c in self.columns:
+                device_arrays.append(c.data[:k])
+                if c.valid is not None:
+                    device_arrays.append(c.valid[:k])
+            host = jax.device_get(device_arrays)
+            sel = np.arange(k)
+            i = 0
+        else:
+            device_arrays = [self.mask]
+            for c in self.columns:
+                device_arrays.append(c.data)
+                if c.valid is not None:
+                    device_arrays.append(c.valid)
+            host = jax.device_get(device_arrays)
+            mask = host[0]
+            sel = np.nonzero(mask)[0]
+            i = 1
         cols = []
         for c in self.columns:
             data = host[i]
