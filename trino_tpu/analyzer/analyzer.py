@@ -125,8 +125,15 @@ class Analyzer:
         for name, cte_q in q.with_:
             ctes[name.lower()] = cte_q
         if isinstance(q.select, ast.SetOp):
-            raise AnalysisError("set operations are not supported yet")
-        rp, names, alias_syms, pre_scope = self.plan_select(q.select, outer, ctes)
+            rp, names = self._plan_setop(q.select, outer, ctes)
+            alias_syms = {
+                n.lower(): f.symbol
+                for n, f in zip(names, rp.scope.fields)
+            }
+        else:
+            rp, names, alias_syms, pre_scope = self.plan_select(
+                q.select, outer, ctes
+            )
         node = rp.node
         if q.order_by:
             keys, node = self._order_keys(q.order_by, node, rp.scope, alias_syms)
@@ -146,6 +153,133 @@ class Analyzer:
                 count=q.limit if q.limit is not None else -1, offset=q.offset or 0
             )
         return RelationPlan(node, rp.scope), names
+
+    # ---- set operations --------------------------------------------------
+    def _plan_setop(
+        self, so: ast.SetOp, outer: Scope | None, ctes: dict
+    ) -> tuple[RelationPlan, list[str]]:
+        """UNION [ALL] / INTERSECT / EXCEPT (reference: SetOperationNode
+        family, MAIN/sql/planner/plan/UnionNode.java; the engine plans
+        UNION ALL as concatenation, distinct set semantics as a
+        group-by above it, and INTERSECT/EXCEPT as a side-marker column
+        + per-group min/max filter — the TPU-friendly form of the
+        reference's SetOperator ChannelSet.)"""
+        lrp, lnames = self._plan_setop_side(so.left, outer, ctes)
+        rrp, rnames = self._plan_setop_side(so.right, outer, ctes)
+        lsyms = list(lrp.node.outputs)
+        rsyms = list(rrp.node.outputs)
+        if len(lsyms) != len(rsyms):
+            raise AnalysisError(
+                f"{so.op.upper()} branch column counts differ: "
+                f"{len(lsyms)} vs {len(rsyms)}"
+            )
+        if so.all and so.op != "union":
+            raise AnalysisError(
+                f"{so.op.upper()} ALL is not supported yet"
+            )
+        common = [
+            T.common_super_type(lrp.node.outputs[a], rrp.node.outputs[b])
+            for a, b in zip(lsyms, rsyms)
+        ]
+        marker = None if so.op == "union" else True
+        lnode, lsyms = self._coerce_branch(lrp.node, lsyms, common, 0, marker)
+        rnode, rsyms = self._coerce_branch(rrp.node, rsyms, common, 1, marker)
+        out_types = list(common) + ([T.BIGINT] if marker else [])
+        out_names = list(lnames) + (["$side"] if marker else [])
+        out_syms = [
+            self.symbols.new(n or "col", t)
+            for n, t in zip(out_names, out_types)
+        ]
+        node = P.Union(
+            dict(zip(out_syms, out_types)),
+            all_sources=[lnode, rnode],
+            symbol_map={
+                s: [a, b]
+                for s, a, b in zip(out_syms, lsyms, rsyms)
+            },
+        )
+        value_syms = out_syms[: len(common)]
+        if so.op == "union":
+            if not so.all:
+                node = P.Aggregate(
+                    dict(node.outputs), source=node,
+                    group_keys=list(node.outputs), aggregates={},
+                )
+        else:
+            side = out_syms[-1]
+            mn = self.symbols.new("side_min", T.BIGINT)
+            mx = self.symbols.new("side_max", T.BIGINT)
+            aggs = {
+                mn: AggCall("min", (InputRef(T.BIGINT, side),), T.BIGINT),
+                mx: AggCall("max", (InputRef(T.BIGINT, side),), T.BIGINT),
+            }
+            outputs = {s: t for s, t in zip(value_syms, common)}
+            node = P.Aggregate(
+                {**outputs, mn: T.BIGINT, mx: T.BIGINT}, source=node,
+                group_keys=value_syms, aggregates=aggs,
+            )
+            if so.op == "intersect":
+                pred = Call(
+                    T.BOOLEAN, "and", (
+                        Call(T.BOOLEAN, "eq", (
+                            InputRef(T.BIGINT, mn), Literal(T.BIGINT, 0),
+                        )),
+                        Call(T.BOOLEAN, "eq", (
+                            InputRef(T.BIGINT, mx), Literal(T.BIGINT, 1),
+                        )),
+                    ),
+                )
+            else:  # except: present in left (0), absent from right (1)
+                pred = Call(
+                    T.BOOLEAN, "eq", (
+                        InputRef(T.BIGINT, mx), Literal(T.BIGINT, 0),
+                    ),
+                )
+            node = P.Filter(dict(node.outputs), source=node, predicate=pred)
+            node = P.Project(
+                dict(outputs), source=node,
+                assignments={
+                    s: InputRef(t, s) for s, t in outputs.items()
+                },
+            )
+        fields = [
+            Field((n or "").lower(), s, t)
+            for n, s, t in zip(lnames, value_syms, common)
+        ]
+        return RelationPlan(node, Scope(fields, parent=outer)), list(lnames)
+
+    def _plan_setop_side(self, side, outer, ctes):
+        if isinstance(side, ast.SetOp):
+            return self._plan_setop(side, outer, ctes)
+        if isinstance(side, ast.Query):
+            return self.plan_query(side, outer, ctes)
+        rp, names, _alias, _pre = self.plan_select(side, outer, ctes)
+        return rp, names
+
+    def _coerce_branch(self, node, syms, common, side_idx, marker):
+        """Cast a branch's columns to the common types and append the
+        side marker when set difference/intersection needs one."""
+        need = any(
+            node.outputs[s] != t for s, t in zip(syms, common)
+        ) or marker is not None
+        if not need:
+            return node, syms
+        assignments = {}
+        out_syms = []
+        for s, t in zip(syms, common):
+            ir = _cast_to(InputRef(node.outputs[s], s), t)
+            s2 = s if node.outputs[s] == t else self.symbols.new("cast", t)
+            assignments[s2] = ir
+            out_syms.append(s2)
+        if marker is not None:
+            ms = self.symbols.new("side", T.BIGINT)
+            assignments[ms] = Literal(T.BIGINT, side_idx)
+            out_syms.append(ms)
+        node = P.Project(
+            {s: e.type for s, e in assignments.items()},
+            source=node, assignments=assignments,
+        )
+        return node, out_syms
 
     def _order_keys(self, order_by, node, scope: Scope, alias_syms: dict):
         keys = []
@@ -205,6 +339,15 @@ class Analyzer:
             node, scope = self._apply_where(
                 node, scope, sel.having, ctes, outer_refs,
                 replacements=replacements, restrict_to=group_syms or None,
+            )
+
+        # window functions (evaluate after aggregation/HAVING)
+        win_items = self._collect_windows(sel)
+        win_syms: list[str] = []
+        if win_items:
+            node, win_syms = self._plan_windows(
+                node, scope, win_items, outer_refs, replacements,
+                group_syms if (sel.group_by or agg_items) else None,
             )
 
         # SELECT items
@@ -450,6 +593,17 @@ class Analyzer:
         seen: set[str] = set()
 
         def walk(e):
+            if isinstance(e, ast.FnCall) and e.over is not None:
+                # a window call is not an aggregate — but aggregates may
+                # appear in its arguments / window spec (evaluated
+                # before the window, per SQL semantics)
+                for a in e.args:
+                    walk(a)
+                for p in e.over.partition_by:
+                    walk(p)
+                for oi in e.over.order_by:
+                    walk(oi.expr)
+                return
             if isinstance(e, ast.FnCall) and (
                 e.name.lower() in AGG_FNS or e.star
             ):
@@ -478,6 +632,133 @@ class Analyzer:
         if sel.having is not None:
             walk(sel.having)
         return found
+
+    # ---- window functions ------------------------------------------------
+
+    WINDOW_ONLY_FNS = {
+        "row_number", "rank", "dense_rank", "ntile", "lead", "lag",
+        "first_value", "last_value",
+    }
+
+    def _collect_windows(self, sel: ast.Select) -> list[ast.FnCall]:
+        found: list[ast.FnCall] = []
+        seen: set[str] = set()
+
+        def walk(e):
+            if isinstance(e, ast.FnCall) and e.over is not None:
+                k = _ast_key(e)
+                if k not in seen:
+                    seen.add(k)
+                    found.append(e)
+                return
+            if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+                return
+            for v in vars(e).values() if hasattr(e, "__dict__") else []:
+                if isinstance(v, ast.Expr):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if isinstance(x, ast.Expr):
+                            walk(x)
+                        elif isinstance(x, tuple):
+                            # CASE branches are list[tuple[Expr, Expr]]
+                            for y in x:
+                                if isinstance(y, ast.Expr):
+                                    walk(y)
+
+        for item in sel.items:
+            if not isinstance(item.expr, ast.Star):
+                walk(item.expr)
+        return found
+
+    def _plan_windows(
+        self, node, scope, win_items, outer_refs, replacements, restrict
+    ):
+        """Plan Window nodes (one per distinct window specification),
+        the analog of the reference's WindowNode planning in
+        QueryPlanner.window (MAIN/sql/planner/QueryPlanner.java)."""
+        buckets: dict[str, list[ast.FnCall]] = {}
+        for fc in win_items:
+            buckets.setdefault(repr(fc.over), []).append(fc)
+        new_syms: list[str] = []
+        for fcs in buckets.values():
+            over = fcs[0].over
+            ea = ExprAnalyzer(
+                self, scope, replacements=replacements,
+                restrict_to=restrict, outer_refs=outer_refs,
+            )
+            pre = {s: InputRef(t, s) for s, t in node.outputs.items()}
+            need_pre = [False]
+
+            def to_ref(e) -> InputRef:
+                ir = ea.analyze(e)
+                if isinstance(ir, InputRef):
+                    return ir
+                sym = self.symbols.new("w", ir.type)
+                pre[sym] = ir
+                need_pre[0] = True
+                return InputRef(ir.type, sym)
+
+            def to_arg(e):
+                # literal arguments (lead/lag offsets, ntile buckets,
+                # defaults) stay literals for the executor
+                ir = ea.analyze(e)
+                if isinstance(ir, (InputRef, Literal)):
+                    return ir
+                return to_ref(e)
+
+            part_syms = [to_ref(p).name for p in over.partition_by]
+            order_keys = []
+            for oi in over.order_by:
+                r = to_ref(oi.expr)
+                order_keys.append(
+                    P.SortKey(r.name, oi.ascending, oi.nulls_first)
+                )
+            fns: dict[str, P.WindowCall] = {}
+            for fc in fcs:
+                name = fc.name.lower()
+                args = tuple(to_arg(a) for a in fc.args)
+                frame = (
+                    (over.frame.mode, over.frame.start, over.frame.end)
+                    if over.frame is not None else None
+                )
+                if fc.star:
+                    call = P.WindowCall("count_all", (), T.BIGINT, frame)
+                elif name in ("row_number", "rank", "dense_rank", "ntile"):
+                    if name != "ntile" and args:
+                        raise AnalysisError(f"{name}() takes no arguments")
+                    call = P.WindowCall(name, args, T.BIGINT, frame)
+                elif name in ("lead", "lag", "first_value", "last_value"):
+                    if not args:
+                        raise AnalysisError(f"{name} requires an argument")
+                    call = P.WindowCall(name, args, args[0].type, frame)
+                elif name == "count":
+                    call = P.WindowCall("count", args, T.BIGINT, frame)
+                elif name in AGG_FNS:
+                    rt = agg_result_type(
+                        name, args[0].type if args else None
+                    )
+                    call = P.WindowCall(name, args, rt, frame)
+                else:
+                    raise AnalysisError(
+                        f"{name} is not a window function"
+                    )
+                sym = self.symbols.new(name, call.type)
+                fns[sym] = call
+                new_syms.append(sym)
+                replacements[_ast_key(fc)] = InputRef(call.type, sym)
+            if need_pre[0]:
+                node = P.Project(
+                    {s: e.type for s, e in pre.items()},
+                    source=node, assignments=pre,
+                )
+            outputs = dict(node.outputs)
+            outputs.update({s: c.type for s, c in fns.items()})
+            node = P.Window(
+                outputs, source=node, partition_by=part_syms,
+                order_keys=order_keys, functions=fns,
+            )
+        return node, new_syms
 
     def _plan_aggregation(self, node, scope, sel, agg_items, ctes, outer_refs):
         # group keys

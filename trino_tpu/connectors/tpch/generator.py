@@ -16,6 +16,7 @@ cached, so projection pushdown avoids materializing unused text.
 
 from __future__ import annotations
 
+import os
 import zlib
 
 import numpy as np
@@ -139,13 +140,50 @@ class TpchData:
             name = name[len(prefix):]
         key = (table, name)
         if key not in self._cache:
-            gen = getattr(self, f"_{table}_{name}", None)
-            if gen is None:
-                raise KeyError(f"no column {table}.{name}")
-            arr = gen()
+            arr = self._disk_load(table, name)
+            if arr is None:
+                gen = getattr(self, f"_{table}_{name}", None)
+                if gen is None:
+                    raise KeyError(f"no column {table}.{name}")
+                arr = gen()
+                self._disk_store(table, name, arr)
             arr.setflags(write=False)  # cached arrays are shared with scans
             self._cache[key] = arr
         return self._cache[key]
+
+    # Generated columns are deterministic functions of (sf, table,
+    # column), so a host disk cache is safe and makes a fresh process
+    # at SF>=1 start in seconds instead of minutes (the reference pays
+    # the same cost once per JVM via its in-process tpch generator).
+    def _disk_path(self, table: str, name: str) -> str | None:
+        root = os.environ.get(
+            "TRINO_TPU_DATA_CACHE",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                ))),
+                ".tpch_cache",
+            ),
+        )
+        if root == "off" or self.sf < 0.5:
+            return None
+        return os.path.join(root, f"sf{self.sf:g}_{table}_{name}.npy")
+
+    def _disk_load(self, table: str, name: str) -> np.ndarray | None:
+        path = self._disk_path(table, name)
+        if path is None or not os.path.exists(path):
+            return None
+        return np.load(path, allow_pickle=True)
+
+    def _disk_store(self, table: str, name: str, arr: np.ndarray) -> None:
+        path = self._disk_path(table, name)
+        if path is None:
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # np.save appends .npy unless the name already ends with it
+        tmp = f"{path[:-4]}.tmp{os.getpid()}.npy"
+        np.save(tmp, arr, allow_pickle=True)
+        os.replace(tmp, path)
 
     def table(self, table: str) -> dict[str, np.ndarray]:
         return {c: self.column(table, c) for c in SCHEMAS[table].column_names}

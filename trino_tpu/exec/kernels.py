@@ -32,6 +32,7 @@ import numpy as np
 
 __all__ = [
     "hash_columns",
+    "searchsorted",
     "normalize_key",
     "GroupInfo",
     "sort_group",
@@ -84,6 +85,16 @@ def _to_bits(data: jnp.ndarray) -> jnp.ndarray:
     if data.dtype == jnp.bool_:
         return data.astype(jnp.uint64)
     return data.astype(jnp.uint64)
+
+
+def searchsorted(a: jnp.ndarray, v: jnp.ndarray, side: str = "left") -> jnp.ndarray:
+    """searchsorted with a TPU-friendly method choice: the default
+    binary-search 'scan' lowers to ~log2(n) serialized gather rounds
+    over every query (measured 1.7 s for 4M queries on v5e); the
+    'sort' method is one argsort of queries+haystack (~0.06 s). Shapes
+    are static under jit, so the choice is made at trace time."""
+    method = "sort" if v.size > 16384 else "scan"
+    return jnp.searchsorted(a, v, side=side, method=method)
 
 
 def normalize_key(data: jnp.ndarray, valid: jnp.ndarray | None):
@@ -143,6 +154,7 @@ def sort_group(
     live: jnp.ndarray,
     capacity: int,
     widths: tuple[int, ...] | None = None,
+    pre_perm: jnp.ndarray | None = None,
 ) -> GroupInfo:
     """Exact multi-key grouping by lexsort + boundary cumsum.
 
@@ -163,14 +175,24 @@ def sort_group(
     n = live.shape[0]
     packed, live_folded = _pack_keys(norm_bits, null_flags, live, widths)
     if packed is not None:
-        perm = jnp.argsort(packed, stable=True).astype(jnp.int32)
+        if pre_perm is None:
+            perm = jnp.argsort(packed, stable=True).astype(jnp.int32)
+        else:
+            # stable sort preserves the caller's row order within each
+            # group (window functions: order-by within partition)
+            perm = pre_perm[
+                jnp.argsort(packed[pre_perm], stable=True)
+            ].astype(jnp.int32)
         if not live_folded:
             perm = perm[jnp.argsort((~live)[perm], stable=True)]
         ps = packed[perm]
         live_s = live[perm]
         same = ps == jnp.roll(ps, 1)
     else:
-        perm = jnp.arange(n, dtype=jnp.int32)
+        perm = (
+            jnp.arange(n, dtype=jnp.int32)
+            if pre_perm is None else pre_perm.astype(jnp.int32)
+        )
         for bits, flag in reversed(list(zip(norm_bits, null_flags))):
             perm = perm[jnp.argsort(bits[perm], stable=True)]
             if flag is not None:
@@ -194,8 +216,8 @@ def sort_group(
     inv = jnp.argsort(perm, stable=True)  # inverse permutation
     group = gid_sorted[inv]
     sids = jnp.arange(capacity, dtype=jnp.int32)
-    starts = jnp.searchsorted(gid_sorted, sids, side="left").astype(jnp.int32)
-    ends = jnp.searchsorted(gid_sorted, sids, side="right").astype(jnp.int32)
+    starts = searchsorted(gid_sorted, sids, side="left").astype(jnp.int32)
+    ends = searchsorted(gid_sorted, sids, side="right").astype(jnp.int32)
     owner = jnp.where(
         sids < num_groups, perm[jnp.clip(starts, 0, max(n - 1, 0))], n
     ).astype(jnp.int32)
@@ -321,6 +343,15 @@ def seg_first_index(contrib_sorted, info: GroupInfo):
     return jnp.where(has, rows, n), has
 
 
+def blocked_sum(x: jnp.ndarray) -> jnp.ndarray:
+    """Scalar int64 sum via a two-stage blocked reduce (same AOT
+    compiler workaround as count_true)."""
+    v = x.astype(jnp.int64)
+    n = v.shape[0]
+    block = 256 if n % 256 == 0 else n
+    return v.reshape(-1, block).sum(axis=1).sum()
+
+
 def count_true(mask: jnp.ndarray) -> jnp.ndarray:
     """Scalar count of True values via a two-stage blocked reduce.
 
@@ -355,7 +386,7 @@ def scatter_any(idx: jnp.ndarray, flags: jnp.ndarray, capacity: int) -> jnp.ndar
     key = jnp.where(flags, idx, capacity).astype(jnp.int32)
     ks = jnp.sort(key)
     targets = jnp.arange(capacity, dtype=jnp.int32)
-    pos = jnp.searchsorted(ks, targets, side="left")
+    pos = searchsorted(ks, targets, side="left")
     at = jnp.clip(pos, 0, max(ks.shape[0] - 1, 0))
     return (pos < ks.shape[0]) & (ks[at] == targets)
 
@@ -453,8 +484,8 @@ def join_ranges(
     sorted_key = jnp.where(
         pos < n_build_live, build_key[order], jnp.uint64(0xFFFFFFFFFFFFFFFF)
     )
-    lo = jnp.searchsorted(sorted_key, probe_key, side="left")
-    hi = jnp.searchsorted(sorted_key, probe_key, side="right")
+    lo = searchsorted(sorted_key, probe_key, side="left")
+    hi = searchsorted(sorted_key, probe_key, side="right")
     lo = jnp.minimum(lo, n_build_live)
     hi = jnp.minimum(hi, n_build_live)
     cnt = jnp.where(probe_live, hi - lo, 0)
@@ -479,7 +510,7 @@ def expand_matches(
     offsets = jnp.cumsum(cnt)  # inclusive
     total = offsets[-1] if cnt.shape[0] else jnp.int32(0)
     j = jnp.arange(out_capacity, dtype=jnp.int32)
-    probe_idx = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+    probe_idx = searchsorted(offsets, j, side="right").astype(jnp.int32)
     probe_c = jnp.clip(probe_idx, 0, cnt.shape[0] - 1)
     start = offsets[probe_c] - cnt[probe_c]
     k = j - start

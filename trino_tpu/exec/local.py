@@ -21,7 +21,7 @@ from trino_tpu.exec import kernels as K
 from trino_tpu.exec import stage
 from trino_tpu.exec.aggregates import compute_aggregate
 from trino_tpu.expr.compiler import ColumnLayout, compile_expr
-from trino_tpu.expr.ir import AggCall, RowExpression
+from trino_tpu.expr.ir import AggCall, Call, Cast, InputRef, RowExpression
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.page import Column, Page, pad_capacity, unify_dictionaries
 from trino_tpu.plan import nodes as P
@@ -93,9 +93,18 @@ class LocalExecutor:
         """Run a fused operator chain: one jitted program, one dispatch.
 
         Grouped aggregations retry with 8x larger slot tables when the
-        returned overflow flag trips (rare: only when the group count
-        exceeds capacity/2 of the initial guess)."""
-        caps = stage.plan_capacities(chain, page.capacity)
+        returned overflow flag trips; the learned capacity persists per
+        chain shape so repeated queries never re-overflow (capacity
+        hysteresis — the FlatHash table survives across pages in the
+        reference, MAIN/operator/FlatHash.java:316)."""
+        caps_key = (
+            "caps", tuple(self._node_key(n) for n in chain), page.capacity
+        )
+        learned = self._jit_cache.get(caps_key)
+        if learned is not None:
+            caps = {i: list(v) for i, v in learned.items()}
+        else:
+            caps = stage.plan_capacities(chain, page.capacity)
         while True:
             key = (
                 "chain",
@@ -140,6 +149,9 @@ class LocalExecutor:
                                 "aggregation table overflow at max capacity"
                             )
                         caps[i][0] = min(cap * 8, mx)
+                    self._jit_cache[caps_key] = {
+                        i: list(v) for i, v in caps.items()
+                    }
                     continue
             cols = [
                 Column(
@@ -304,148 +316,327 @@ class LocalExecutor:
         # callers (_Join) hand in already-compacted pages
         n_l, n_r = left.num_rows(), right.num_rows()
         cap = pad_capacity(max(n_l * n_r, 1))
-        j = jnp.arange(cap)
-        li = jnp.clip(j // max(n_r, 1), 0, max(left.capacity - 1, 0))
-        ri = jnp.clip(j % max(n_r, 1), 0, max(right.capacity - 1, 0))
-        out_live = j < n_l * n_r
+        key = (
+            "cross", n_l, n_r,
+            self._layout_sig(left), self._layout_sig(right),
+        )
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            l_cap, r_cap = left.capacity, right.capacity
+            lnames, rnames = list(left.names), list(right.names)
+
+            def fx(lenv, renv):
+                j = jnp.arange(cap)
+                li = jnp.clip(j // max(n_r, 1), 0, max(l_cap - 1, 0))
+                ri = jnp.clip(j % max(n_r, 1), 0, max(r_cap - 1, 0))
+                out_live = j < n_l * n_r
+                env2 = {}
+                for names, env, idx in (
+                    (lnames, lenv, li), (rnames, renv, ri)
+                ):
+                    for nm in names:
+                        d, v = env[nm]
+                        env2[nm] = (d[idx], None if v is None else v[idx])
+                return env2, out_live
+
+            fn = jax.jit(fx)
+            self._jit_cache[key] = fn
+        env2, mask = fn(self._env(left), self._env(right))
         names, cols = [], []
-        for page, idx in ((left, li), (right, ri)):
-            for n, c in zip(page.names, page.columns):
-                names.append(n)
-                cols.append(
-                    Column(
-                        c.type,
-                        c.data[idx],
-                        None if c.valid is None else c.valid[idx],
-                        c.dictionary,
-                    )
-                )
-        return Page(names, cols, out_live)
+        for page in (left, right):
+            for nm, c in zip(page.names, page.columns):
+                names.append(nm)
+                cols.append(Column(c.type, *env2[nm], c.dictionary))
+        out = Page(names, cols, mask)
+        out.known_rows = n_l * n_r
+        out.packed = True
+        return out
 
-    def _join_key(self, probe: Page, build: Page, criteria):
-        """Combined uint64 keys for probe/build sides.
-
-        Single fixed-width key -> exact; multi-column -> hash-combined
-        and ``verify`` is True (matches re-checked after expansion).
-        """
-        pairs = []
+    def _unify_join_dicts(self, probe: Page, build: Page, criteria):
+        """Remap VARCHAR key pairs onto shared dictionaries (host-side
+        dictionary union + one device gather per remapped column)."""
         for lsym, rsym in criteria:
             pc, bc = probe.column(lsym), build.column(rsym)
             if pc.dictionary is not None or bc.dictionary is not None:
                 pc2, bc2 = unify_dictionaries(pc, bc)
                 probe.columns[probe.names.index(lsym)] = pc2
                 build.columns[build.names.index(rsym)] = bc2
-                pc, bc = pc2, bc2
-            pairs.append((pc, bc))
-        probe_valid = None
-        build_valid = None
-        for pc, bc in pairs:
-            probe_valid = _and_mask(probe_valid, pc.valid)
-            build_valid = _and_mask(build_valid, bc.valid)
+
+    @staticmethod
+    def _traced_join_keys(penv, benv, criteria):
+        """Combined uint64 keys for probe/build sides from traced envs.
+
+        Single fixed-width key -> exact; multi-column -> hash-combined
+        and ``verify`` is True (matches re-checked after expansion).
+        """
+        pairs = [(penv[l], benv[r]) for l, r in criteria]
+        pv = bv = None
+        for (pd, pvd), (bd, bvd) in pairs:
+            pv = _and_mask(pv, pvd)
+            bv = _and_mask(bv, bvd)
         if len(pairs) == 1:
-            pk, _ = K.normalize_key(pairs[0][0].data, None)
-            bk, _ = K.normalize_key(pairs[0][1].data, None)
+            pk, _ = K.normalize_key(pairs[0][0][0], None)
+            bk, _ = K.normalize_key(pairs[0][1][0], None)
             verify = False
         else:
-            pk = K.hash_columns([(c.data, None) for c, _ in pairs])
-            bk = K.hash_columns([(c.data, None) for _, c in pairs])
+            pk = K.hash_columns([(pd, None) for (pd, _), _ in pairs])
+            bk = K.hash_columns([(bd, None) for _, (bd, _) in pairs])
             verify = True
-        return pk, bk, probe_valid, build_valid, pairs, verify
+        return pk, bk, pv, bv, pairs, verify
+
+    def _join_count(self, criteria, probe: Page, build: Page):
+        """Join phase A: sorted build order + per-probe match ranges +
+        total match count — ONE jitted program, one host sync (the
+        output-capacity decision, the reference's build-side barrier).
+        """
+        key = (
+            "joinA", tuple(criteria),
+            self._layout_sig(probe), self._layout_sig(build),
+        )
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            crit = list(criteria)
+
+            def fa(penv, pmask, benv, bmask):
+                pk, bk, pv, bv, _, _ = self._traced_join_keys(
+                    penv, benv, crit
+                )
+                probe_live = pmask if pv is None else (pmask & pv)
+                build_live = bmask if bv is None else (bmask & bv)
+                order, lo, cnt = K.join_ranges(
+                    bk, build_live, pk, probe_live
+                )
+                return order, lo, cnt, K.blocked_sum(cnt)
+
+            fn = jax.jit(fa)
+            self._jit_cache[key] = fn
+        order, lo, cnt, total_dev = fn(
+            self._env(probe), probe.mask, self._env(build), build.mask
+        )
+        return order, lo, cnt, int(jax.device_get(total_dev))
 
     def _equi_join(self, node: P.Join, probe: Page, build: Page) -> Page:
         if not node.criteria:
             raise NotImplementedError(f"{node.kind} join without equi criteria")
-        pk, bk, pv, bv, pairs, verify = self._join_key(
-            probe, build, node.criteria
-        )
-        probe_live = probe.mask if pv is None else (probe.mask & pv)
-        build_live = build.mask if bv is None else (build.mask & bv)
-        order, lo, cnt = K.join_ranges(bk, build_live, pk, probe_live)
-        total = int(jnp.sum(cnt))
+        self._unify_join_dicts(probe, build, node.criteria)
+        order, lo, cnt, total = self._join_count(node.criteria, probe, build)
         out_cap = pad_capacity(max(total, 1))
-        probe_idx, build_idx, out_live = K.expand_matches(
-            order, lo, cnt, out_cap
+        key = (
+            "joinB", node.kind, tuple(node.criteria), tuple(node.outputs),
+            repr(node.filter), out_cap,
+            self._layout_sig(probe), self._layout_sig(build),
         )
-        exact = not verify
-        if verify:
-            out_live = _verify_matches(pairs, probe_idx, build_idx, out_live)
-
-        inner = self._gather_join_columns(
-            node, probe, build, probe_idx, build_idx, out_live
+        hit = self._jit_cache.get(key)
+        if hit is None:
+            hit = self._build_join_expand(node, probe, build, out_cap)
+            self._jit_cache[key] = hit
+        fn, out_meta = hit
+        env2, mask2 = fn(
+            self._env(probe), probe.mask, self._env(build), build.mask,
+            order, lo, cnt,
         )
-        if exact and node.filter is None:
-            # the expansion emits matches as a dense prefix of length
-            # ``total`` — record it so downstream never re-syncs
-            inner.known_rows = total
-            inner.packed = True
-        if node.filter is not None:
-            fd, fv, _ = self._eval(inner, node.filter)
-            out_live = inner.mask & (fd if fv is None else (fd & fv))
-            inner = Page(inner.names, inner.columns, out_live)
-        if node.kind == "inner":
-            return inner
-        if node.kind in ("left", "full"):
-            matched = K.range_any(cnt, inner.mask)
-            unmatched = probe.mask & ~matched
-            out = self._append_outer_rows(node, inner, probe, unmatched, side="probe")
-            if node.kind == "full":
-                bmatched = K.scatter_any(
-                    build_idx, inner.mask, build.capacity
-                )
-                bunmatched = build.mask & ~bmatched
-                out = self._append_outer_rows(node, out, build, bunmatched, side="build")
-            return out
-        raise NotImplementedError(f"join kind {node.kind}")
+        cols = [
+            Column(t, *env2[s], d) for s, _fp, t, d in out_meta
+        ]
+        out = Page([s for s, *_ in out_meta], cols, mask2)
+        if (
+            node.kind == "inner"
+            and node.filter is None
+            and len(node.criteria) == 1
+        ):
+            # exact single-key expansion emits matches as a dense
+            # prefix of length ``total`` — downstream never re-syncs
+            out.known_rows = total
+            out.packed = True
+        return out
 
-    def _gather_join_columns(
-        self, node: P.Join, probe: Page, build: Page, probe_idx, build_idx, out_live
-    ) -> Page:
-        names, cols = [], []
+    def _build_join_expand(self, node: P.Join, probe: Page, build: Page, out_cap: int):
+        """Join phase B: expansion, verification, output gathers,
+        residual filter, and outer-row sections — ONE jitted program.
+        Outer (left/full) unmatched rows are emitted as extra full-size
+        sections with NULLs for the far side, exactly like the mesh
+        executor — no data-dependent capacity, no extra sync."""
+        criteria = list(node.criteria)
+        kind = node.kind
+        p_cap, b_cap = probe.capacity, build.capacity
+        out_meta = []  # (sym, from_probe, type, dictionary)
         for sym in node.outputs:
-            if sym in probe.names:
-                c, idx = probe.column(sym), probe_idx
-            else:
-                c, idx = build.column(sym), build_idx
-            names.append(sym)
-            cols.append(
-                Column(
-                    c.type,
-                    c.data[idx],
-                    None if c.valid is None else c.valid[idx],
-                    c.dictionary,
-                )
-            )
-        return Page(names, cols, out_live)
+            from_probe = sym in probe.names
+            c = (probe if from_probe else build).column(sym)
+            out_meta.append((sym, from_probe, c.type, c.dictionary))
+        filter_c = None
+        fsyms: list[str] = []
+        if node.filter is not None:
+            filter_c = compile_expr(node.filter, _pair_layout(probe, build))
+            fsyms = sorted(_expr_symbols(node.filter))
+        probe_names = set(probe.names)
 
-    def _append_outer_rows(
-        self, node: P.Join, inner: Page, side_page: Page, unmatched, side: str
-    ) -> Page:
-        """Append unmatched outer rows with NULLs for the other side."""
-        n_un = int(jnp.sum(unmatched))
-        if n_un == 0:
-            return inner
-        perm = jnp.argsort(~unmatched, stable=True)
-        cap2 = pad_capacity(n_un)
-        idx = perm[:cap2]
-        sec_live = jnp.arange(cap2) < n_un
-        names, cols = [], []
-        for sym, c_in in zip(inner.names, inner.columns):
-            if sym in side_page.names:
-                c = side_page.column(sym)
-                data2 = c.data[idx]
-                valid2 = sec_live if c.valid is None else (c.valid[idx] & sec_live)
-            else:
-                data2 = jnp.zeros((cap2,), dtype=c_in.type.np_dtype)
-                valid2 = jnp.zeros((cap2,), dtype=jnp.bool_)
-            data = jnp.concatenate([c_in.data, data2])
-            v1 = c_in.valid
-            if v1 is None and valid2 is not None:
-                v1 = jnp.ones((inner.capacity,), dtype=jnp.bool_)
-            valid = None if v1 is None else jnp.concatenate([v1, valid2])
+        def fb(penv, pmask, benv, bmask, order, lo, cnt):
+            pk, bk, pv, bv, pairs, verify = self._traced_join_keys(
+                penv, benv, criteria
+            )
+            probe_idx, build_idx, out_live = K.expand_matches(
+                order, lo, cnt, out_cap
+            )
+            if verify:
+                for (pd, _), (bd, _) in pairs:
+                    pb, _ = K.normalize_key(pd, None)
+                    bb, _ = K.normalize_key(bd, None)
+                    out_live = out_live & (pb[probe_idx] == bb[build_idx])
+            inner = {}
+            for sym, from_probe, _t, _d in out_meta:
+                d, v = (penv if from_probe else benv)[sym]
+                idx = probe_idx if from_probe else build_idx
+                inner[sym] = (d[idx], None if v is None else v[idx])
+            if filter_c is not None:
+                fenv = _gather_pair_env(
+                    penv, benv, probe_names, fsyms,
+                    probe_idx, build_idx, base=inner,
+                )
+                fd, fv = filter_c.fn(fenv)
+                out_live = out_live & (fd if fv is None else (fd & fv))
+            sections = {sym: [inner[sym]] for sym, *_ in out_meta}
+            masks = [out_live]
+            if kind in ("left", "full"):
+                matched = K.range_any(cnt, out_live)
+                unmatched = pmask & ~matched
+                for sym, from_probe, _t, _d in out_meta:
+                    if from_probe:
+                        sections[sym].append(penv[sym])
+                    else:
+                        d0, _ = benv[sym]
+                        sections[sym].append((
+                            jnp.zeros((p_cap,), dtype=d0.dtype),
+                            jnp.zeros((p_cap,), dtype=jnp.bool_),
+                        ))
+                masks.append(unmatched)
+            if kind == "full":
+                bmatched = K.scatter_any(build_idx, out_live, b_cap)
+                bunmatched = bmask & ~bmatched
+                for sym, from_probe, _t, _d in out_meta:
+                    if from_probe:
+                        d0, _ = penv[sym]
+                        sections[sym].append((
+                            jnp.zeros((b_cap,), dtype=d0.dtype),
+                            jnp.zeros((b_cap,), dtype=jnp.bool_),
+                        ))
+                    else:
+                        sections[sym].append(benv[sym])
+                masks.append(bunmatched)
+            env2 = {}
+            for sym, *_ in out_meta:
+                env2[sym] = _concat_sections(sections[sym])
+            mask2 = masks[0] if len(masks) == 1 else jnp.concatenate(masks)
+            return env2, mask2
+
+        return jax.jit(fb), out_meta
+
+    def _build_semi_expand(self, node: P.SemiJoin, source: Page, filt: Page, out_cap: int):
+        """Semi-join expansion phase: verify hash-combined matches and
+        apply the correlated residual filter, then reduce per-probe —
+        ONE jitted program returning the match vector."""
+        criteria = list(node.keys)
+        filter_c = None
+        fsyms: list[str] = []
+        if node.filter is not None:
+            filter_c = compile_expr(node.filter, _pair_layout(source, filt))
+            fsyms = sorted(_expr_symbols(node.filter))
+        probe_names = set(source.names)
+
+        def fb(penv, benv, order, lo, cnt):
+            pk, bk, pv, bv, pairs, _verify = self._traced_join_keys(
+                penv, benv, criteria
+            )
+            probe_idx, build_idx, out_live = K.expand_matches(
+                order, lo, cnt, out_cap
+            )
+            for (pd, _), (bd, _) in pairs:
+                pb, _ = K.normalize_key(pd, None)
+                bb, _ = K.normalize_key(bd, None)
+                out_live = out_live & (pb[probe_idx] == bb[build_idx])
+            if filter_c is not None:
+                fenv = _gather_pair_env(
+                    penv, benv, probe_names, fsyms, probe_idx, build_idx
+                )
+                fd, fv = filter_c.fn(fenv)
+                out_live = out_live & (fd if fv is None else (fd & fv))
+            return K.range_any(cnt, out_live)
+
+        return jax.jit(fb)
+
+    # ---- window / set operations -----------------------------------------
+
+    def _Window(self, node: P.Window) -> Page:
+        from trino_tpu.exec.window import build_window_program
+
+        page = self.execute(node.source)
+        key = (
+            "window", tuple(node.partition_by),
+            tuple(
+                (k.symbol, k.ascending, k.nulls_first)
+                for k in node.order_keys
+            ),
+            tuple(
+                (s, c.name, repr(c.args), repr(c.frame))
+                for s, c in node.functions.items()
+            ),
+            self._layout_sig(page),
+        )
+        hit = self._jit_cache.get(key)
+        if hit is None:
+            types = {n: c.type for n, c in zip(page.names, page.columns)}
+            dicts = {
+                n: c.dictionary for n, c in zip(page.names, page.columns)
+            }
+            fn, out_meta = build_window_program(
+                node, types, dicts, page.capacity
+            )
+            hit = (jax.jit(fn), out_meta)
+            self._jit_cache[key] = hit
+        fn, out_meta = hit
+        env2 = fn(self._env(page), page.mask)
+        names = list(page.names)
+        cols = list(page.columns)
+        for sym, t, d in out_meta:
             names.append(sym)
-            cols.append(Column(c_in.type, data, valid, c_in.dictionary))
-        mask = jnp.concatenate([inner.mask, sec_live])
-        return Page(names, cols, mask)
+            cols.append(Column(t, *env2[sym], d))
+        return Page(
+            names, cols, page.mask,
+            known_rows=page.known_rows, packed=page.packed,
+        )
+
+    def _Union(self, node: P.Union) -> Page:
+        from trino_tpu.page import StringDictionary, _remap
+
+        pages = [self.execute(s) for s in node.all_sources]
+        # unify dictionaries per output column across branches: one
+        # merged sorted dictionary, each branch remapped by gather
+        for sym, src_syms in node.symbol_map.items():
+            cols = [p.column(s) for p, s in zip(pages, src_syms)]
+            if any(c.dictionary is not None for c in cols):
+                merged = StringDictionary(np.unique(np.concatenate(
+                    [c.dictionary.values for c in cols]
+                )))
+                for p, s, c in zip(pages, src_syms, cols):
+                    remap = np.searchsorted(
+                        merged.values, c.dictionary.values
+                    ).astype(np.int32)
+                    p.columns[p.names.index(s)] = _remap(c, remap, merged)
+        names, cols = [], []
+        for sym, src_syms in node.symbol_map.items():
+            parts = [
+                (p.column(s).data, p.column(s).valid)
+                for p, s in zip(pages, src_syms)
+            ]
+            data, valid = _concat_sections(parts)
+            ref = pages[0].column(src_syms[0])
+            names.append(sym)
+            cols.append(Column(node.outputs[sym], data, valid, ref.dictionary))
+        mask = jnp.concatenate([p.mask for p in pages])
+        out = Page(names, cols, mask)
+        if all(p.known_rows is not None for p in pages):
+            out.known_rows = sum(p.known_rows for p in pages)
+        return out
 
     # ---- semi join -------------------------------------------------------
 
@@ -455,29 +646,53 @@ class LocalExecutor:
         return self._semi_join_pages(node, source, filt)
 
     def _semi_join_pages(self, node: P.SemiJoin, source: Page, filt: Page) -> Page:
-        pk, bk, pv, bv, pairs, verify = self._join_key(
-            source, filt, node.keys
-        )
-        probe_live = source.mask if pv is None else (source.mask & pv)
-        build_live = filt.mask if bv is None else (filt.mask & bv)
-        order, lo, cnt = K.join_ranges(bk, build_live, pk, probe_live)
-        if verify or node.filter is not None:
-            total = int(jnp.sum(cnt))
-            out_cap = pad_capacity(max(total, 1))
-            probe_idx, build_idx, out_live = K.expand_matches(
-                order, lo, cnt, out_cap
+        self._unify_join_dicts(source, filt, node.keys)
+        pv = bv = None
+        for lsym, rsym in node.keys:
+            pv = _and_mask(pv, source.column(lsym).valid)
+            bv = _and_mask(bv, filt.column(rsym).valid)
+        needs_expand = len(node.keys) > 1 or node.filter is not None
+        if needs_expand:
+            order, lo, cnt, total = self._join_count(
+                node.keys, source, filt
             )
-            out_live = _verify_matches(pairs, probe_idx, build_idx, out_live)
-            if node.filter is not None:
-                # residual correlated predicate over (source, filter) pairs
-                pair_page = self._gather_pair_page(
-                    source, filt, probe_idx, build_idx, out_live
-                )
-                fd, fv, _ = self._eval(pair_page, node.filter)
-                out_live = out_live & (fd if fv is None else (fd & fv))
-            matched = K.range_any(cnt, out_live)
+            out_cap = pad_capacity(max(total, 1))
+            key = (
+                "semiB", tuple(node.keys), repr(node.filter), out_cap,
+                self._layout_sig(source), self._layout_sig(filt),
+            )
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                fn = self._build_semi_expand(node, source, filt, out_cap)
+                self._jit_cache[key] = fn
+            matched = fn(
+                self._env(source), self._env(filt), order, lo, cnt
+            )
         else:
-            matched = cnt > 0
+            key = (
+                "semiA", tuple(node.keys),
+                self._layout_sig(source), self._layout_sig(filt),
+            )
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                crit = list(node.keys)
+
+                def fa(penv, pmask, benv, bmask):
+                    pk, bk, pv2, bv2, _, _ = self._traced_join_keys(
+                        penv, benv, crit
+                    )
+                    probe_live = pmask if pv2 is None else (pmask & pv2)
+                    build_live = bmask if bv2 is None else (bmask & bv2)
+                    _, _, cnt = K.join_ranges(
+                        bk, build_live, pk, probe_live
+                    )
+                    return cnt > 0
+
+                fn = jax.jit(fa)
+                self._jit_cache[key] = fn
+            matched = fn(
+                self._env(source), source.mask, self._env(filt), filt.mask
+            )
         valid = None
         if node.null_aware and filt.num_rows() == 0:
             # x IN (empty) is FALSE — even for NULL x (and NOT IN TRUE);
@@ -576,17 +791,60 @@ class LocalExecutor:
         return Page(names, cols, live)
 
 
-def _verify_matches(pairs, probe_idx, build_idx, out_live):
-    """Re-check hash-combined multi-column matches by exact key bits.
+def _pair_layout(a: Page, b: Page) -> ColumnLayout:
+    """Expression layout over the concatenated columns of two pages
+    (for residual join filters evaluated on matched pairs)."""
+    return ColumnLayout(
+        types={
+            **{n: c.type for n, c in zip(a.names, a.columns)},
+            **{n: c.type for n, c in zip(b.names, b.columns)},
+        },
+        dictionaries={
+            **{n: c.dictionary for n, c in zip(a.names, a.columns)},
+            **{n: c.dictionary for n, c in zip(b.names, b.columns)},
+        },
+    )
 
-    Compares normalized bits rather than raw values so float keys keep
-    canonical semantics (-0.0 == +0.0, NaN == NaN) consistently with
-    the single-column bit-key path."""
-    for pc, bc in pairs:
-        pb, _ = K.normalize_key(pc.data, None)
-        bb, _ = K.normalize_key(bc.data, None)
-        out_live = out_live & (pb[probe_idx] == bb[build_idx])
-    return out_live
+
+def _gather_pair_env(penv, benv, probe_names, syms, probe_idx, build_idx, base=None):
+    """Expanded-pair environment for the given symbols (traced)."""
+    fenv = dict(base or {})
+    for sym in syms:
+        if sym not in fenv:
+            from_probe = sym in probe_names
+            d, v = (penv if from_probe else benv)[sym]
+            idx = probe_idx if from_probe else build_idx
+            fenv[sym] = (d[idx], None if v is None else v[idx])
+    return fenv
+
+
+def _concat_sections(parts):
+    """Concatenate (data, valid|None) sections; None = all-valid."""
+    if len(parts) == 1:
+        return parts[0]
+    data = jnp.concatenate([d for d, _ in parts])
+    if all(v is None for _, v in parts):
+        return data, None
+    valids = [
+        jnp.ones(d.shape, dtype=jnp.bool_) if v is None else v
+        for d, v in parts
+    ]
+    return data, jnp.concatenate(valids)
+
+
+def _expr_symbols(e: RowExpression) -> set[str]:
+    """Free input symbols of an expression tree."""
+    out: set[str] = set()
+    stack = [e]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, InputRef):
+            out.add(x.name)
+        elif isinstance(x, Call):
+            stack.extend(x.args)
+        elif isinstance(x, Cast):
+            stack.append(x.arg)
+    return out
 
 
 def _and_mask(a, b):

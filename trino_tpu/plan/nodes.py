@@ -19,7 +19,7 @@ from trino_tpu.expr.ir import AggCall, RowExpression
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "Join",
     "SemiJoin", "Sort", "TopN", "Limit", "Output", "Values", "Exchange",
-    "SortKey",
+    "SortKey", "Window", "WindowCall", "Union",
 ]
 
 
@@ -126,6 +126,57 @@ class SortKey:
 
 
 @dataclass
+class WindowCall:
+    """One window function over the node's shared window specification
+    (MAIN/sql/planner/plan/WindowNode.Function analog)."""
+
+    name: str  # row_number/rank/dense_rank/ntile/lead/lag/first_value/
+    #          last_value/sum/avg/count/count_all/min/max
+    args: tuple[RowExpression, ...]
+    type: T.DataType
+    #: (mode, start, end) with bounds ("unbounded_preceding"|"preceding"
+    #: |"current"|"following"|"unbounded_following", offset|None);
+    #: None = the SQL default frame (RANGE UNBOUNDED PRECEDING..CURRENT)
+    frame: tuple | None = None
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+@dataclass
+class Window(PlanNode):
+    """Adds window-function columns; row-preserving
+    (MAIN/operator/WindowOperator.java analog). All functions of one
+    node share the same PARTITION BY / ORDER BY."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    partition_by: list[str] = field(default_factory=list)
+    order_keys: list[SortKey] = field(default_factory=list)
+    #: output symbol -> window call (args are symbols of source)
+    functions: dict[str, WindowCall] = field(default_factory=dict)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Union(PlanNode):
+    """UNION ALL: concatenation of sources
+    (MAIN/sql/planner/plan/UnionNode.java analog). Distinct set
+    semantics are planned as an Aggregate above, INTERSECT/EXCEPT as a
+    marker column + group filter."""
+
+    all_sources: list[PlanNode] = field(default_factory=list)
+    #: output symbol -> per-source input symbols (one per source)
+    symbol_map: dict[str, list[str]] = field(default_factory=dict)
+
+    @property
+    def sources(self):
+        return list(self.all_sources)
+
+
+@dataclass
 class Sort(PlanNode):
     source: PlanNode = None  # type: ignore[assignment]
     keys: list[SortKey] = field(default_factory=list)
@@ -219,6 +270,18 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         detail = f"[{ks}{n}]"
     elif isinstance(node, Limit):
         detail = f"[{node.count}]"
+    elif isinstance(node, Window):
+        ks = ", ".join(
+            f"{k.symbol} {'asc' if k.ascending else 'desc'}"
+            for k in node.order_keys
+        )
+        detail = (
+            f"[partition={node.partition_by} order=[{ks}] fns="
+            + ", ".join(f"{k}:={v!r}" for k, v in node.functions.items())
+            + "]"
+        )
+    elif isinstance(node, Union):
+        detail = f"[{len(node.all_sources)} branches]"
     elif isinstance(node, Exchange):
         detail = f"[{node.scope} {node.partitioning} {node.hash_symbols}]"
     elif isinstance(node, Output):

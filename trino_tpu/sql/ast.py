@@ -123,6 +123,25 @@ class FnCall(Expr):
     args: list[Expr]
     distinct: bool = False
     star: bool = False  # count(*)
+    over: "Optional[Over]" = None  # window specification
+
+
+@dataclass
+class WindowFrame:
+    """ROWS/RANGE BETWEEN <start> AND <end>; bounds are
+    ("unbounded_preceding" | "preceding" | "current" | "following" |
+    "unbounded_following", offset|None)."""
+
+    mode: str  # rows | range
+    start: tuple[str, Optional[int]]
+    end: tuple[str, Optional[int]]
+
+
+@dataclass
+class Over(Node):
+    partition_by: "list[Expr]"
+    order_by: "list[OrderItem]"
+    frame: Optional[WindowFrame] = None
 
 
 @dataclass

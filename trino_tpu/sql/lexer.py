@@ -37,6 +37,8 @@ KEYWORDS = {
     "tables", "schemas", "catalogs", "describe", "use", "set", "session",
     "year", "month", "day", "hour", "minute", "second", "substring", "for",
     "values", "create", "table", "insert", "into", "drop", "count",
+    "over", "partition", "rows", "range", "unbounded", "preceding",
+    "following", "current", "row",
 }
 
 _TOKEN_RE = re.compile(

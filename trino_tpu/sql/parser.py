@@ -531,15 +531,60 @@ class _Parser:
         self.expect_op("(")
         if self.accept_op("*"):
             self.expect_op(")")
-            return ast.FnCall(name, [], star=True)
+            return self._maybe_over(ast.FnCall(name, [], star=True))
         if self.accept_op(")"):
-            return ast.FnCall(name, [])
+            return self._maybe_over(ast.FnCall(name, []))
         distinct = self.accept_kw("distinct")
         args = [self.expr()]
         while self.accept_op(","):
             args.append(self.expr())
         self.expect_op(")")
-        return ast.FnCall(name, args, distinct=distinct)
+        return self._maybe_over(ast.FnCall(name, args, distinct=distinct))
+
+    def _maybe_over(self, fn: ast.FnCall) -> ast.Expr:
+        """Attach an OVER (...) window specification if present."""
+        if not self.accept_kw("over"):
+            return fn
+        self.expect_op("(")
+        partition_by: list[ast.Expr] = []
+        order_by: list[ast.OrderItem] = []
+        frame = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.expr())
+            while self.accept_op(","):
+                partition_by.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by = self.order_items()
+        if self.at_kw("rows") or self.at_kw("range"):
+            mode = self.next().text
+            if self.accept_kw("between"):
+                start = self._frame_bound()
+                self.expect_kw("and")
+                end = self._frame_bound()
+            else:
+                start = self._frame_bound()
+                end = ("current", None)
+            frame = ast.WindowFrame(mode, start, end)
+        self.expect_op(")")
+        fn.over = ast.Over(partition_by, order_by, frame)
+        return fn
+
+    def _frame_bound(self) -> tuple:
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return ("unbounded_preceding", None)
+            self.expect_kw("following")
+            return ("unbounded_following", None)
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ("current", None)
+        n = int(self.next().text)
+        if self.accept_kw("preceding"):
+            return ("preceding", n)
+        self.expect_kw("following")
+        return ("following", n)
 
     def _type_name(self) -> str:
         base = self.next().text
@@ -557,6 +602,8 @@ _NONRESERVED = {
     "year", "month", "day", "hour", "minute", "second", "date", "timestamp",
     "count", "first", "last", "tables", "schemas", "catalogs", "session",
     "analyze", "show", "use", "set", "values",
+    "partition", "rows", "range", "unbounded", "preceding", "following",
+    "current", "row",
 }
 
 

@@ -23,13 +23,47 @@ from trino_tpu.types import format_date
 __all__ = ["load_tpch_sqlite", "assert_rows_match", "to_sqlite"]
 
 
-def load_tpch_sqlite(data: TpchData, tables: list[str] | None = None) -> sqlite3.Connection:
+def load_tpch_sqlite(
+    data: TpchData,
+    tables: list[str] | None = None,
+    disk_cache: bool = False,
+) -> sqlite3.Connection:
     """Load generated TPC-H tables into an in-memory sqlite database.
 
     Dates become ISO text (compares correctly lexicographically),
-    decimals become REAL dollars (cents / 100).
+    decimals become REAL dollars (cents / 100). ``disk_cache`` keeps
+    the loaded database as a file next to the generator's column cache
+    so benchmark baselines don't pay the multi-minute reload at SF>=1.
     """
-    conn = sqlite3.connect(":memory:")
+    if disk_cache and tables is not None:
+        # a partial database must not be cached under the full-db key
+        disk_cache = False
+    if disk_cache:
+        import os
+
+        root = os.environ.get(
+            "TRINO_TPU_DATA_CACHE",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)
+                ))),
+                ".tpch_cache",
+            ),
+        )
+        if root == "off":
+            return _load_into(sqlite3.connect(":memory:"), data, tables)
+        os.makedirs(root, exist_ok=True)
+        path = os.path.join(root, f"sqlite_sf{data.sf:g}.db")
+        if os.path.exists(path):
+            return sqlite3.connect(path)
+        conn = _load_into(sqlite3.connect(f"{path}.tmp.{os.getpid()}"), data, tables)
+        conn.close()
+        os.replace(f"{path}.tmp.{os.getpid()}", path)
+        return sqlite3.connect(path)
+    return _load_into(sqlite3.connect(":memory:"), data, tables)
+
+
+def _load_into(conn: sqlite3.Connection, data: TpchData, tables=None) -> sqlite3.Connection:
     for name in tables or list(SCHEMAS):
         schema = SCHEMAS[name]
         cols = []
@@ -42,21 +76,28 @@ def load_tpch_sqlite(data: TpchData, tables: list[str] | None = None) -> sqlite3
                 sql_t = "INTEGER"
             cols.append(f"{col} {sql_t}")
         conn.execute(f"CREATE TABLE {name} ({', '.join(cols)})")
-        arrays = []
-        for col, typ in schema.columns:
-            arr = data.column(name, col)
-            if isinstance(typ, T.DecimalType):
-                arrays.append((arr / 10**typ.scale).tolist())
-            elif isinstance(typ, T.DateType):
-                arrays.append([format_date(d) for d in arr])
-            elif isinstance(typ, T.VarcharType):
-                arrays.append([str(s) for s in arr])
-            else:
-                arrays.append(arr.tolist())
+        n_rows = data.row_count(name)
         placeholders = ",".join("?" * len(schema.columns))
-        conn.executemany(
-            f"INSERT INTO {name} VALUES ({placeholders})", list(zip(*arrays))
-        )
+        # chunked load: a full zip() of SF>=1 lineitem is millions of
+        # python tuples at once — several GB of transient heap
+        chunk = 500_000
+        for lo in range(0, n_rows, chunk):
+            hi = min(lo + chunk, n_rows)
+            arrays = []
+            for col, typ in schema.columns:
+                arr = data.column(name, col)[lo:hi]
+                if isinstance(typ, T.DecimalType):
+                    arrays.append((arr / 10**typ.scale).tolist())
+                elif isinstance(typ, T.DateType):
+                    arrays.append([format_date(d) for d in arr])
+                elif isinstance(typ, T.VarcharType):
+                    arrays.append([str(s) for s in arr])
+                else:
+                    arrays.append(arr.tolist())
+            conn.executemany(
+                f"INSERT INTO {name} VALUES ({placeholders})",
+                zip(*arrays),
+            )
     conn.commit()
     return conn
 
