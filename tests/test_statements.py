@@ -1,0 +1,70 @@
+"""Metadata statements + EXPLAIN / EXPLAIN ANALYZE.
+
+The analog of the reference's DataDefinitionExecution + planprinter
+coverage (MAIN/execution/, MAIN/sql/planner/planprinter/)."""
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+def test_show_catalogs(runner):
+    assert runner.execute("show catalogs").rows == [("tpch",)]
+
+
+def test_show_schemas(runner):
+    rows = runner.execute("show schemas").rows
+    assert ("tiny",) in rows and ("sf1",) in rows
+
+
+def test_show_tables(runner):
+    rows = runner.execute("show tables").rows
+    assert ("lineitem",) in rows and ("nation",) in rows
+
+
+def test_describe(runner):
+    rows = runner.execute("describe region").rows
+    assert rows[0] == ("r_regionkey", "bigint")
+    assert len(rows) == 3
+
+
+def test_use_and_set_session():
+    r = QueryRunner.tpch("tiny")
+    r.execute("use tpch.sf1")
+    assert r.session.schema == "sf1"
+    r.execute("use tiny")
+    assert r.session.schema == "tiny"
+    r.execute("set session query_max_memory = '1GB'")
+    assert r.session.properties["query_max_memory"] == "1GB"
+
+
+def test_explain(runner):
+    rows = runner.execute(
+        "explain select count(*) from nation where n_regionkey = 1"
+    ).rows
+    text = "\n".join(r[0] for r in rows)
+    assert "TableScan" in text and "Aggregate" in text
+    assert "Output" in text
+
+
+def test_explain_analyze(runner):
+    rows = runner.execute(
+        "explain analyze select n_regionkey, count(*) from nation "
+        "group by n_regionkey"
+    ).rows
+    text = "\n".join(r[0] for r in rows)
+    assert "rows," in text and "ms total" in text
+    assert "TableScan" in text
+
+
+def test_explain_analyze_matches_execution(runner):
+    # EXPLAIN ANALYZE must leave the executor usable afterwards
+    before = runner.execute("select count(*) from nation").rows
+    runner.execute("explain analyze select count(*) from nation")
+    after = runner.execute("select count(*) from nation").rows
+    assert before == after == [(25,)]

@@ -48,6 +48,9 @@ class Split:
 class Connector:
     """Base connector: metadata + split enumeration + column scan."""
 
+    def list_schemas(self) -> list[str]:
+        return []
+
     def list_tables(self, schema: str) -> list[str]:
         raise NotImplementedError
 

@@ -5,10 +5,17 @@ The analog of the reference's LocalQueryRunner
 analyze, plan, execute — in one process without the HTTP layers. The
 distributed runner builds on the same stages but fragments the plan and
 executes over a device mesh.
+
+Statement dispatch mirrors the reference's DataDefinitionExecution vs
+SqlQueryExecution split (MAIN/execution/): metadata statements (SHOW,
+DESCRIBE, USE, SET SESSION) execute coordinator-side; EXPLAIN renders
+the plan; EXPLAIN ANALYZE executes with per-node device timings (the
+ExplainAnalyzeOperator analog, MAIN/operator/ExplainAnalyzeOperator.java).
 """
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 from trino_tpu.analyzer.analyzer import Analyzer
@@ -18,6 +25,7 @@ from trino_tpu.metadata import Metadata, Session
 from trino_tpu.page import Page
 from trino_tpu.plan import nodes as P
 from trino_tpu.plan.optimizer import optimize
+from trino_tpu.sql import ast
 from trino_tpu.sql.parser import parse_statement
 
 __all__ = ["QueryRunner", "QueryResult"]
@@ -64,8 +72,9 @@ class QueryRunner:
         md.register_catalog("tpch", TpchConnector())
         return QueryRunner(md, Session(catalog="tpch", schema=schema), mesh=mesh)
 
-    def plan_sql(self, sql: str, optimized: bool = True) -> P.PlanNode:
-        stmt = parse_statement(sql)
+    # ---- planning --------------------------------------------------------
+
+    def plan_stmt(self, stmt: ast.Statement, optimized: bool = True) -> P.PlanNode:
         analyzer = Analyzer(self.metadata, self.session)
         plan = analyzer.analyze(stmt)
         if optimized:
@@ -76,12 +85,66 @@ class QueryRunner:
             plan = add_exchanges(plan, self.metadata)
         return plan
 
+    def plan_sql(self, sql: str, optimized: bool = True) -> P.PlanNode:
+        return self.plan_stmt(parse_statement(sql), optimized=optimized)
+
+    # ---- execution -------------------------------------------------------
+
     def execute_page(self, sql: str) -> tuple[P.PlanNode, Page]:
         plan = self.plan_sql(sql)
         return plan, self.executor.execute(plan)
 
     def execute(self, sql: str) -> QueryResult:
-        plan, page = self.execute_page(sql)
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, ast.ShowCatalogs):
+            return QueryResult(
+                ["Catalog"],
+                [(c,) for c in sorted(self.metadata.catalogs())],
+            )
+        if isinstance(stmt, ast.ShowSchemas):
+            cat = stmt.catalog or self.session.catalog
+            conn = self.metadata.connector(cat)
+            return QueryResult(
+                ["Schema"], [(s,) for s in sorted(conn.list_schemas())]
+            )
+        if isinstance(stmt, ast.ShowTables):
+            cat = self.session.catalog
+            schema = self.session.schema
+            if stmt.schema:
+                parts = stmt.schema
+                schema = parts[-1]
+                if len(parts) > 1:
+                    cat = parts[0]
+            conn = self.metadata.connector(cat)
+            return QueryResult(
+                ["Table"], [(t,) for t in sorted(conn.list_tables(schema))]
+            )
+        if isinstance(stmt, ast.DescribeTable):
+            qt, schema = self.metadata.resolve_table(
+                self.session, tuple(stmt.table)
+            )
+            return QueryResult(
+                ["Column", "Type"],
+                [(c, str(t)) for c, t in schema.columns],
+            )
+        if isinstance(stmt, ast.Use):
+            parts = list(stmt.parts)
+            if len(parts) == 2:
+                self.session.catalog, self.session.schema = parts
+            else:
+                self.session.schema = parts[0]
+            return QueryResult(["result"], [("USE",)])
+        if isinstance(stmt, ast.SessionSet):
+            v = stmt.value
+            val = getattr(v, "value", None)
+            if val is None and hasattr(v, "text"):
+                val = v.text
+            self.session.properties[stmt.name] = val
+            return QueryResult(["result"], [("SET SESSION",)])
+        plan = self.plan_stmt(stmt)
+        page = self.executor.execute(plan)
         ordered = _has_order(plan)
         return QueryResult(
             names=list(page.names),
@@ -89,6 +152,73 @@ class QueryRunner:
             ordered=ordered,
             plan=plan,
         )
+
+    # ---- EXPLAIN ---------------------------------------------------------
+
+    def _explain(self, stmt: ast.Explain) -> QueryResult:
+        plan = self.plan_stmt(stmt.statement)
+        if not stmt.analyze:
+            return QueryResult(
+                ["Query Plan"],
+                [(line,) for line in P.plan_tree_str(plan).splitlines()],
+            )
+        stats: dict[int, tuple[float, int]] = {}
+        ex = self.executor
+        orig = type(ex).execute
+
+        def timed(node):
+            t0 = time.perf_counter()
+            out = orig(ex, node)
+            # force completion so the timing covers device work (the
+            # reference's operator wall clocks include the same sync
+            # bias at pipeline boundaries)
+            n_rows = out.num_rows() if hasattr(out, "num_rows") else 0
+            stats[id(node)] = (
+                (time.perf_counter() - t0) * 1e3, n_rows,
+            )
+            return out
+
+        # instance-level patch: other executors (and other threads'
+        # runners) are untouched
+        ex.execute = timed
+        try:
+            t0 = time.perf_counter()
+            page = ex.execute(plan)
+            rows = page.to_pylist()
+            total_ms = (time.perf_counter() - t0) * 1e3
+        finally:
+            del ex.execute
+        lines = [
+            f"Query: {len(rows)} rows, {total_ms:.1f} ms total",
+        ]
+        lines.extend(_annotated_tree(plan, stats).splitlines())
+        return QueryResult(["Query Plan"], [(line,) for line in lines])
+
+
+def _timed_frontier_ms(node: P.PlanNode, stats) -> float:
+    """Total time of the nearest timed descendants (fused interior
+    nodes never pass through execute(), so the direct sources of a
+    chain head are untimed — walk through them)."""
+    total = 0.0
+    for s in node.sources:
+        if id(s) in stats:
+            total += stats[id(s)][0]
+        else:
+            total += _timed_frontier_ms(s, stats)
+    return total
+
+
+def _annotated_tree(node: P.PlanNode, stats, indent: int = 0) -> str:
+    own = stats.get(id(node))
+    base = P.plan_tree_str(node, indent).splitlines()[0]
+    if own is not None:
+        ms, n_rows = own
+        child_ms = _timed_frontier_ms(node, stats)
+        base += f"   [{n_rows} rows, {max(ms - child_ms, 0.0):.1f} ms]"
+    lines = [base]
+    for s in node.sources:
+        lines.append(_annotated_tree(s, stats, indent + 1))
+    return "\n".join(lines)
 
 
 def _has_order(plan: P.PlanNode) -> bool:

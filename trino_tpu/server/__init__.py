@@ -1,0 +1,13 @@
+"""Coordinator HTTP server, REST client, and CLI.
+
+The analog of the reference's client protocol stack: the coordinator
+statement resources (MAIN/dispatcher/QueuedStatementResource.java:105,
+MAIN/server/protocol/ExecutingStatementResource.java:71), the Java
+client (client/trino-client/.../StatementClientV1.java:68), and the
+terminal CLI (client/trino-cli/.../Console.java:86).
+"""
+
+from trino_tpu.server.coordinator import Coordinator
+from trino_tpu.server.client import StatementClient
+
+__all__ = ["Coordinator", "StatementClient"]

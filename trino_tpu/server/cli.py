@@ -1,0 +1,108 @@
+"""Interactive SQL CLI.
+
+The analog of the reference's terminal client
+(client/trino-cli/.../Console.java:86): reads statements (terminated
+by ';'), sends them through the REST protocol, renders aligned tables.
+Run as:
+
+    python -m trino_tpu.server.cli [--server URL] [--execute SQL]
+
+Without --server, an embedded coordinator is started over the TPC-H
+catalog (the dev loop the reference serves with TestingTrinoServer).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from trino_tpu.server.client import QueryError, StatementClient
+
+__all__ = ["main", "render_table"]
+
+
+def render_table(columns: list[dict], rows: list[list]) -> str:
+    if not columns:
+        return "(no columns)"
+    headers = [c["name"] for c in columns]
+    cells = [[_fmt(v) for v in row] for row in rows]
+    widths = [
+        max(len(h), *(len(r[i]) for r in cells)) if cells else len(h)
+        for i, h in enumerate(headers)
+    ]
+    sep = "-+-".join("-" * w for w in widths)
+    out = [
+        " | ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        sep,
+    ]
+    for r in cells:
+        out.append(" | ".join(v.ljust(w) for v, w in zip(r, widths)))
+    out.append(f"({len(rows)} row{'s' if len(rows) != 1 else ''})")
+    return "\n".join(out)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="trino-tpu")
+    ap.add_argument("--server", help="coordinator URL (default: embedded)")
+    ap.add_argument("--execute", "-e", help="run one statement and exit")
+    ap.add_argument(
+        "--schema", default="tiny", help="TPC-H schema for embedded mode"
+    )
+    args = ap.parse_args(argv)
+
+    coordinator = None
+    if args.server:
+        server = args.server
+    else:
+        from trino_tpu.engine import QueryRunner
+        from trino_tpu.server.coordinator import Coordinator
+
+        coordinator = Coordinator(QueryRunner.tpch(args.schema)).start()
+        server = coordinator.uri
+        print(f"embedded coordinator at {server}", file=sys.stderr)
+    client = StatementClient(server)
+
+    def run_one(sql: str) -> int:
+        sql = sql.strip().rstrip(";").strip()
+        if not sql:
+            return 0
+        try:
+            columns, rows = client.execute(sql)
+        except QueryError as e:
+            print(f"Query failed: {e}", file=sys.stderr)
+            return 1
+        print(render_table(columns, rows))
+        return 0
+
+    try:
+        if args.execute:
+            return run_one(args.execute)
+        print("trino-tpu> ", end="", flush=True)
+        buf: list[str] = []
+        for line in sys.stdin:
+            buf.append(line)
+            if ";" in line:
+                stmt = "".join(buf)
+                buf = []
+                if stmt.strip().rstrip(";").strip().lower() in ("quit", "exit"):
+                    break
+                run_one(stmt)
+                print("trino-tpu> ", end="", flush=True)
+            else:
+                print("        -> ", end="", flush=True)
+        return 0
+    finally:
+        if coordinator is not None:
+            coordinator.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""REST statement client.
+
+The analog of the reference's StatementClientV1
+(client/trino-client/.../StatementClientV1.java:68): POST the SQL,
+then follow ``nextUri`` until it disappears, accumulating data pages.
+Pure stdlib (urllib) — the server is localhost/cluster-internal.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["StatementClient", "QueryError"]
+
+
+class QueryError(RuntimeError):
+    pass
+
+
+class StatementClient:
+    def __init__(self, server: str, timeout: float = 300.0):
+        self.server = server.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, url: str, body: bytes | None = None) -> dict:
+        req = urllib.request.Request(url, data=body, method=method)
+        req.add_header("X-Trino-User", "user")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode()[:200]
+            except Exception:
+                pass
+            raise QueryError(f"HTTP {e.code} from {url}: {detail}") from e
+        except urllib.error.URLError as e:
+            raise QueryError(f"cannot reach {url}: {e.reason}") from e
+        return json.loads(payload) if payload else {}
+
+    def execute(self, sql: str):
+        """Run one statement; returns (columns, rows).
+
+        ``columns`` is a list of {name, type} dicts; rows are lists of
+        JSON-decoded values.
+        """
+        resp = self._request(
+            "POST", f"{self.server}/v1/statement", sql.encode()
+        )
+        columns = None
+        rows: list[list] = []
+        deadline = time.time() + self.timeout
+        while True:
+            if "error" in resp:
+                raise QueryError(resp["error"].get("message", "query failed"))
+            if resp.get("columns") and columns is None:
+                columns = resp["columns"]
+            rows.extend(resp.get("data") or [])
+            nxt = resp.get("nextUri")
+            if nxt is None:
+                break
+            if time.time() > deadline:
+                raise QueryError("client timeout")
+            resp = self._request("GET", nxt)
+        return columns or [], rows
+
+    def server_info(self) -> dict:
+        return self._request("GET", f"{self.server}/v1/info")
+
+    def queries(self) -> list[dict]:
+        return self._request("GET", f"{self.server}/v1/queries")

@@ -1,0 +1,276 @@
+"""Coordinator: HTTP statement protocol + query lifecycle.
+
+The analog of the reference's dispatch/protocol layer:
+
+- ``POST /v1/statement`` submits SQL and returns the first protocol
+  response with a ``nextUri`` (QueuedStatementResource.postStatement,
+  MAIN/dispatcher/QueuedStatementResource.java:158);
+- ``GET /v1/statement/executing/{id}/{slug}/{token}`` pages results
+  (ExecutingStatementResource,
+  MAIN/server/protocol/ExecutingStatementResource.java:71) — each
+  response carries a batch of rows and the next token's URI until the
+  query drains;
+- ``DELETE`` on the same URI cancels
+- ``GET /v1/info`` / ``GET /v1/queries`` expose server/query state
+  (QueryResource analog, MAIN/server/QueryResource.java).
+
+The lifecycle mirrors QueryStateMachine's QUEUED -> RUNNING ->
+FINISHED/FAILED states (MAIN/execution/QueryStateMachine.java) with a
+worker thread per query (dispatch is cheap here: the heavy lifting is
+device execution, serialized through the engine's executor).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+import time
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from decimal import Decimal
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from trino_tpu.engine import QueryResult, QueryRunner
+
+__all__ = ["Coordinator"]
+
+#: rows per protocol page (the reference targets bytes; rows are fine
+#: for a first protocol cut)
+PAGE_ROWS = 4096
+
+
+@dataclass
+class QueryState:
+    query_id: str
+    slug: str
+    sql: str
+    state: str = "QUEUED"  # QUEUED | RUNNING | FINISHED | FAILED
+    result: QueryResult | None = None
+    error: str | None = None
+    error_detail: str | None = None  # server-side traceback
+    created_at: float = field(default_factory=time.time)
+    finished_at: float | None = None
+    cancelled: bool = False
+
+
+class Coordinator:
+    """Embedded coordinator server (TestingTrinoServer analog,
+    MAIN/server/testing/TestingTrinoServer.java:141)."""
+
+    def __init__(self, runner: QueryRunner | None = None, port: int = 0):
+        self.runner = runner or QueryRunner.tpch("tiny")
+        self._queries: dict[str, QueryState] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        coordinator = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, payload: dict | None):
+                if code == 204 or payload is None:
+                    self.send_response(code)
+                    self.end_headers()
+                    return
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/v1/statement":
+                    self._send(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                sql = self.rfile.read(n).decode()
+                q = coordinator.submit(sql)
+                self._send(200, coordinator.proto_response(q, 0, self._base()))
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if self.path == "/v1/info":
+                    self._send(200, {
+                        "nodeVersion": {"version": "trino-tpu-0.1"},
+                        "coordinator": True,
+                        "starting": False,
+                    })
+                    return
+                if self.path == "/v1/queries":
+                    self._send(200, coordinator.list_queries())
+                    return
+                if (
+                    len(parts) == 6
+                    and parts[:3] == ["v1", "statement", "executing"]
+                ):
+                    _, _, _, qid, slug, token = parts
+                    payload, code = coordinator.page(
+                        qid, slug, int(token), self._base()
+                    )
+                    self._send(code, payload)
+                    return
+                self._send(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if (
+                    len(parts) == 6
+                    and parts[:3] == ["v1", "statement", "executing"]
+                ):
+                    coordinator.cancel(parts[3])
+                    self._send(204, None)
+                    return
+                self._send(404, {"error": "not found"})
+
+            def _base(self) -> str:
+                host = self.headers.get("Host") or "localhost"
+                return f"http://{host}"
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Coordinator":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def uri(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # ---- query management ------------------------------------------------
+
+    def submit(self, sql: str) -> QueryState:
+        with self._lock:
+            self._seq += 1
+            qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{self._seq:05d}_{uuid.uuid4().hex[:5]}"
+        q = QueryState(query_id=qid, slug=secrets.token_hex(8), sql=sql)
+        self._queries[qid] = q
+
+        def run():
+            if q.cancelled:
+                q.finished_at = time.time()
+                return
+            q.state = "RUNNING"
+            try:
+                result = self.runner.execute(sql)
+                if q.cancelled:
+                    q.state = "FAILED"
+                else:
+                    q.result = result
+                    q.state = "FINISHED"
+            except Exception as e:  # surfaces through the protocol
+                q.error = f"{type(e).__name__}: {e}"
+                q.error_detail = traceback.format_exc()
+                q.state = "FAILED"
+                q.result = None
+            q.finished_at = time.time()
+
+        threading.Thread(target=run, daemon=True).start()
+        return q
+
+    def cancel(self, qid: str):
+        q = self._queries.get(qid)
+        if q is not None:
+            q.cancelled = True
+            if q.state in ("QUEUED", "RUNNING"):
+                q.state = "FAILED"
+                q.error = "Query was canceled"
+
+    def list_queries(self) -> list[dict]:
+        return [
+            {
+                "queryId": q.query_id,
+                "state": q.state,
+                "query": q.sql,
+                "error": q.error,
+                "errorDetail": q.error_detail,
+            }
+            for q in self._queries.values()
+        ]
+
+    # ---- protocol responses ----------------------------------------------
+
+    def page(self, qid: str, slug: str, token: int, base: str):
+        q = self._queries.get(qid)
+        if q is None or q.slug != slug:
+            return {"error": "query not found"}, 404
+        # long-poll-lite: wait briefly for results like the reference's
+        # asyncResponse (ExecutingStatementResource waits server-side)
+        deadline = time.time() + 1.0
+        while q.state in ("QUEUED", "RUNNING") and time.time() < deadline:
+            time.sleep(0.01)
+        return self.proto_response(q, token, base), 200
+
+    def proto_response(self, q: QueryState, token: int, base: str) -> dict:
+        uri = f"{base}/v1/statement/executing/{q.query_id}/{q.slug}"
+        resp = {
+            "id": q.query_id,
+            "infoUri": f"{base}/v1/queries",
+            "stats": {
+                "state": q.state,
+                "queued": q.state == "QUEUED",
+                "elapsedTimeMillis": int(
+                    ((q.finished_at or time.time()) - q.created_at) * 1e3
+                ),
+            },
+        }
+        if q.state == "FAILED":
+            resp["error"] = {
+                "message": q.error or "unknown error",
+                "errorCode": 1,
+            }
+            return resp
+        if q.state in ("QUEUED", "RUNNING") or q.result is None:
+            resp["nextUri"] = f"{uri}/{token}"
+            return resp
+        result = q.result
+        lo = token * PAGE_ROWS
+        hi = lo + PAGE_ROWS
+        resp["columns"] = [
+            {"name": n, "type": _proto_type(result, i)}
+            for i, n in enumerate(result.names)
+        ]
+        chunk = result.rows[lo:hi]
+        if chunk:
+            resp["data"] = [[_json_value(v) for v in row] for row in chunk]
+        if hi < len(result.rows):
+            resp["nextUri"] = f"{uri}/{token + 1}"
+        return resp
+
+
+def _proto_type(result: QueryResult, i: int) -> str:
+    if result.plan is not None and i < len(result.plan.outputs):
+        t = list(result.plan.outputs.values())[i]
+        return str(t)
+    # metadata statements carry strings/ints only
+    for row in result.rows:
+        v = row[i]
+        if v is not None:
+            if isinstance(v, bool):
+                return "boolean"
+            if isinstance(v, int):
+                return "bigint"
+            if isinstance(v, float):
+                return "double"
+            break
+    return "varchar"
+
+
+def _json_value(v):
+    if isinstance(v, Decimal):
+        return str(v)
+    return v
