@@ -1,0 +1,163 @@
+"""Memory / blackhole / parquet connectors + DDL/DML write path.
+
+The analog of the reference's BaseConnectorTest compliance surface
+(TESTING/BaseConnectorTest.java:179) at the scale of the implemented
+SPI: create/insert/scan round-trips, NULL handling, parquet file
+ingest with projection pushdown.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.memory import BlackholeConnector, MemoryConnector
+from trino_tpu.connectors.parquet import ParquetConnector, write_parquet_table
+from trino_tpu.engine import QueryRunner
+from trino_tpu.metadata import Metadata, Session
+
+
+@pytest.fixture()
+def mem_runner():
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    return QueryRunner(md, Session(catalog="memory", schema="default"))
+
+
+def test_create_insert_select(mem_runner):
+    r = mem_runner
+    r.execute("create table t (a bigint, b varchar, c double)")
+    assert r.execute("show tables").rows == [("t",)]
+    n = r.execute("insert into t values (1, 'x', 1.5), (2, 'y', 2.5)").rows
+    assert n == [(2,)]
+    assert r.execute("select a, b, c from t order by a").rows == [
+        (1, "x", 1.5), (2, "y", 2.5),
+    ]
+    # append more + aggregate
+    r.execute("insert into t values (3, 'x', 10.0)")
+    assert r.execute(
+        "select b, count(*), sum(c) from t group by b order by b"
+    ).rows == [("x", 2, 11.5), ("y", 1, 2.5)]
+
+
+def test_insert_nulls_and_partial_columns(mem_runner):
+    r = mem_runner
+    r.execute("create table t (a bigint, b varchar)")
+    r.execute("insert into t values (1, null), (null, 'z')")
+    r.execute("insert into t (a) values (7)")
+    rows = r.execute("select a, b from t").rows
+    assert sorted(rows, key=str) == sorted(
+        [(1, None), (None, "z"), (7, None)], key=str
+    )
+    assert r.execute("select count(a), count(b) from t").rows == [(2, 1)]
+
+
+def test_create_table_as(mem_runner):
+    r = mem_runner
+    r.execute("create table src (k bigint, v varchar)")
+    r.execute("insert into src values (1, 'a'), (2, 'b'), (2, 'c')")
+    r.execute("create table agg as select k, count(*) cnt from src group by k")
+    assert r.execute("select k, cnt from agg order by k").rows == [
+        (1, 1), (2, 2),
+    ]
+
+
+def test_insert_select(mem_runner):
+    r = mem_runner
+    r.execute("create table a (x bigint)")
+    r.execute("create table b (x bigint)")
+    r.execute("insert into a values (1), (2), (3)")
+    r.execute("insert into b select x * 10 from a where x > 1")
+    assert r.execute("select x from b order by x").rows == [(20,), (30,)]
+
+
+def test_drop_table(mem_runner):
+    r = mem_runner
+    r.execute("create table t (a bigint)")
+    r.execute("drop table t")
+    assert r.execute("show tables").rows == []
+    r.execute("drop table if exists t")  # no error
+    r.execute("create table if not exists t (a bigint)")
+    r.execute("create table if not exists t (a bigint)")  # no error
+
+
+def test_blackhole():
+    md = Metadata()
+    md.register_catalog("blackhole", BlackholeConnector())
+    r = QueryRunner(md, Session(catalog="blackhole", schema="default"))
+    r.execute("create table sink (a bigint, b varchar)")
+    assert r.execute("insert into sink values (1, 'x'), (2, 'y')").rows == [(2,)]
+    assert r.execute("select count(*) from sink").rows == [(0,)]
+
+
+def test_decimal_and_date_round_trip(mem_runner):
+    r = mem_runner
+    r.execute("create table t (d decimal(10,2), dt date)")
+    r.execute("insert into t values (12.34, date '2024-02-29')")
+    rows = r.execute("select d, dt from t").rows
+    from decimal import Decimal
+
+    assert rows == [(Decimal("12.34"), "2024-02-29")]
+
+
+# ---- parquet ----------------------------------------------------------------
+
+@pytest.fixture()
+def pq_runner(tmp_path):
+    """TPC-H tiny exported to parquet, queried through the engine."""
+    src = QueryRunner.tpch("tiny")
+    conn = src.metadata.connector("tpch")
+    data = conn.data("tiny")
+    root = str(tmp_path / "pq")
+    for table in ("nation", "region", "orders"):
+        ts = conn.table_schema("tiny", table)
+        cols = {c: data.column(table, c) for c in ts.column_names}
+        write_parquet_table(root, "tiny", table, ts, cols)
+    md = Metadata()
+    md.register_catalog("hive", ParquetConnector(root))
+    return QueryRunner(md, Session(catalog="hive", schema="tiny")), src
+
+
+def test_parquet_metadata(pq_runner):
+    r, _src = pq_runner
+    assert r.execute("show tables").rows == [
+        ("nation",), ("orders",), ("region",),
+    ]
+    rows = r.execute("describe nation").rows
+    assert rows[0] == ("n_nationkey", "bigint")
+
+
+def test_parquet_scan_matches_generator(pq_runner):
+    r, src = pq_runner
+    for sql in (
+        "select n_name, n_regionkey from nation order by n_name",
+        "select count(*), sum(o_totalprice) from orders",
+        "select o_orderstatus, count(*) from orders "
+        "group by o_orderstatus order by 1",
+        # join across parquet tables
+        "select r_name, count(*) from nation n, region r "
+        "where n.n_regionkey = r.r_regionkey group by r_name order by 1",
+    ):
+        assert r.execute(sql).rows == src.execute(sql).rows
+
+
+def test_parquet_nulls(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = str(tmp_path / "pq2")
+    import os
+
+    os.makedirs(f"{root}/s")
+    pq.write_table(
+        pa.table({
+            "a": pa.array([1, None, 3], type=pa.int64()),
+            "b": pa.array(["x", "y", None], type=pa.string()),
+        }),
+        f"{root}/s/t.parquet",
+    )
+    md = Metadata()
+    md.register_catalog("hive", ParquetConnector(root))
+    r = QueryRunner(md, Session(catalog="hive", schema="s"))
+    assert r.execute("select count(*), count(a), count(b) from t").rows == [
+        (3, 2, 2),
+    ]
+    assert r.execute("select a from t where b = 'x'").rows == [(1,)]

@@ -75,8 +75,25 @@ class Connector:
     def scan(
         self, schema: str, table: str, columns: list[str], split: Split | None = None
     ) -> dict[str, np.ndarray]:
-        """Produce host arrays for the requested columns (row range)."""
+        """Produce host arrays for the requested columns (row range).
+
+        A value may also be a ``(values, valid)`` tuple for nullable
+        columns (valid=None means all valid)."""
         raise NotImplementedError
+
+    # ---- write path (ConnectorMetadata DDL + ConnectorPageSink analog,
+    # SPI/connector/ConnectorMetadata.java, ConnectorPageSink.java) ----
+
+    def create_table(self, schema: str, table: str, table_schema: TableSchema):
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
+    def drop_table(self, schema: str, table: str):
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
+    def insert(self, schema: str, table: str, columns: dict) -> int:
+        """Append rows; ``columns`` maps column name ->
+        (values, valid|None) host arrays. Returns the row count."""
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
 
 
 @dataclass

@@ -1,0 +1,166 @@
+"""In-memory tables connector.
+
+The analog of the reference's trino-memory plugin
+(plugin/trino-memory, ~2.3k LoC): tables live as host numpy columns,
+support CREATE TABLE / CREATE TABLE AS / INSERT / DROP and scans.
+Heavily used by tests, exactly like the reference uses it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connectors.base import Connector, Split, TableSchema
+
+__all__ = ["MemoryConnector", "BlackholeConnector"]
+
+
+class _Table:
+    def __init__(self, schema: TableSchema):
+        self.schema = schema
+        self.columns: dict[str, np.ndarray] = {
+            c: np.empty((0,), dtype=_storage_dtype(t))
+            for c, t in schema.columns
+        }
+        self.valid: dict[str, np.ndarray | None] = {
+            c: None for c, _ in schema.columns
+        }
+        self.n_rows = 0
+
+
+def _storage_dtype(t: T.DataType):
+    if isinstance(t, T.VarcharType):
+        return object
+    return t.np_dtype
+
+
+class MemoryConnector(Connector):
+    def __init__(self):
+        self._schemas: dict[str, dict[str, _Table]] = {"default": {}}
+        self._lock = threading.Lock()
+
+    # ---- metadata --------------------------------------------------------
+
+    def list_schemas(self) -> list[str]:
+        return list(self._schemas)
+
+    def list_tables(self, schema: str) -> list[str]:
+        return list(self._schemas.get(schema, {}))
+
+    def table_schema(self, schema: str, table: str) -> TableSchema:
+        return self._table(schema, table).schema
+
+    def row_count(self, schema: str, table: str) -> int:
+        return self._table(schema, table).n_rows
+
+    def _table(self, schema: str, table: str) -> _Table:
+        try:
+            return self._schemas[schema][table]
+        except KeyError:
+            raise KeyError(f"table {schema}.{table} does not exist")
+
+    # ---- DDL / DML -------------------------------------------------------
+
+    def create_table(self, schema: str, table: str, table_schema: TableSchema):
+        with self._lock:
+            tables = self._schemas.setdefault(schema, {})
+            if table in tables:
+                raise ValueError(f"table {schema}.{table} already exists")
+            tables[table] = _Table(table_schema)
+
+    def drop_table(self, schema: str, table: str):
+        with self._lock:
+            tables = self._schemas.get(schema, {})
+            if table not in tables:
+                raise KeyError(f"table {schema}.{table} does not exist")
+            del tables[table]
+
+    def insert(self, schema: str, table: str, columns: dict) -> int:
+        t = self._table(schema, table)
+        with self._lock:
+            n_new = None
+            for c, _typ in t.schema.columns:
+                vals = columns[c]
+                valid = None
+                if isinstance(vals, tuple):
+                    vals, valid = vals
+                vals = np.asarray(vals, dtype=t.columns[c].dtype)
+                n_new = len(vals) if n_new is None else n_new
+                t.columns[c] = np.concatenate([t.columns[c], vals])
+                old_valid = t.valid[c]
+                if valid is not None or old_valid is not None:
+                    ov = (
+                        np.ones(t.n_rows, dtype=bool)
+                        if old_valid is None else old_valid
+                    )
+                    nv = (
+                        np.ones(len(vals), dtype=bool)
+                        if valid is None else np.asarray(valid, dtype=bool)
+                    )
+                    t.valid[c] = np.concatenate([ov, nv])
+            t.n_rows += n_new or 0
+        return n_new or 0
+
+    # ---- scan ------------------------------------------------------------
+
+    def scan(
+        self, schema: str, table: str, columns: list[str],
+        split: Split | None = None,
+    ):
+        t = self._table(schema, table)
+        out = {}
+        for c in columns:
+            vals = t.columns[c]
+            valid = t.valid[c]
+            if split is not None:
+                vals = vals[split.start:split.start + split.count]
+                valid = None if valid is None else valid[
+                    split.start:split.start + split.count
+                ]
+            out[c] = vals if valid is None else (vals, valid)
+        return out
+
+
+class BlackholeConnector(Connector):
+    """Null sink/source (plugin/trino-blackhole analog): accepts any
+    DDL/insert, scans are empty — for perf isolation tests."""
+
+    def __init__(self):
+        self._tables: dict[tuple[str, str], TableSchema] = {}
+
+    def list_schemas(self) -> list[str]:
+        return ["default"]
+
+    def list_tables(self, schema: str) -> list[str]:
+        return [t for (s, t) in self._tables if s == schema]
+
+    def table_schema(self, schema: str, table: str) -> TableSchema:
+        return self._tables[(schema, table)]
+
+    def row_count(self, schema: str, table: str) -> int:
+        return 0
+
+    def create_table(self, schema: str, table: str, table_schema: TableSchema):
+        self._tables[(schema, table)] = table_schema
+
+    def drop_table(self, schema: str, table: str):
+        if (schema, table) not in self._tables:
+            raise KeyError(f"table {schema}.{table} does not exist")
+        del self._tables[(schema, table)]
+
+    def insert(self, schema: str, table: str, columns: dict) -> int:
+        first = next(iter(columns.values()), None)
+        if first is None:
+            return 0
+        vals = first[0] if isinstance(first, tuple) else first
+        return len(vals)
+
+    def scan(self, schema: str, table: str, columns: list[str], split=None):
+        ts = self._tables[(schema, table)]
+        return {
+            c: np.empty((0,), dtype=_storage_dtype(ts.column_type(c)))
+            for c in columns
+        }

@@ -1,0 +1,187 @@
+"""Parquet files connector: columnar file ingest to device pages.
+
+The analog of the reference's Hive-style file connectors sitting on
+lib/trino-parquet (ParquetReader,
+lib/trino-parquet/.../reader/ParquetReader.java:85): a directory tree
+``root/<schema>/<table>.parquet`` is exposed as catalog tables; scans
+read only the projected columns (projection pushdown into the arrow
+reader), nulls become validity masks, decimals become unscaled int64,
+dates become int32 days — the engine's device page layout.
+
+Row counts come from file metadata without touching data pages, the
+footer-stats analog of the reference's stripe/rowgroup pruning.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connectors.base import Connector, Split, TableSchema
+
+__all__ = ["ParquetConnector", "write_parquet_table"]
+
+
+def _arrow():
+    import pyarrow
+    import pyarrow.parquet as pq
+
+    return pyarrow, pq
+
+
+def _type_from_arrow(t) -> T.DataType:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(t):
+        return T.BOOLEAN
+    if pa.types.is_int8(t):
+        return T.TINYINT
+    if pa.types.is_int16(t):
+        return T.SMALLINT
+    if pa.types.is_int32(t):
+        return T.INTEGER
+    if pa.types.is_int64(t):
+        return T.BIGINT
+    if pa.types.is_float32(t):
+        return T.REAL
+    if pa.types.is_float64(t):
+        return T.DOUBLE
+    if pa.types.is_decimal(t):
+        if t.precision > 18:
+            raise NotImplementedError(
+                f"decimal precision {t.precision} > 18"
+            )
+        return T.DecimalType(t.precision, t.scale)
+    if pa.types.is_date32(t):
+        return T.DATE
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return T.VARCHAR
+    raise NotImplementedError(f"parquet type {t}")
+
+
+class ParquetConnector(Connector):
+    def __init__(self, root: str):
+        self.root = root
+        self._schema_cache: dict[tuple[str, str], TableSchema] = {}
+
+    def _path(self, schema: str, table: str) -> str:
+        return os.path.join(self.root, schema, f"{table}.parquet")
+
+    # ---- metadata --------------------------------------------------------
+
+    def list_schemas(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d))
+        )
+
+    def list_tables(self, schema: str) -> list[str]:
+        d = os.path.join(self.root, schema)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            f[:-8] for f in os.listdir(d) if f.endswith(".parquet")
+        )
+
+    def table_schema(self, schema: str, table: str) -> TableSchema:
+        key = (schema, table)
+        if key not in self._schema_cache:
+            _, pq = _arrow()
+            meta = pq.read_schema(self._path(schema, table))
+            cols = [
+                (name, _type_from_arrow(meta.field(name).type))
+                for name in meta.names
+            ]
+            self._schema_cache[key] = TableSchema(table, cols)
+        return self._schema_cache[key]
+
+    def row_count(self, schema: str, table: str) -> int:
+        _, pq = _arrow()
+        return pq.ParquetFile(self._path(schema, table)).metadata.num_rows
+
+    # ---- scan ------------------------------------------------------------
+
+    def scan(
+        self, schema: str, table: str, columns: list[str],
+        split: Split | None = None,
+    ):
+        _, pq = _arrow()
+        ts = self.table_schema(schema, table)
+        tbl = pq.read_table(self._path(schema, table), columns=list(columns))
+        if split is not None:
+            tbl = tbl.slice(split.start, split.count)
+        out = {}
+        for c in columns:
+            arr = tbl.column(c).combine_chunks()
+            out[c] = _to_host(arr, ts.column_type(c))
+        return out
+
+
+def _to_host(arr, t: T.DataType):
+    """Arrow array -> (values, valid|None) in the engine's host layout."""
+    valid = None
+    if arr.null_count:
+        valid = np.asarray(arr.is_valid())
+    if isinstance(t, T.VarcharType):
+        vals = np.asarray(
+            ["" if v is None else v for v in arr.to_pylist()], dtype=object
+        )
+    elif isinstance(t, T.DecimalType):
+        import pyarrow as pa
+
+        unscaled = arr.cast(pa.decimal128(38, t.scale))
+        vals = np.asarray(
+            [0 if v is None else int(v.scaleb(t.scale)) for v in
+             unscaled.to_pylist()],
+            dtype=np.int64,
+        )
+    elif isinstance(t, T.DateType):
+        import pyarrow as pa
+
+        vals = np.asarray(arr.cast(pa.int32()).fill_null(0))
+    else:
+        vals = np.asarray(arr.fill_null(0) if arr.null_count else arr)
+    return vals if valid is None else (vals, valid)
+
+
+def write_parquet_table(
+    root: str, schema: str, table: str, table_schema: TableSchema, columns: dict
+):
+    """Write host columns as one parquet file (the export half of the
+    ingest path; the reference writes via ParquetWriter)."""
+    pa, pq = _arrow()
+    os.makedirs(os.path.join(root, schema), exist_ok=True)
+    arrays = []
+    names = []
+    for c, t in table_schema.columns:
+        vals = columns[c]
+        valid = None
+        if isinstance(vals, tuple):
+            vals, valid = vals
+        mask = None if valid is None else ~np.asarray(valid, dtype=bool)
+        if isinstance(t, T.VarcharType):
+            arr = pa.array(list(vals), type=pa.string(), mask=mask)
+        elif isinstance(t, T.DecimalType):
+            import decimal
+
+            py = [
+                decimal.Decimal(int(v)).scaleb(-t.scale)
+                for v in np.asarray(vals)
+            ]
+            arr = pa.array(py, type=pa.decimal128(t.precision, t.scale), mask=mask)
+        elif isinstance(t, T.DateType):
+            arr = pa.array(
+                np.asarray(vals, dtype=np.int32), type=pa.date32(), mask=mask
+            )
+        else:
+            arr = pa.array(np.asarray(vals), mask=mask)
+        arrays.append(arr)
+        names.append(c)
+    pq.write_table(
+        pa.Table.from_arrays(arrays, names=names),
+        os.path.join(root, schema, f"{table}.parquet"),
+    )

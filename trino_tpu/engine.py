@@ -15,6 +15,7 @@ ExplainAnalyzeOperator analog, MAIN/operator/ExplainAnalyzeOperator.java).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -55,6 +56,10 @@ class QueryRunner:
         self.metadata = metadata or Metadata()
         self.session = session or Session()
         self.mesh = mesh
+        # statements execute serially per runner: the executor's scan
+        # cache, jit cache and the session are shared mutable state
+        # (the coordinator's per-query threads all funnel through here)
+        self._lock = threading.RLock()
         # one executor across queries: keeps the jit-program cache and
         # device-resident scanned tables warm (a Trino worker's lifetime)
         if mesh is not None:
@@ -95,6 +100,10 @@ class QueryRunner:
         return plan, self.executor.execute(plan)
 
     def execute(self, sql: str) -> QueryResult:
+        with self._lock:
+            return self._execute(sql)
+
+    def _execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
@@ -143,6 +152,20 @@ class QueryRunner:
                 val = v.text
             self.session.properties[stmt.name] = val
             return QueryResult(["result"], [("SET SESSION",)])
+        if isinstance(stmt, ast.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, ast.CreateTableAs):
+            return self._create_table_as(stmt)
+        if isinstance(stmt, ast.InsertInto):
+            return self._insert(stmt)
+        if isinstance(stmt, ast.DropTable):
+            cat, sch, tab = self._qualify(stmt.name)
+            conn = self.metadata.connector(cat)
+            if stmt.if_exists and tab not in conn.list_tables(sch):
+                return QueryResult(["result"], [("DROP TABLE",)])
+            conn.drop_table(sch, tab)
+            self.executor.invalidate_scan(cat, sch, tab)
+            return QueryResult(["result"], [("DROP TABLE",)])
         plan = self.plan_stmt(stmt)
         page = self.executor.execute(plan)
         ordered = _has_order(plan)
@@ -152,6 +175,92 @@ class QueryRunner:
             ordered=ordered,
             plan=plan,
         )
+
+    # ---- DDL / DML (DataDefinitionExecution + TableWriter analog,
+    # MAIN/execution/CreateTableTask.java, MAIN/operator/TableWriterOperator.java)
+
+    def _qualify(self, parts) -> tuple[str, str, str]:
+        parts = list(parts)
+        if len(parts) == 3:
+            return parts[0], parts[1], parts[2]
+        if len(parts) == 2:
+            return self.session.catalog, parts[0], parts[1]
+        return self.session.catalog, self.session.schema, parts[0]
+
+    def _create_table(self, stmt: ast.CreateTable) -> QueryResult:
+        from trino_tpu import types as T
+        from trino_tpu.connectors.base import TableSchema
+
+        cat, sch, tab = self._qualify(stmt.name)
+        conn = self.metadata.connector(cat)
+        if stmt.if_not_exists and tab in conn.list_tables(sch):
+            return QueryResult(["result"], [("CREATE TABLE",)])
+        ts = TableSchema(
+            tab,
+            [(c, T.type_from_name(tn)) for c, tn in stmt.columns],
+        )
+        conn.create_table(sch, tab, ts)
+        return QueryResult(["result"], [("CREATE TABLE",)])
+
+    def _create_table_as(self, stmt: ast.CreateTableAs) -> QueryResult:
+        from trino_tpu.connectors.base import TableSchema
+
+        cat, sch, tab = self._qualify(stmt.name)
+        conn = self.metadata.connector(cat)
+        if stmt.if_not_exists and tab in conn.list_tables(sch):
+            return QueryResult(["rows"], [(0,)])
+        plan = self.plan_stmt(stmt.query)
+        page = self.executor.execute(plan)
+        names = list(plan.names)
+        types = [plan.outputs[s] for s in plan.symbols]
+        ts = TableSchema(tab, list(zip(names, types)))
+        conn.create_table(sch, tab, ts)
+        cols = _rows_to_columns(ts, names, page.to_pylist())
+        n = conn.insert(sch, tab, cols)
+        self.executor.invalidate_scan(cat, sch, tab)
+        return QueryResult(["rows"], [(n,)])
+
+    def _insert(self, stmt: ast.InsertInto) -> QueryResult:
+        cat, sch, tab = self._qualify(stmt.name)
+        conn = self.metadata.connector(cat)
+        ts = conn.table_schema(sch, tab)
+        target_cols = stmt.columns or ts.column_names
+        if stmt.rows is not None:
+            for row in stmt.rows:
+                if len(row) != len(target_cols):
+                    raise ValueError(
+                        f"INSERT row has {len(row)} values but "
+                        f"{len(target_cols)} target columns"
+                    )
+            rows = [
+                tuple(
+                    _literal_value(e, ts.column_type(c))
+                    for e, c in zip(row, target_cols)
+                )
+                for row in stmt.rows
+            ]
+        else:
+            plan = self.plan_stmt(stmt.query)
+            if len(plan.symbols) != len(target_cols):
+                raise ValueError(
+                    f"INSERT query has {len(plan.symbols)} columns but "
+                    f"{len(target_cols)} target columns"
+                )
+            page = self.executor.execute(plan)
+            rows = page.to_pylist()
+        # align to the table's column order, NULL-filling the rest
+        idx = {c: i for i, c in enumerate(target_cols)}
+        full_rows = [
+            tuple(
+                row[idx[c]] if c in idx else None
+                for c, _ in ts.columns
+            )
+            for row in rows
+        ]
+        cols = _rows_to_columns(ts, ts.column_names, full_rows)
+        n = conn.insert(sch, tab, cols)
+        self.executor.invalidate_scan(cat, sch, tab)
+        return QueryResult(["rows"], [(n,)])
 
     # ---- EXPLAIN ---------------------------------------------------------
 
@@ -219,6 +328,81 @@ def _annotated_tree(node: P.PlanNode, stats, indent: int = 0) -> str:
     for s in node.sources:
         lines.append(_annotated_tree(s, stats, indent + 1))
     return "\n".join(lines)
+
+
+def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:
+    """Python result rows -> host storage columns (values, valid)."""
+    import numpy as np
+
+    from trino_tpu import types as T
+
+    out = {}
+    for i, (c, t) in enumerate(zip(names, [ts.column_type(n) for n in names])):
+        raw = [r[i] for r in rows]
+        valid = np.array([v is not None for v in raw], dtype=bool)
+        if isinstance(t, T.VarcharType):
+            vals = np.array(
+                ["" if v is None else str(v) for v in raw], dtype=object
+            )
+        elif isinstance(t, T.DecimalType):
+            vals = np.array(
+                [
+                    0 if v is None else _to_unscaled(v, t.scale)
+                    for v in raw
+                ],
+                dtype=np.int64,
+            )
+        elif isinstance(t, T.DateType):
+            vals = np.array(
+                [
+                    0 if v is None else (
+                        T.parse_date(v) if isinstance(v, str) else int(v)
+                    )
+                    for v in raw
+                ],
+                dtype=t.np_dtype,
+            )
+        else:
+            vals = np.array(
+                [0 if v is None else v for v in raw], dtype=t.np_dtype
+            )
+        out[c] = (vals, None if valid.all() else valid)
+    return out
+
+
+def _to_unscaled(v, scale: int) -> int:
+    from decimal import Decimal
+
+    if isinstance(v, Decimal):
+        return int(v.scaleb(scale))
+    if isinstance(v, int):
+        return v * 10**scale
+    if isinstance(v, str):
+        return int(Decimal(v).scaleb(scale))
+    return round(float(v) * 10**scale)
+
+
+def _literal_value(e: ast.Expr, t):
+    """Evaluate an INSERT VALUES literal expression host-side."""
+    if isinstance(e, ast.NullLit):
+        return None
+    if isinstance(e, (ast.IntLit, ast.FloatLit, ast.StrLit, ast.BoolLit)):
+        return e.value
+    if isinstance(e, ast.DecimalLit):
+        from decimal import Decimal
+
+        return Decimal(e.text)
+    if isinstance(e, ast.DateLit):
+        return e.text
+    if (
+        isinstance(e, ast.Unary)
+        and e.op == "-"
+        and isinstance(e.arg, (ast.IntLit, ast.FloatLit))
+    ):
+        return -e.arg.value
+    raise NotImplementedError(
+        f"INSERT VALUES supports literals only, got {type(e).__name__}"
+    )
 
 
 def _has_order(plan: P.PlanNode) -> bool:

@@ -48,6 +48,12 @@ class LocalExecutor:
         #: (catalog, schema, table) -> {column name: Column}; "" -> mask
         self._scan_cache: dict = {}
 
+    def invalidate_scan(self, catalog: str, schema: str, table: str):
+        """Drop cached device pages for a table (called after writes —
+        the reference's memory connector versions table handles the
+        same way)."""
+        self._scan_cache.pop((catalog, schema, table), None)
+
     def execute(self, node: P.PlanNode) -> Page:
         if isinstance(node, stage.FUSABLE):
             chain: list[P.PlanNode] = []
@@ -220,8 +226,13 @@ class LocalExecutor:
                 cache[""] = jnp.asarray(mask)
             by_col = {c: s for s, c in node.assignments.items()}
             for cname in missing:
+                v = cols[cname]
+                valid = None
+                if isinstance(v, tuple):
+                    v, valid = v
                 cache[cname] = Column.from_numpy(
-                    node.outputs[by_col[cname]], cols[cname], capacity=cap
+                    node.outputs[by_col[cname]], v, valid=valid,
+                    capacity=cap,
                 )
             cache["#rows"] = n
         names = list(node.assignments)

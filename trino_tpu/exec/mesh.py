@@ -186,6 +186,10 @@ class MeshExecutor(LocalExecutor):
 
     # ---- scan / gather / scatter ----------------------------------------
 
+    def invalidate_scan(self, catalog: str, schema: str, table: str):
+        super().invalidate_scan(catalog, schema, table)
+        self._dist_scan_cache.pop((catalog, schema, table), None)
+
     def _shard_layout(self, n: int) -> tuple[int, int]:
         """(rows per shard, padded per-shard capacity) for n rows."""
         per = -(-max(n, 1) // self.n_shards)  # ceil
@@ -215,8 +219,12 @@ class MeshExecutor(LocalExecutor):
                 )
             by_col = {c: s for s, c in node.assignments.items()}
             for cname in missing:
+                v = cols[cname]
+                valid = None
+                if isinstance(v, tuple):
+                    v, valid = v
                 col = Column.from_numpy(
-                    node.outputs[by_col[cname]], cols[cname],
+                    node.outputs[by_col[cname]], v, valid=valid,
                     capacity=max(n, 1),
                 )
                 cache[cname] = Column(
@@ -224,7 +232,9 @@ class MeshExecutor(LocalExecutor):
                     self._shard_split(
                         np.asarray(col.data[:n]), n, per, cap
                     ),
-                    None,
+                    None if col.valid is None else self._shard_split(
+                        np.asarray(col.valid[:n]), n, per, cap
+                    ),
                     col.dictionary,
                 )
         names = list(node.assignments)

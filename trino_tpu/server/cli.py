@@ -86,18 +86,22 @@ def main(argv=None) -> int:
         if args.execute:
             return run_one(args.execute)
         print("trino-tpu> ", end="", flush=True)
-        buf: list[str] = []
+        buf = ""
+        quitting = False
         for line in sys.stdin:
-            buf.append(line)
-            if ";" in line:
-                stmt = "".join(buf)
-                buf = []
-                if stmt.strip().rstrip(";").strip().lower() in ("quit", "exit"):
+            buf += line
+            while ";" in buf:
+                stmt, buf = buf.split(";", 1)
+                if stmt.strip().lower() in ("quit", "exit"):
+                    quitting = True
                     break
                 run_one(stmt)
-                print("trino-tpu> ", end="", flush=True)
-            else:
-                print("        -> ", end="", flush=True)
+            if quitting:
+                break
+            prompt = "trino-tpu> " if not buf.strip() else "        -> "
+            print(prompt, end="", flush=True)
+        if not quitting and buf.strip():
+            run_one(buf)  # final statement without a trailing ';'
         return 0
     finally:
         if coordinator is not None:

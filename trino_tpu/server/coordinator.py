@@ -158,7 +158,17 @@ class Coordinator:
             self._seq += 1
             qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{self._seq:05d}_{uuid.uuid4().hex[:5]}"
         q = QueryState(query_id=qid, slug=secrets.token_hex(8), sql=sql)
-        self._queries[qid] = q
+        with self._lock:
+            self._queries[qid] = q
+            # bounded history: release old finished results (the
+            # reference's QueryTracker expiration analog)
+            if len(self._queries) > 200:
+                done = [
+                    k for k, v in self._queries.items()
+                    if v.state in ("FINISHED", "FAILED")
+                ]
+                for k in done[: len(self._queries) - 200]:
+                    del self._queries[k]
 
         def run():
             if q.cancelled:
@@ -191,6 +201,8 @@ class Coordinator:
                 q.error = "Query was canceled"
 
     def list_queries(self) -> list[dict]:
+        with self._lock:
+            snapshot = list(self._queries.values())
         return [
             {
                 "queryId": q.query_id,
@@ -199,7 +211,7 @@ class Coordinator:
                 "error": q.error,
                 "errorDetail": q.error_detail,
             }
-            for q in self._queries.values()
+            for q in snapshot
         ]
 
     # ---- protocol responses ----------------------------------------------

@@ -314,3 +314,32 @@ class SessionSet(Statement):
 @dataclass
 class Use(Statement):
     parts: tuple[str, ...]
+
+
+@dataclass
+class CreateTable(Statement):
+    name: tuple[str, ...]
+    columns: list[tuple[str, str]]  # (column, type name)
+    if_not_exists: bool = False
+
+
+@dataclass
+class CreateTableAs(Statement):
+    name: tuple[str, ...]
+    query: "Query"
+    if_not_exists: bool = False
+
+
+@dataclass
+class InsertInto(Statement):
+    name: tuple[str, ...]
+    columns: "Optional[list[str]]" = None
+    query: "Optional[Query]" = None
+    #: VALUES rows (each a list of literal expressions), when not a query
+    rows: "Optional[list[list[Expr]]]" = None
+
+
+@dataclass
+class DropTable(Statement):
+    name: tuple[str, ...]
+    if_exists: bool = False

@@ -38,7 +38,7 @@ KEYWORDS = {
     "year", "month", "day", "hour", "minute", "second", "substring", "for",
     "values", "create", "table", "insert", "into", "drop", "count",
     "over", "partition", "rows", "range", "unbounded", "preceding",
-    "following", "current", "row",
+    "following", "current", "row", "if",
 }
 
 _TOKEN_RE = re.compile(

@@ -113,6 +113,61 @@ class _Parser:
                 name_parts.append(self.ident())
             self.expect_op("=")
             return ast.SessionSet(".".join(name_parts), self.expr())
+        if self.accept_kw("create"):
+            self.expect_kw("table")
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            name = self.qualified_name()
+            if self.accept_kw("as"):
+                return ast.CreateTableAs(name, self.query(), if_not_exists)
+            self.expect_op("(")
+            columns = []
+            while True:
+                col = self.ident()
+                columns.append((col, self._type_name()))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return ast.CreateTable(name, columns, if_not_exists)
+        if self.accept_kw("insert"):
+            self.expect_kw("into")
+            name = self.qualified_name()
+            columns = None
+            if self.at_op("("):
+                # lookahead: column list vs subquery
+                save = self.i
+                self.next()
+                try:
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                    columns = cols
+                except SqlSyntaxError:
+                    self.i = save
+            if self.accept_kw("values"):
+                rows = []
+                while True:
+                    self.expect_op("(")
+                    row = [self.expr()]
+                    while self.accept_op(","):
+                        row.append(self.expr())
+                    self.expect_op(")")
+                    rows.append(row)
+                    if not self.accept_op(","):
+                        break
+                return ast.InsertInto(name, columns, rows=rows)
+            return ast.InsertInto(name, columns, query=self.query())
+        if self.accept_kw("drop"):
+            self.expect_kw("table")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropTable(self.qualified_name(), if_exists)
         return self.query()
 
     def qualified_name(self) -> tuple[str, ...]:
