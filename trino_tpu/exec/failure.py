@@ -1,0 +1,58 @@
+"""Failure injection + stage retry.
+
+The analog of the reference's FailureInjector + task-retry unit
+(MAIN/execution/FailureInjector.java:39, injectTaskFailure:61; retry
+semantics of EventDrivenFaultTolerantQueryScheduler,
+MAIN/execution/scheduler/faulttolerant/): tests arm failures for a
+stage tag and attempt range; the mesh executor consults the injector
+before each stage-shard program and re-invokes the program on an
+injected failure. The retry unit works because stage inputs are
+retained device arrays — "spooled stage output" in the reference maps
+to XLA buffers that outlive the failed invocation here.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["InjectedFailure", "FailureInjector"]
+
+
+class InjectedFailure(RuntimeError):
+    """A test-armed failure (InjectionType.TASK_FAILURE analog)."""
+
+
+class FailureInjector:
+    def __init__(self, max_attempts: int = 4):
+        self.max_attempts = max_attempts
+        self._rules: dict[str, int] = {}
+        self._lock = threading.Lock()
+        #: log of (tag, attempt) failures actually injected
+        self.injected: list[tuple[str, int]] = []
+        #: log of (tag, attempt) stage executions that ran
+        self.attempts: list[tuple[str, int]] = []
+
+    def fail_stage(self, tag: str, times: int = 1):
+        """Arm ``times`` consecutive failures for stages whose tag
+        starts with ``tag`` (attempts 0..times-1 fail; the retry at
+        attempt ``times`` succeeds)."""
+        with self._lock:
+            self._rules[tag] = times
+
+    def reset(self):
+        with self._lock:
+            self._rules.clear()
+            self.injected.clear()
+            self.attempts.clear()
+
+    def check(self, tag: str, attempt: int):
+        if not self._rules:
+            return  # production fast path: no bookkeeping, no lock
+        with self._lock:
+            self.attempts.append((tag, attempt))
+            for rule, times in self._rules.items():
+                if tag.startswith(rule) and attempt < times:
+                    self.injected.append((tag, attempt))
+                    raise InjectedFailure(
+                        f"injected failure: stage {tag!r} attempt {attempt}"
+                    )

@@ -102,7 +102,45 @@ class LocalExecutor:
         returned overflow flag trips; the learned capacity persists per
         chain shape so repeated queries never re-overflow (capacity
         hysteresis — the FlatHash table survives across pages in the
-        reference, MAIN/operator/FlatHash.java:316)."""
+        reference, MAIN/operator/FlatHash.java:316).
+
+        With session property ``max_chunk_rows`` set, aggregations over
+        wider inputs run memory-bounded: partial aggregation per row
+        chunk, then a FINAL combine over the concatenated partials —
+        the working-set analog of the reference's spillable aggregation
+        (MAIN/operator/aggregation/builder/SpillableHashAggregationBuilder.java:46);
+        the chunk partials play the role of spilled sorted runs."""
+        chunk_rows = int(self.session.properties.get("max_chunk_rows", 0) or 0)
+        if chunk_rows > 0 and page.capacity > chunk_rows:
+            # only SINGLE-step aggregations chunk: the FINAL combine
+            # over the partial states materializes O(distinct keys),
+            # like the reference's spill merge pass
+            agg_i = next(
+                (
+                    i for i, n in enumerate(chain)
+                    if isinstance(n, P.Aggregate)
+                    and n.group_keys
+                    and n.step == "SINGLE"
+                ),
+                None,
+            )
+            if (
+                agg_i is not None
+                and not any(
+                    c.distinct
+                    for c in chain[agg_i].aggregates.values()
+                )
+                # every pre node must be row-local: a Limit/Sort/TopN
+                # before the aggregate is global, not per-chunk
+                and all(
+                    isinstance(n, (P.Filter, P.Project))
+                    for n in chain[:agg_i]
+                )
+                and _splittable(chain[agg_i])
+            ):
+                return self._run_chain_chunked(
+                    chain, page, agg_i, chunk_rows
+                )
         caps_key = (
             "caps", tuple(self._node_key(n) for n in chain), page.capacity
         )
@@ -176,6 +214,25 @@ class LocalExecutor:
             if pad_capacity(out.known_rows) < out.capacity:
                 out = self._compact(out)
             return out
+
+    def _run_chain_chunked(
+        self, chain: list[P.PlanNode], page: Page, agg_i: int, chunk_rows: int
+    ) -> Page:
+        """Partial-aggregate fixed-size row chunks, concatenate the
+        partial states, FINAL-combine (grace aggregation)."""
+        from trino_tpu.plan.distribute import _split_aggregate
+
+        partial, final = _split_aggregate(chain[agg_i])
+        pre = chain[:agg_i]
+        post = chain[agg_i + 1:]
+        partials = []
+        for lo in range(0, page.capacity, chunk_rows):
+            hi = min(lo + chunk_rows, page.capacity)
+            partials.append(
+                self._run_chain(pre + [partial], _slice_page(page, lo, hi))
+            )
+        combined = _concat_pages(partials)
+        return self._run_chain([final] + post, combined)
 
     # ---- expression evaluation ------------------------------------------
 
@@ -800,6 +857,55 @@ class LocalExecutor:
                     )
                 )
         return Page(names, cols, live)
+
+
+def _splittable(agg: P.Aggregate) -> bool:
+    """Probe partial/final decomposability BEFORE running chunks, so a
+    non-splittable aggregate never discards computed partials."""
+    from trino_tpu.plan.distribute import _split_aggregate
+
+    try:
+        _split_aggregate(agg)
+        return True
+    except NotImplementedError:
+        return False
+
+
+def _slice_page(page: Page, lo: int, hi: int) -> Page:
+    """Row-range view of a page (device slices are cheap)."""
+    cols = [
+        Column(
+            c.type, c.data[lo:hi],
+            None if c.valid is None else c.valid[lo:hi],
+            c.dictionary,
+        )
+        for c in page.columns
+    ]
+    return Page(list(page.names), cols, page.mask[lo:hi])
+
+
+def _concat_pages(pages: list[Page]) -> Page:
+    """Concatenate same-layout pages (chunk partials share column
+    types and dictionaries by construction)."""
+    if len(pages) == 1:
+        return pages[0]
+    first = pages[0]
+    cols = []
+    for i, c in enumerate(first.columns):
+        data = jnp.concatenate([p.columns[i].data for p in pages])
+        if any(p.columns[i].valid is not None for p in pages):
+            valid = jnp.concatenate([
+                (
+                    jnp.ones(p.columns[i].data.shape, dtype=jnp.bool_)
+                    if p.columns[i].valid is None else p.columns[i].valid
+                )
+                for p in pages
+            ])
+        else:
+            valid = None
+        cols.append(Column(c.type, data, valid, c.dictionary))
+    mask = jnp.concatenate([p.mask for p in pages])
+    return Page(list(first.names), cols, mask)
 
 
 def _pair_layout(a: Page, b: Page) -> ColumnLayout:

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec import stage
+from trino_tpu.exec.failure import FailureInjector, InjectedFailure
 from trino_tpu.exec.local import LocalExecutor
 from trino_tpu.expr.compiler import compile_expr, ColumnLayout
 from trino_tpu.metadata import Metadata, Session
@@ -148,6 +149,27 @@ class MeshExecutor(LocalExecutor):
         self._row_sharding = NamedSharding(self.mesh, PS(axis))
         self._dist_scan_cache: dict = {}
         self._mesh_jit_cache: dict = {}
+        #: test hook: arm per-stage failures; stage programs retry
+        #: (FailureInjector analog, MAIN/execution/FailureInjector.java:39)
+        self.failure_injector = FailureInjector()
+
+    def _attempt(self, tag: str, call):
+        """Run one stage-shard program with injected-failure retry.
+
+        The retry unit of the fault-tolerant scheduler
+        (EventDrivenFaultTolerantQueryScheduler analog): stage inputs
+        are retained device arrays, so a failed invocation simply
+        re-runs against them — the spooled-stage-output durability of
+        the reference comes free from XLA buffer lifetimes."""
+        attempt = 0
+        while True:
+            try:
+                self.failure_injector.check(tag, attempt)
+                return call()
+            except InjectedFailure:
+                attempt += 1
+                if attempt >= self.failure_injector.max_attempts:
+                    raise
 
     # ---- boundaries ------------------------------------------------------
 
@@ -372,7 +394,9 @@ class MeshExecutor(LocalExecutor):
                 self._mesh_jit_cache[key] = hit
             prog, out_layout, meta = hit
             leaves, _ = _page_leaves(sp)
-            env, mask, flags = prog(*leaves)
+            env, mask, flags = self._attempt(
+                "chain", lambda: prog(*leaves)
+            )
             if flags:
                 vals = jax.device_get(flags)
                 overflowed = [i for i, v in vals.items() if v]
@@ -454,7 +478,9 @@ class MeshExecutor(LocalExecutor):
                     )
                 )
                 self._mesh_jit_cache[key] = prog
-            out, rlive, ovf = prog(dest, *leaves)
+            out, rlive, ovf = self._attempt(
+                "exchange", lambda: prog(dest, *leaves)
+            )
             if bool(jax.device_get(ovf)) and bucket_cap < shard_cap:
                 bucket_cap = min(bucket_cap * 4, shard_cap)
                 continue
@@ -534,7 +560,9 @@ class MeshExecutor(LocalExecutor):
                 )
             )
             self._mesh_jit_cache[key] = prog
-        totals = jax.device_get(prog(*leaves))
+        totals = jax.device_get(
+            self._attempt("join-count", lambda: prog(*leaves))
+        )
         return pad_capacity(int(max(totals.max(), 1)))
 
     def _join_sig(self, page, replicated: bool) -> tuple:
@@ -692,7 +720,9 @@ class MeshExecutor(LocalExecutor):
                 )
             )
             self._mesh_jit_cache[key_b] = prog_b
-        outs, mask = prog_b(*p_leaves, *b_leaves)
+        outs, mask = self._attempt(
+            "join-expand", lambda: prog_b(*p_leaves, *b_leaves)
+        )
         cols, i = [], 0
         for s, from_probe, has_valid in out_meta:
             src = p_cols[s] if from_probe else b_cols[s]
@@ -777,7 +807,9 @@ class MeshExecutor(LocalExecutor):
                 )
             )
             self._mesh_jit_cache[key_b] = prog_b
-        outs, mask = prog_b(*p_leaves, *b_leaves)
+        outs, mask = self._attempt(
+            "join-expand", lambda: prog_b(*p_leaves, *b_leaves)
+        )
         cols, i = [], 0
         for s, from_probe, has_valid in out_meta:
             src = p_cols[s] if from_probe else b_cols[s]
@@ -900,7 +932,9 @@ class MeshExecutor(LocalExecutor):
                 )
             )
             self._mesh_jit_cache[key_b] = prog_b
-        matched = prog_b(*p_leaves, *b_leaves)
+        matched = self._attempt(
+            "semi-join", lambda: prog_b(*p_leaves, *b_leaves)
+        )
         from trino_tpu import types as T
 
         names = list(sp.names) + [node.match_symbol]
