@@ -1,0 +1,156 @@
+"""Scalar + aggregate function breadth vs the sqlite oracle.
+
+Covers the round-2 additions: math/string scalars, nullif/least/
+greatest, count_if, approx_distinct (exact under the hood), and
+max_by/min_by (reference: MAIN/operator/scalar/MathFunctions.java,
+StringFunctions.java, MAIN/operator/aggregation/).
+"""
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+from trino_tpu.testing.golden import (
+    assert_rows_match,
+    load_tpch_sqlite,
+    to_sqlite,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle(runner):
+    data = runner.metadata.connector("tpch").data("tiny")
+    return load_tpch_sqlite(data)
+
+
+def check(runner, oracle, sql, abs_tol=1e-9):
+    result = runner.execute(sql)
+    expected = oracle.execute(to_sqlite(sql)).fetchall()
+    assert_rows_match(
+        result.rows, expected, ordered=result.ordered, abs_tol=abs_tol
+    )
+
+
+def test_math_functions(runner, oracle):
+    check(
+        runner, oracle,
+        "select n_nationkey, exp(n_regionkey), ln(n_nationkey + 1), "
+        "power(n_regionkey, 2), sign(n_nationkey - 10) "
+        "from nation order by n_nationkey",
+        abs_tol=1e-9,
+    )
+
+
+def test_trig(runner):
+    import math
+
+    rows = runner.execute(
+        "select sin(0), cos(0), degrees(acos(0)) from nation limit 1"
+    ).rows
+    assert abs(rows[0][0]) < 1e-12
+    assert rows[0][1] == 1.0
+    assert abs(rows[0][2] - 90.0) < 1e-9
+
+
+def test_string_functions(runner, oracle):
+    check(
+        runner, oracle,
+        "select n_name, length(n_name), replace(n_name, 'A', '@'), "
+        "ltrim(n_name), rtrim(n_name) from nation order by n_name",
+    )
+
+
+def test_reverse_strpos_startswith(runner):
+    rows = runner.execute(
+        "select r_regionkey, reverse(r_name), strpos(r_name, 'ER'), "
+        "starts_with(r_name, 'A') from region order by r_regionkey"
+    ).rows
+    assert rows[0][1:] == ("ACIRFA", 0, True)     # AFRICA
+    assert rows[1][1:] == ("ACIREMA", 3, True)    # AMERICA
+    assert rows[3][1:] == ("EPORUE", 0, False)    # EUROPE
+
+
+def test_nullif_least_greatest(runner, oracle):
+    # unordered: Trino sorts NULLs last for ASC, sqlite sorts them first
+    result = runner.execute(
+        "select nullif(n_regionkey, 2), min(n_nationkey) "
+        "from nation group by 1"
+    )
+    expected = oracle.execute(
+        "select nullif(n_regionkey, 2), min(n_nationkey) "
+        "from nation group by 1"
+    ).fetchall()
+    assert_rows_match(result.rows, expected, ordered=False)
+    rows = runner.execute(
+        "select least(3, 1, 2), greatest(1.5, 2.5), "
+        "least(1, null) from nation limit 1"
+    ).rows
+    assert rows[0][0] == 1
+    assert rows[0][1] == 2.5
+    assert rows[0][2] is None
+
+
+def test_count_if(runner, oracle):
+    result = runner.execute(
+        "select o_orderstatus, count_if(o_totalprice > 100000) "
+        "from orders group by o_orderstatus order by 1"
+    )
+    expected = oracle.execute(
+        "select o_orderstatus, "
+        "sum(case when o_totalprice > 100000 then 1 else 0 end) "
+        "from orders group by o_orderstatus order by 1"
+    ).fetchall()
+    assert_rows_match(result.rows, expected, ordered=True)
+
+
+def test_approx_distinct(runner):
+    # exact implementation: equals count(distinct ...)
+    a = runner.execute(
+        "select approx_distinct(o_custkey) from orders"
+    ).rows
+    b = runner.execute(
+        "select count(distinct o_custkey) from orders"
+    ).rows
+    assert a == b
+
+
+def test_max_by_min_by(runner, oracle):
+    result = runner.execute(
+        "select o_custkey, max_by(o_orderkey, o_totalprice), "
+        "min_by(o_orderkey, o_totalprice) "
+        "from orders where o_custkey < 20 group by o_custkey order by 1"
+    )
+    expected = oracle.execute(
+        "select o_custkey, "
+        "(select o2.o_orderkey from orders o2 where o2.o_custkey = o.o_custkey"
+        "  order by o2.o_totalprice desc limit 1), "
+        "(select o3.o_orderkey from orders o3 where o3.o_custkey = o.o_custkey"
+        "  order by o3.o_totalprice asc limit 1) "
+        "from orders o where o_custkey < 20 "
+        "group by o_custkey order by 1"
+    ).fetchall()
+    assert_rows_match(result.rows, expected, ordered=True)
+
+
+def test_max_by_varchar_and_global(runner):
+    rows = runner.execute(
+        "select max_by(n_name, n_nationkey), min_by(n_name, n_nationkey) "
+        "from nation"
+    ).rows
+    assert rows == [("UNITED STATES", "ALGERIA")]
+
+
+def test_max_by_distributed():
+    from trino_tpu.parallel.core import make_mesh
+
+    sql = (
+        "select o_orderstatus, max_by(o_orderkey, o_totalprice) "
+        "from orders group by o_orderstatus order by 1"
+    )
+    local = QueryRunner.tpch("tiny").execute(sql).rows
+    dist = QueryRunner.tpch("tiny", mesh=make_mesh()).execute(sql).rows
+    assert local == dist

@@ -800,6 +800,14 @@ class Analyzer:
                 args = tuple(ea.analyze(a) for a in fc.args)
                 if name == "count":
                     call = AggCall("count", args, T.BIGINT, distinct=fc.distinct)
+                elif name == "approx_distinct":
+                    # exact distinct count satisfies approx semantics
+                    # (reference: ApproximateCountDistinctAggregation);
+                    # the optional max-standard-error argument is
+                    # irrelevant for an exact count
+                    call = AggCall(
+                        "count", args[:1], T.BIGINT, distinct=True
+                    )
                 else:
                     rt = agg_result_type(name, args[0].type if args else None)
                     call = AggCall(name, args, rt, distinct=fc.distinct)
@@ -1255,6 +1263,45 @@ class ExprAnalyzer:
                 rtype = T.common_super_type(rtype, a.type)
             args = [_cast_to(a, rtype) for a in args]
             return Call(rtype, "coalesce", tuple(args))
+        if name == "nullif":
+            # result is ``a`` with validity cleared where a = b (a
+            # special form so dictionary-backed varchar flows through)
+            a = self.analyze(e.args[0])
+            b = self.analyze(e.args[1])
+            ct = T.common_super_type(a.type, b.type)
+            cond = Call(
+                T.BOOLEAN, "eq", (_cast_to(a, ct), _cast_to(b, ct))
+            )
+            return Call(a.type, "nullif", (a, cond))
+        if name in ("least", "greatest"):
+            args = [self.analyze(a) for a in e.args]
+            rtype = args[0].type
+            for a in args[1:]:
+                rtype = T.common_super_type(rtype, a.type)
+            if isinstance(rtype, T.VarcharType):
+                raise AnalysisError(
+                    f"{name} over varchar is not supported yet"
+                )
+            args = [_cast_to(a, rtype) for a in args]
+            cmp = "le" if name == "least" else "ge"
+            out = args[0]
+            for a in args[1:]:
+                pick = Call(
+                    rtype, "if",
+                    (Call(T.BOOLEAN, cmp, (out, a)), out, a),
+                )
+                # NULL if any argument is NULL (reference semantics)
+                either_null = Call(
+                    T.BOOLEAN, "or", (
+                        Call(T.BOOLEAN, "is_null", (out,)),
+                        Call(T.BOOLEAN, "is_null", (a,)),
+                    ),
+                )
+                out = Call(
+                    rtype, "if",
+                    (either_null, Literal(rtype, None), pick),
+                )
+            return out
         if name not in SCALAR_FNS:
             raise AnalysisError(f"unknown function {name}")
         ir_name, rt_fn = SCALAR_FNS[name]

@@ -143,13 +143,18 @@ def agg_result_type(name: str, arg_type: T.DataType | None) -> T.DataType:
         return T.DOUBLE
     if name == "bool_and" or name == "bool_or":
         return T.BOOLEAN
+    if name in ("count_if", "approx_distinct"):
+        return T.BIGINT
+    if name in ("max_by", "min_by"):
+        return arg_type  # first argument's type
     raise AnalysisError(f"unknown aggregate function {name}")
 
 
 AGG_FNS = {
     "count", "sum", "avg", "min", "max", "any_value", "arbitrary",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
-    "bool_and", "bool_or",
+    "bool_and", "bool_or", "count_if", "approx_distinct",
+    "max_by", "min_by",
 }
 
 #: scalar fn name -> (ir_name, result_type fn(arg_types))
@@ -168,4 +173,35 @@ SCALAR_FNS = {
     "month": ("extract_month", lambda ts: T.BIGINT),
     "day": ("extract_day", lambda ts: T.BIGINT),
     "coalesce": ("coalesce", None),  # special typing
+    # math (reference: MAIN/operator/scalar/MathFunctions.java)
+    "exp": ("exp", lambda ts: T.DOUBLE),
+    "ln": ("ln", lambda ts: T.DOUBLE),
+    "log2": ("log2", lambda ts: T.DOUBLE),
+    "log10": ("log10", lambda ts: T.DOUBLE),
+    "power": ("power", lambda ts: T.DOUBLE),
+    "pow": ("power", lambda ts: T.DOUBLE),
+    "cbrt": ("cbrt", lambda ts: T.DOUBLE),
+    # sign of DECIMAL computes on the unscaled int: type it BIGINT so
+    # +-1/0 is not reinterpreted at the column's scale
+    "sign": (
+        "sign",
+        lambda ts: T.BIGINT if isinstance(ts[0], T.DecimalType) else ts[0],
+    ),
+    "sin": ("sin", lambda ts: T.DOUBLE),
+    "cos": ("cos", lambda ts: T.DOUBLE),
+    "tan": ("tan", lambda ts: T.DOUBLE),
+    "asin": ("asin", lambda ts: T.DOUBLE),
+    "acos": ("acos", lambda ts: T.DOUBLE),
+    "atan": ("atan", lambda ts: T.DOUBLE),
+    "degrees": ("degrees", lambda ts: T.DOUBLE),
+    "radians": ("radians", lambda ts: T.DOUBLE),
+    "mod": ("modulus", lambda ts: ts[0]),
+    # strings (reference: MAIN/operator/scalar/StringFunctions.java)
+    "replace": ("replace", lambda ts: T.VARCHAR),
+    "reverse": ("reverse", lambda ts: T.VARCHAR),
+    "ltrim": ("ltrim", lambda ts: T.VARCHAR),
+    "rtrim": ("rtrim", lambda ts: T.VARCHAR),
+    "length": ("length", lambda ts: T.BIGINT),
+    "strpos": ("strpos", lambda ts: T.BIGINT),
+    "starts_with": ("starts_with", lambda ts: T.BOOLEAN),
 }

@@ -153,6 +153,42 @@ def compute_aggregate(
     if name == "count_all":
         return red.count(), None
 
+    if name == "count_if":
+        data, valid = arg
+        eff = contrib & data
+        if valid is not None:
+            eff = eff & valid
+        return _Reducer(info, capacity, eff, share).count(), None
+
+    if name in ("max_by", "min_by"):
+        (vd, vv), (kd, kv) = arg
+        is_min = name == "min_by"
+        eff = contrib if kv is None else (contrib & kv)
+        kbits = K.order_bits(kd)
+        n = kd.shape[0]
+        if info is None:
+            worst = (
+                jnp.uint64(0xFFFFFFFFFFFFFFFF) if is_min else jnp.uint64(0)
+            )
+            masked = jnp.where(eff, kbits, worst)
+            m = (jnp.min if is_min else jnp.max)(masked)
+            # first CONTRIBUTING row at the extreme — a sentinel-valued
+            # real key must not lose to an excluded row
+            idx = jnp.argmax(eff & (masked == m))[None]
+            has = jnp.any(eff)[None]
+            out = vd[jnp.clip(idx, 0, max(n - 1, 0))]
+            ov = has if vv is None else (has & vv[idx])
+            return out, ov
+        er = _Reducer(info, capacity, eff, share)
+        rows = K.seg_arg_extreme(
+            er._sorted(kbits), er.contrib_s, info, is_min
+        )
+        has = er.count() > 0
+        at = jnp.clip(rows, 0, max(n - 1, 0))
+        out = vd[at]
+        ov = has if vv is None else (has & vv[at])
+        return out, ov
+
     data, valid = arg
     red = red.with_valid(valid)
 

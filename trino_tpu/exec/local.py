@@ -110,6 +110,31 @@ class LocalExecutor:
         the working-set analog of the reference's spillable aggregation
         (MAIN/operator/aggregation/builder/SpillableHashAggregationBuilder.java:46);
         the chunk partials play the role of spilled sorted runs."""
+        # adaptive filter split: a selective leading Filter shrinks the
+        # working capacity for the whole rest of the chain (dead-row
+        # sorts/gathers dominate otherwise). Selectivity is learned per
+        # chain shape; non-selective filters stay fused (the split
+        # costs one extra sync + compaction).
+        if (
+            len(chain) > 1
+            and isinstance(chain[0], P.Filter)
+            and page.capacity >= (1 << 18)
+            and any(
+                isinstance(n, (P.Aggregate, P.Sort, P.TopN))
+                for n in chain[1:]
+            )
+        ):
+            skey = (
+                "selectivity", self._node_key(chain[0]), page.capacity,
+            )
+            sel = self._jit_cache.get(skey)
+            if sel is None or sel <= 0.5:
+                filtered = self._run_chain(chain[:1], page)
+                self._jit_cache[skey] = (
+                    filtered.num_rows() / page.capacity
+                )
+                return self._run_chain(chain[1:], filtered)
+
         chunk_rows = int(self.session.properties.get("max_chunk_rows", 0) or 0)
         if chunk_rows > 0 and page.capacity > chunk_rows:
             # only SINGLE-step aggregations chunk: the FINAL combine

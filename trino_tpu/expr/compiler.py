@@ -200,6 +200,22 @@ class _Compiler:
             return self._string_predicate(expr)
         if name in _STRING_TRANSFORMS:
             return self._string_transform(expr)
+        if name in _DICT_VALUE_FNS:
+            return self._dict_value_fn(expr)
+        if name == "nullif":
+            a = self.compile(expr.args[0])
+            cond = self.compile(expr.args[1])
+
+            def ev_nullif(env, _a=a, _c=cond):
+                d, v = _a.fn(env)
+                cd, cv = _c.fn(env)
+                # nullify only where the comparison is TRUE (an unknown
+                # comparison keeps ``a`` — reference NullIf semantics)
+                nullify = cd if cv is None else (cd & cv)
+                nv = ~nullify if v is None else (v & ~nullify)
+                return d, nv
+
+            return CompiledExpr(ev_nullif, expr.type, a.dictionary)
         if name in ("eq", "ne", "lt", "le", "gt", "ge"):
             return self._comparison(expr)
         if name in ("add", "subtract", "multiply", "divide", "modulus"):
@@ -488,6 +504,29 @@ class _Compiler:
 
         return CompiledExpr(ev, expr.type, new_dict)
 
+    def _dict_value_fn(self, expr: Call) -> CompiledExpr:
+        """length/strpos/starts_with: evaluate per dictionary value on
+        host, gather the result by code on device."""
+        a = self.compile(expr.args[0])
+        if a.dictionary is None:
+            raise NotImplementedError(f"{expr.name} requires a dictionary input")
+        f = _DICT_VALUE_FNS[expr.name]
+        lits = [l.value for l in expr.args[1:]]  # type: ignore[attr-defined]
+        out_dtype = expr.type.np_dtype
+        table = np.asarray(
+            [f(str(v), *lits) for v in a.dictionary.values],
+            dtype=out_dtype,
+        )
+        if not len(table):
+            table = np.zeros(1, dtype=out_dtype)
+        dev_table = jnp.asarray(table)
+
+        def ev(env):
+            data, valid = a.fn(env)
+            return jnp.take(dev_table, data, mode="clip"), valid
+
+        return CompiledExpr(ev, expr.type)
+
     def _arith(self, expr: Call) -> CompiledExpr:
         lhs, rhs = expr.args
         a = self.compile(lhs)
@@ -717,6 +756,18 @@ _STRING_TRANSFORMS: dict[str, Callable] = {
     "lower": lambda s: s.lower(),
     "upper": lambda s: s.upper(),
     "trim": lambda s: s.strip(),
+    "ltrim": lambda s: s.lstrip(),
+    "rtrim": lambda s: s.rstrip(),
+    "reverse": lambda s: s[::-1],
+    "replace": lambda s, find, repl="": s.replace(find, repl),
+}
+
+#: varchar -> numeric/boolean per-dictionary-value functions: evaluate
+#: on the (small) dictionary host-side, gather by code on device
+_DICT_VALUE_FNS: dict[str, Callable] = {
+    "length": lambda s: len(s),
+    "strpos": lambda s, sub: s.find(sub) + 1,
+    "starts_with": lambda s, p: s.startswith(p),
 }
 
 
@@ -745,4 +796,21 @@ _SIMPLE_FNS: dict[str, Callable] = {
     "floor": jnp.floor,
     "ceil": jnp.ceil,
     "round": jnp.round,
+    "exp": jnp.exp,
+    "ln": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "power": lambda a, b: jnp.power(
+        a.astype(jnp.float64), b.astype(jnp.float64)
+    ),
+    "cbrt": jnp.cbrt,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
 }

@@ -217,7 +217,18 @@ def _walk_aggregate(node: P.Aggregate, md: Metadata) -> tuple[P.PlanNode, str]:
             return dc_replace(node, source=ex), "dist"
         return dc_replace(node, source=_gather(src)), "single"
 
-    partial, final = _split_aggregate(node)
+    try:
+        partial, final = _split_aggregate(node)
+    except NotImplementedError:
+        # aggregates without a partial form (e.g. max_by pairs): route
+        # raw rows by group-key hash and aggregate in one step
+        if node.group_keys:
+            ex = P.Exchange(
+                dict(src.outputs), source=src, partitioning="hash",
+                hash_symbols=list(node.group_keys),
+            )
+            return dc_replace(node, source=ex), "dist"
+        return dc_replace(node, source=_gather(src)), "single"
     partial = dc_replace(partial, source=src)
     if node.group_keys:
         ex = P.Exchange(
@@ -237,7 +248,7 @@ def _split_aggregate(node: P.Aggregate) -> tuple[P.Aggregate, P.Aggregate]:
     final_aggs: dict[str, AggCall] = {}
     for sym, call in node.aggregates.items():
         name = call.name
-        if name in ("count", "count_all"):
+        if name in ("count", "count_all", "count_if"):
             partial_aggs[sym] = call
             final_aggs[sym] = AggCall(
                 "count_final", (InputRef(T.BIGINT, sym),), call.type
