@@ -1,0 +1,111 @@
+"""TIMESTAMP type: literals, comparisons, extract, casts, round trips
+(reference: SPI/type/TimestampType.java; stored as int64 microseconds).
+"""
+
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.types import format_timestamp, parse_timestamp
+
+
+@pytest.fixture()
+def runner():
+    md = Metadata()
+    md.register_catalog("m", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="m", schema="default"))
+    r.execute("create table ev (id bigint, at timestamp)")
+    r.execute(
+        "insert into ev values "
+        "(1, timestamp '2024-06-01 10:30:00'), "
+        "(2, timestamp '2024-06-01 23:59:59.5'), "
+        "(3, null)"
+    )
+    return r
+
+
+def test_parse_format_round_trip():
+    for s in (
+        "2024-06-01 10:30:00",
+        "1969-12-31 23:59:59",
+        "2024-02-29 00:00:00.123456",
+    ):
+        expect = s.rstrip("0").rstrip(".") if "." in s else s
+        assert format_timestamp(parse_timestamp(s)) == expect
+
+
+def test_timestamp_rows(runner):
+    rows = runner.execute("select id, at from ev order by id").rows
+    assert rows[0] == (1, "2024-06-01 10:30:00")
+    assert rows[1] == (2, "2024-06-01 23:59:59.5")
+    assert rows[2] == (3, None)
+
+
+def test_extract_fields(runner):
+    rows = runner.execute(
+        "select extract(year from at), extract(month from at), "
+        "extract(day from at), extract(hour from at), "
+        "extract(minute from at), extract(second from at) "
+        "from ev where id = 1"
+    ).rows
+    assert rows == [(2024, 6, 1, 10, 30, 0)]
+
+
+def test_comparisons_and_aggregates(runner):
+    assert runner.execute(
+        "select count(*) from ev where at > timestamp '2024-06-01 12:00:00'"
+    ).rows == [(1,)]
+    assert runner.execute("select min(at), max(at) from ev").rows == [
+        ("2024-06-01 10:30:00", "2024-06-01 23:59:59.5"),
+    ]
+
+
+def test_date_coercion_and_cast(runner):
+    # date literal coerces to timestamp in comparisons
+    assert runner.execute(
+        "select count(*) from ev where at >= date '2024-06-01'"
+    ).rows == [(2,)]
+    assert runner.execute(
+        "select cast(at as date) from ev where id = 2"
+    ).rows == [("2024-06-01",)]
+
+
+def test_group_by_timestamp(runner):
+    runner.execute(
+        "insert into ev values (4, timestamp '2024-06-01 10:30:00')"
+    )
+    rows = runner.execute(
+        "select at, count(*) from ev where at is not null "
+        "group by at order by at"
+    ).rows
+    assert rows[0] == ("2024-06-01 10:30:00", 2)
+
+
+def test_timestamp_parquet_round_trip(tmp_path):
+    import numpy as np
+
+    from trino_tpu.connectors.base import TableSchema
+    from trino_tpu.connectors.parquet import (
+        ParquetConnector,
+        write_parquet_table,
+    )
+    from trino_tpu import types as T
+
+    ts = TableSchema("t", [("a", T.BIGINT), ("at", T.TIMESTAMP)])
+    root = str(tmp_path / "pq")
+    write_parquet_table(
+        root, "s", "t", ts,
+        {
+            "a": np.array([1, 2]),
+            "at": np.array(
+                [parse_timestamp("2024-06-01 10:30:00"), 0], dtype=np.int64
+            ),
+        },
+    )
+    md = Metadata()
+    md.register_catalog("hive", ParquetConnector(root))
+    r = QueryRunner(md, Session(catalog="hive", schema="s"))
+    rows = r.execute("select a, at from t order by a").rows
+    assert rows[0] == (1, "2024-06-01 10:30:00")
+    assert rows[1] == (2, "1970-01-01 00:00:00")

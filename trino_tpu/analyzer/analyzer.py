@@ -1093,6 +1093,9 @@ class ExprAnalyzer:
     def _DateLit(self, e: ast.DateLit):
         return Literal(T.DATE, e.text)
 
+    def _TimestampLit(self, e: ast.TimestampLit):
+        return Literal(T.TIMESTAMP, e.text)
+
     def _Ident(self, e: ast.Ident):
         f, outer = self.scope.resolve(e.parts)
         if outer:
@@ -1246,8 +1249,16 @@ class ExprAnalyzer:
 
     def _ExtractExpr(self, e: ast.ExtractExpr):
         arg = self.analyze(e.arg)
+        if e.field in ("hour", "minute", "second"):
+            if not isinstance(arg.type, T.TimestampType):
+                raise AnalysisError(
+                    f"EXTRACT({e.field}) requires a timestamp"
+                )
+            return Call(T.BIGINT, f"extract_{e.field}", (arg,))
         if e.field not in ("year", "month", "day"):
             raise AnalysisError(f"EXTRACT({e.field}) not supported yet")
+        if isinstance(arg.type, T.TimestampType):
+            arg = Cast(T.DATE, arg)
         return Call(T.BIGINT, f"extract_{e.field}", (arg,))
 
     def _FnCall(self, e: ast.FnCall):

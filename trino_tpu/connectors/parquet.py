@@ -56,6 +56,8 @@ def _type_from_arrow(t) -> T.DataType:
         return T.DecimalType(t.precision, t.scale)
     if pa.types.is_date32(t):
         return T.DATE
+    if pa.types.is_timestamp(t):
+        return T.TIMESTAMP
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         return T.VARCHAR
     raise NotImplementedError(f"parquet type {t}")
@@ -143,6 +145,15 @@ def _to_host(arr, t: T.DataType):
         import pyarrow as pa
 
         vals = np.asarray(arr.cast(pa.int32()).fill_null(0))
+    elif isinstance(t, T.TimestampType):
+        import pyarrow as pa
+
+        vals = np.asarray(
+            # safe=False: truncate sub-microsecond units (ns files)
+            # like the reference rather than raising
+            arr.cast(pa.timestamp("us"), safe=False)
+            .cast(pa.int64()).fill_null(0)
+        )
     else:
         vals = np.asarray(arr.fill_null(0) if arr.null_count else arr)
     return vals if valid is None else (vals, valid)
@@ -176,6 +187,11 @@ def write_parquet_table(
         elif isinstance(t, T.DateType):
             arr = pa.array(
                 np.asarray(vals, dtype=np.int32), type=pa.date32(), mask=mask
+            )
+        elif isinstance(t, T.TimestampType):
+            arr = pa.array(
+                np.asarray(vals, dtype=np.int64),
+                type=pa.timestamp("us"), mask=mask,
             )
         else:
             arr = pa.array(np.asarray(vals), mask=mask)

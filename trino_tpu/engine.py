@@ -362,6 +362,16 @@ def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:
                 ],
                 dtype=t.np_dtype,
             )
+        elif isinstance(t, T.TimestampType):
+            vals = np.array(
+                [
+                    0 if v is None else (
+                        T.parse_timestamp(v) if isinstance(v, str) else int(v)
+                    )
+                    for v in raw
+                ],
+                dtype=t.np_dtype,
+            )
         else:
             vals = np.array(
                 [0 if v is None else v for v in raw], dtype=t.np_dtype
@@ -392,7 +402,7 @@ def _literal_value(e: ast.Expr, t):
         from decimal import Decimal
 
         return Decimal(e.text)
-    if isinstance(e, ast.DateLit):
+    if isinstance(e, (ast.DateLit, ast.TimestampLit)):
         return e.text
     if (
         isinstance(e, ast.Unary)

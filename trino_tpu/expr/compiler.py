@@ -166,6 +166,14 @@ class _Compiler:
                 # reference rounds (Math.round): floor(x + 0.5)
                 return wrap(lambda x: jnp.floor(x + 0.5).astype(dtype))
             return wrap(lambda x: x.astype(dtype))
+        if isinstance(d_t, T.DateType) and isinstance(s_t, T.TimestampType):
+            return wrap(
+                lambda x: (x // T.MICROS_PER_DAY).astype(jnp.int32)
+            )
+        if isinstance(d_t, T.TimestampType) and isinstance(s_t, T.DateType):
+            return wrap(
+                lambda x: x.astype(jnp.int64) * T.MICROS_PER_DAY
+            )
         if isinstance(d_t, T.VarcharType):
             raise NotImplementedError(f"cast {s_t} -> varchar not yet supported")
         raise NotImplementedError(f"cast {s_t} -> {d_t}")
@@ -645,6 +653,8 @@ def _literal_device_value(expr: Literal):
     v = expr.value
     if isinstance(expr.type, T.DateType) and isinstance(v, str):
         return T.parse_date(v)
+    if isinstance(expr.type, T.TimestampType) and isinstance(v, str):
+        return T.parse_timestamp(v)
     if isinstance(expr.type, T.DecimalType):
         from decimal import Decimal
 
@@ -813,4 +823,8 @@ _SIMPLE_FNS: dict[str, Callable] = {
     "atan": jnp.arctan,
     "degrees": jnp.degrees,
     "radians": jnp.radians,
+    # timestamp fields (micros since epoch)
+    "extract_hour": lambda x: (x // 3_600_000_000) % 24,
+    "extract_minute": lambda x: (x // 60_000_000) % 60,
+    "extract_second": lambda x: (x // 1_000_000) % 60,
 }

@@ -260,6 +260,8 @@ def _pyvalue(type_: T.DataType, v):
         return decimal.Decimal(int(v)).scaleb(-type_.scale)
     if isinstance(type_, T.DateType):
         return T.format_date(int(v))
+    if isinstance(type_, T.TimestampType):
+        return T.format_timestamp(int(v))
     if isinstance(type_, (T.DoubleType, T.RealType)):
         return float(v)
     if isinstance(type_, (T.VarcharType,)):

@@ -49,6 +49,9 @@ __all__ = [
     "UNKNOWN",
     "parse_date",
     "format_date",
+    "parse_timestamp",
+    "format_timestamp",
+    "MICROS_PER_DAY",
 ]
 
 EPOCH = datetime.date(1970, 1, 1)
@@ -62,6 +65,40 @@ def parse_date(s: str) -> int:
 
 def format_date(days: int) -> str:
     return (EPOCH + datetime.timedelta(days=int(days))).isoformat()
+
+
+MICROS_PER_DAY = 86_400_000_000
+
+
+def parse_timestamp(s: str) -> int:
+    """'1995-03-15 12:34:56[.fff]' -> microseconds since epoch."""
+    s = s.strip()
+    if "T" in s:
+        s = s.replace("T", " ")
+    if " " in s:
+        d, t = s.split(" ", 1)
+    else:
+        d, t = s, "00:00:00"
+    days = parse_date(d)
+    parts = t.split(":")
+    h = int(parts[0])
+    m = int(parts[1]) if len(parts) > 1 else 0
+    sec = float(parts[2]) if len(parts) > 2 else 0.0
+    return days * MICROS_PER_DAY + (
+        (h * 3600 + m * 60) * 1_000_000 + round(sec * 1_000_000)
+    )
+
+
+def format_timestamp(micros: int) -> str:
+    micros = int(micros)
+    days, rem = divmod(micros, MICROS_PER_DAY)
+    secs, us = divmod(rem, 1_000_000)
+    h, rest = divmod(secs, 3600)
+    m, s = divmod(rest, 60)
+    out = f"{format_date(days)} {h:02d}:{m:02d}:{s:02d}"
+    if us:
+        out += f".{us:06d}".rstrip("0")
+    return out
 
 
 class DataType:
@@ -292,6 +329,10 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
         return DOUBLE if isinstance(b, DoubleType) or isinstance(a, DoubleType) else REAL
     if isinstance(a, VarcharType) and isinstance(b, VarcharType):
         return VARCHAR
+    if isinstance(a, (DateType, TimestampType)) and isinstance(
+        b, (DateType, TimestampType)
+    ):
+        return TIMESTAMP
     raise TypeError(f"no common type for {a} and {b}")
 
 
