@@ -111,3 +111,64 @@ def test_render_table():
         [[1, "x"], [22, None]],
     )
     assert "a" in out and "NULL" in out and "(2 rows)" in out
+
+
+# ---- DB-API 2.0 driver (trino-jdbc analog) ---------------------------------
+
+def test_dbapi_basic(server):
+    import trino_tpu.server.dbapi as dbapi
+
+    with dbapi.connect(server.uri) as conn:
+        cur = conn.cursor()
+        cur.execute("select r_regionkey, r_name from region order by 1")
+        assert [d[0] for d in cur.description] == ["r_regionkey", "r_name"]
+        assert cur.rowcount == 5
+        assert cur.fetchone() == (0, "AFRICA")
+        assert cur.fetchmany(2) == [(1, "AMERICA"), (2, "ASIA")]
+        assert len(cur.fetchall()) == 2
+
+
+def test_dbapi_parameters(server):
+    import trino_tpu.server.dbapi as dbapi
+
+    cur = dbapi.connect(server.uri).cursor()
+    cur.execute(
+        "select n_name from nation where n_regionkey = ? and n_name > ?",
+        (1, "B"),
+    )
+    rows = cur.fetchall()
+    assert ("BRAZIL",) in rows and ("CANADA",) in rows
+
+
+def test_dbapi_iteration_and_errors(server):
+    import pytest as _pytest
+
+    import trino_tpu.server.dbapi as dbapi
+
+    cur = dbapi.connect(server.uri).cursor()
+    cur.execute("select n_nationkey from nation where n_nationkey < 3 order by 1")
+    assert [r[0] for r in cur] == [0, 1, 2]
+    with _pytest.raises(dbapi.DatabaseError):
+        cur.execute("select nope from nation")
+
+
+def test_dbapi_placeholder_edge_cases(server):
+    import pytest as _pytest
+
+    import trino_tpu.server.dbapi as dbapi
+
+    cur = dbapi.connect(server.uri).cursor()
+    # '?' inside a string literal is not a placeholder
+    cur.execute(
+        "select count(*) from nation where n_name = 'a?b' or n_nationkey = ?",
+        (0,),
+    )
+    assert cur.fetchall() == [(1,)]
+    with _pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select ? , ?", (1,))
+    with _pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select ?", (1, 2))
+    with _pytest.raises(dbapi.DataError):
+        cur.execute("select ?", (float("nan"),))
+    cur.execute("select n_name from nation limit 3")
+    assert cur.fetchmany(0) == []

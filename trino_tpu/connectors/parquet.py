@@ -57,6 +57,10 @@ def _type_from_arrow(t) -> T.DataType:
     if pa.types.is_date32(t):
         return T.DATE
     if pa.types.is_timestamp(t):
+        if t.tz is not None:
+            raise NotImplementedError(
+                "timestamp with time zone is not supported yet"
+            )
         return T.TIMESTAMP
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         return T.VARCHAR

@@ -1,0 +1,222 @@
+"""PEP 249 (DB-API 2.0) driver over the REST statement protocol.
+
+The ecosystem-native analog of the reference's JDBC driver
+(client/trino-jdbc/, TrinoConnection/TrinoResultSet wrapping
+trino-client): a `connect()` returning Connection/Cursor objects any
+Python SQL tooling can drive, wrapping StatementClient the same way.
+
+    import trino_tpu.server.dbapi as dbapi
+    conn = dbapi.connect("http://127.0.0.1:8080")
+    cur = conn.cursor()
+    cur.execute("select count(*) from nation")
+    print(cur.fetchall())
+"""
+
+from __future__ import annotations
+
+from trino_tpu.server.client import QueryError, StatementClient
+
+apilevel = "2.0"
+threadsafety = 1  # threads may share the module, not connections
+paramstyle = "qmark"
+
+__all__ = [
+    "connect", "Connection", "Cursor",
+    "Warning", "Error", "InterfaceError", "DatabaseError", "DataError",
+    "OperationalError", "IntegrityError", "InternalError",
+    "ProgrammingError", "NotSupportedError",
+    "apilevel", "threadsafety", "paramstyle",
+]
+
+
+class Warning(Exception):  # noqa: A001 — PEP 249 name
+    pass
+
+
+class Error(Exception):
+    pass
+
+
+class InterfaceError(Error):
+    pass
+
+
+class DatabaseError(Error):
+    pass
+
+
+class DataError(DatabaseError):
+    pass
+
+
+class OperationalError(DatabaseError):
+    pass
+
+
+class IntegrityError(DatabaseError):
+    pass
+
+
+class InternalError(DatabaseError):
+    pass
+
+
+class ProgrammingError(DatabaseError):
+    pass
+
+
+class NotSupportedError(DatabaseError):
+    pass
+
+
+def connect(server: str, timeout: float = 300.0) -> "Connection":
+    return Connection(server, timeout)
+
+
+class Connection:
+    def __init__(self, server: str, timeout: float = 300.0):
+        self._client = StatementClient(server, timeout=timeout)
+        self._closed = False
+
+    def cursor(self) -> "Cursor":
+        if self._closed:
+            raise InterfaceError("connection is closed")
+        return Cursor(self._client)
+
+    def close(self):
+        self._closed = True
+
+    # queries auto-commit (the engine's per-statement transaction)
+    def commit(self):
+        pass
+
+    def rollback(self):
+        raise NotSupportedError("rollback is not supported")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, client: StatementClient):
+        self._client = client
+        self._rows: list[tuple] | None = None
+        self._pos = 0
+        self.description = None
+        self.rowcount = -1
+
+    def execute(self, sql: str, parameters=None):
+        if parameters:
+            sql = _substitute(sql, parameters)
+        try:
+            columns, rows = self._client.execute(sql)
+        except QueryError as e:
+            raise DatabaseError(str(e)) from e
+        self.description = [
+            (c["name"], c.get("type"), None, None, None, None, None)
+            for c in columns
+        ]
+        self._rows = [tuple(r) for r in rows]
+        self._pos = 0
+        self.rowcount = len(self._rows)
+        return self
+
+    def executemany(self, sql: str, seq_of_parameters):
+        for p in seq_of_parameters:
+            self.execute(sql, p)
+        return self
+
+    def fetchone(self):
+        if self._rows is None:
+            raise InterfaceError("no query has been executed")
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: int | None = None):
+        n = self.arraysize if size is None else size
+        out = []
+        for _ in range(n):
+            r = self.fetchone()
+            if r is None:
+                break
+            out.append(r)
+        return out
+
+    def fetchall(self):
+        if self._rows is None:
+            raise InterfaceError("no query has been executed")
+        out = self._rows[self._pos:]
+        self._pos = len(self._rows)
+        return out
+
+    def __iter__(self):
+        while True:
+            r = self.fetchone()
+            if r is None:
+                return
+            yield r
+
+    def close(self):
+        self._rows = None
+
+    def setinputsizes(self, sizes):
+        pass
+
+    def setoutputsize(self, size, column=None):
+        pass
+
+
+def _substitute(sql: str, parameters) -> str:
+    """qmark substitution with SQL-literal quoting (server side has no
+    prepared statements yet, mirroring the JDBC driver's client-side
+    fallback). '?' inside string literals is left alone."""
+    params = list(parameters)
+    out = []
+    it = iter(params)
+    used = 0
+    in_string = False
+    for ch in sql:
+        if ch == "'":
+            in_string = not in_string  # '' escapes toggle twice: fine
+            out.append(ch)
+        elif ch == "?" and not in_string:
+            try:
+                v = next(it)
+            except StopIteration:
+                raise ProgrammingError(
+                    "not enough parameters for placeholders"
+                ) from None
+            used += 1
+            out.append(_quote(v))
+        else:
+            out.append(ch)
+    if used != len(params):
+        raise ProgrammingError(
+            f"{len(params)} parameters for {used} placeholders"
+        )
+    return "".join(out)
+
+
+def _quote(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        import math
+
+        if not math.isfinite(v):
+            raise DataError(f"cannot bind non-finite float {v!r}")
+        return repr(v)
+    if isinstance(v, int):
+        return repr(v)
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
