@@ -172,3 +172,31 @@ def test_dbapi_placeholder_edge_cases(server):
         cur.execute("select ?", (float("nan"),))
     cur.execute("select n_name from nation limit 3")
     assert cur.fetchmany(0) == []
+
+
+def test_dbapi_typed_binds(server):
+    """Decimal/date/datetime parameters bind as typed literals, not
+    quoted varchar (the engine has no varchar->decimal/date coercion)."""
+    import datetime
+    import decimal
+
+    import pytest as _pytest
+
+    import trino_tpu.server.dbapi as dbapi
+
+    cur = dbapi.connect(server.uri).cursor()
+    cur.execute(
+        "select count(*) from orders where o_orderdate < ?",
+        (datetime.date(1995, 1, 1),),
+    )
+    (n_before,) = cur.fetchone()
+    assert n_before > 0
+    cur.execute(
+        "select count(*) from lineitem where l_quantity > ?",
+        (decimal.Decimal("25.50"),),
+    )
+    assert cur.fetchone()[0] > 0
+    cur.execute("select ?", (datetime.datetime(2001, 2, 3, 4, 5, 6),))
+    assert "2001" in str(cur.fetchone()[0])
+    with _pytest.raises(dbapi.DataError):
+        cur.execute("select ?", (b"bytes",))

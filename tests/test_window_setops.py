@@ -253,3 +253,28 @@ def test_distributed_window_and_union(runner, oracle):
         result = mesh_runner.execute(sql)
         expected = oracle.execute(to_sqlite(sql)).fetchall()
         assert_rows_match(result.rows, expected, ordered=False)
+
+
+def test_window_float_sum_cross_partition_precision():
+    """Float window sums must not lose precision to a neighboring
+    partition of vastly larger magnitude: the frame sum is a segmented
+    per-partition scan in float64, not a global cumsum difference
+    (which would quantize the small partition at ulp(1e18))."""
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.metadata import Metadata, Session
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table w (p bigint, i bigint, v double)")
+    r.execute(
+        "insert into w values "
+        "(1, 1, 1e18), (1, 2, 1e18), (1, 3, 1e18), "
+        "(2, 1, 1.0), (2, 2, 2.0), (2, 3, 3.0)"
+    )
+    rows = r.execute(
+        "select p, i, sum(v) over (partition by p order by i) from w "
+        "order by p, i"
+    ).rows
+    small = [v for p, _, v in rows if p == 2]
+    assert small == [1.0, 3.0, 6.0]  # exact, no cross-partition ulp loss

@@ -173,16 +173,27 @@ class TpchData:
         path = self._disk_path(table, name)
         if path is None or not os.path.exists(path):
             return None
-        return np.load(path, allow_pickle=True)
+        # never unpickle: the cache dir is overridable/shared, and
+        # pickled .npy files are an arbitrary-code-execution surface.
+        # Strings are stored as fixed-width unicode (see _disk_store).
+        try:
+            arr = np.load(path, allow_pickle=False)
+        except ValueError:  # legacy pickled file: regenerate instead
+            return None
+        if arr.dtype.kind == "U":
+            arr = arr.astype(object)
+        return arr
 
     def _disk_store(self, table: str, name: str, arr: np.ndarray) -> None:
         path = self._disk_path(table, name)
         if path is None:
             return
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        if arr.dtype == object:  # varchar columns: pickle-free layout
+            arr = arr.astype("U")
         # np.save appends .npy unless the name already ends with it
         tmp = f"{path[:-4]}.tmp{os.getpid()}.npy"
-        np.save(tmp, arr, allow_pickle=True)
+        np.save(tmp, arr, allow_pickle=False)
         os.replace(tmp, path)
 
     def table(self, table: str) -> dict[str, np.ndarray]:

@@ -51,8 +51,15 @@ class LocalExecutor:
     def invalidate_scan(self, catalog: str, schema: str, table: str):
         """Drop cached device pages for a table (called after writes —
         the reference's memory connector versions table handles the
-        same way)."""
+        same way). Learned statistics (filter selectivities, group-by
+        capacities) are dropped with it: they were observed against the
+        pre-write data and would otherwise persist stale forever."""
         self._scan_cache.pop((catalog, schema, table), None)
+        for k in [
+            k for k in self._jit_cache
+            if isinstance(k, tuple) and k and k[0] in ("selectivity", "caps")
+        ]:
+            del self._jit_cache[k]
 
     def execute(self, node: P.PlanNode) -> Page:
         if isinstance(node, stage.FUSABLE):

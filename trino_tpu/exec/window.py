@@ -203,14 +203,17 @@ def _eval_call(
         return cnt, None
     if name == "sum":
         z = jnp.zeros((), dtype=data.dtype)
-        s = _range_sum(jnp.where(contrib, data, z), lo, hi, n)
-        return s, cnt > 0
+        s = _range_sum(
+            jnp.where(contrib, data, z), lo, hi, n, gid=info.gid_sorted
+        )
+        return s.astype(data.dtype), cnt > 0
     if name == "avg":
         if isinstance(call.type, T.DecimalType):
             s = _range_sum(jnp.where(contrib, data, 0), lo, hi, n)
             return _div_round_half_up(s, jnp.maximum(cnt, 1)), cnt > 0
         s = _range_sum(
-            jnp.where(contrib, data.astype(jnp.float64), 0.0), lo, hi, n
+            jnp.where(contrib, data.astype(jnp.float64), 0.0), lo, hi, n,
+            gid=info.gid_sorted,
         )
         return s / jnp.maximum(cnt, 1), cnt > 0
     if name in ("min", "max"):
@@ -267,8 +270,32 @@ def _bound_pos(bound, pos, pstart, pend, peer_start, peer_end, mode, is_lo):
     return pos + off if is_lo else pos + off + 1
 
 
-def _range_sum(vals, lo, hi, n):
-    """Per-row sum of vals over sorted positions [lo, hi)."""
+def _range_sum(vals, lo, hi, n, gid=None):
+    """Per-row sum of vals over sorted positions [lo, hi).
+
+    Integer sums use a global cumsum difference (exact in int64).
+    Float sums with ``gid`` use a per-partition segmented scan in
+    float64: a global-cumsum difference would quantize every frame at
+    ulp(global running prefix), so a small partition next to a huge one
+    loses all its precision (the same cross-group cancellation
+    aggregates.seg_sum_ranges avoids)."""
+    if gid is not None and jnp.issubdtype(vals.dtype, jnp.floating):
+        acc = vals.astype(jnp.float64)
+
+        def op(a, b):
+            ga, va = a
+            gb, vb = b
+            return gb, jnp.where(ga == gb, va + vb, vb)
+
+        _, cs = jax.lax.associative_scan(op, (gid, acc))
+        zero = jnp.zeros((), dtype=jnp.float64)
+        hi_at = jnp.clip(hi - 1, 0, n - 1)
+        lo_at = jnp.clip(lo - 1, 0, n - 1)
+        top = jnp.where(hi > 0, cs[hi_at], zero)
+        # lo-1 belongs to the same partition iff the frame doesn't start
+        # at the partition boundary (lo is clipped to pstart upstream)
+        bot = jnp.where((lo > 0) & (gid[lo_at] == gid), cs[lo_at], zero)
+        return jnp.where(hi > lo, top - bot, zero)
     cs = jnp.cumsum(vals)
     zero = jnp.zeros((), dtype=vals.dtype)
     hi_at = jnp.clip(hi - 1, 0, n - 1)

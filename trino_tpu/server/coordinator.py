@@ -64,6 +64,8 @@ class Coordinator:
         self._queries: dict[str, QueryState] = {}
         self._lock = threading.Lock()
         self._seq = 0
+        #: finished queries stay fetchable at least this long
+        self.history_grace_s = 60.0
         coordinator = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -161,11 +163,18 @@ class Coordinator:
         with self._lock:
             self._queries[qid] = q
             # bounded history: release old finished results (the
-            # reference's QueryTracker expiration analog)
+            # reference's QueryTracker min-age expiration,
+            # MAIN/execution/QueryTracker.java). A grace period keeps a
+            # finished query alive while a slow client is still
+            # paginating its resultset — evicting it mid-pagination
+            # would surface a spurious 404.
             if len(self._queries) > 200:
+                now = time.time()
                 done = [
                     k for k, v in self._queries.items()
                     if v.state in ("FINISHED", "FAILED")
+                    and v.finished_at is not None
+                    and now - v.finished_at > self.history_grace_s
                 ]
                 for k in done[: len(self._queries) - 200]:
                     del self._queries[k]

@@ -206,6 +206,9 @@ def _substitute(sql: str, parameters) -> str:
 
 
 def _quote(v) -> str:
+    import datetime
+    import decimal
+
     if v is None:
         return "null"
     if isinstance(v, bool):
@@ -218,5 +221,21 @@ def _quote(v) -> str:
         return repr(v)
     if isinstance(v, int):
         return repr(v)
+    # typed literals: the engine has no varchar->decimal/date/timestamp
+    # coercion, so quoted strings would fail analysis for these binds
+    if isinstance(v, decimal.Decimal):
+        if not v.is_finite():
+            raise DataError(f"cannot bind non-finite decimal {v!r}")
+        # plain notation: str() would emit 1E-8 for small values, which
+        # the lexer tokenizes as a double literal
+        return format(v, "f")
+    if isinstance(v, datetime.datetime):
+        if v.tzinfo is not None:
+            raise DataError("cannot bind tz-aware datetime (no TZ type)")
+        return f"TIMESTAMP '{v.isoformat(sep=' ')}'"
+    if isinstance(v, datetime.date):
+        return f"DATE '{v.isoformat()}'"
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        raise DataError("cannot bind binary parameters (no VARBINARY type)")
     s = str(v).replace("'", "''")
     return f"'{s}'"
