@@ -16,7 +16,10 @@ import numpy as np
 
 from trino_tpu import types as T
 
-__all__ = ["TableSchema", "Connector", "Catalog", "Split"]
+__all__ = [
+    "TableSchema", "Connector", "Catalog", "Split",
+    "ColumnStats", "TableStats", "compute_column_stats",
+]
 
 
 @dataclass(frozen=True)
@@ -45,6 +48,78 @@ class Split:
     count: int
 
 
+@dataclass(frozen=True)
+class ColumnStats:
+    """Per-column statistics (SPI/statistics/ColumnStatistics.java
+    analog). ``lo``/``hi`` are EXACT bounds in the column's storage
+    order-domain (ints as-is, dates as day numbers, decimals as
+    unscaled ints, doubles as floats; None for varchar) — the planner
+    relies on exactness for value-range key packing, so connectors
+    must only report bounds they can guarantee, and integer-domain
+    bounds must be Python ints (float64 rounds beyond 2^53)."""
+
+    ndv: float | None = None
+    lo: float | int | None = None
+    hi: float | int | None = None
+    null_fraction: float = 0.0
+
+
+@dataclass(frozen=True)
+class TableStats:
+    """Table statistics (SPI/statistics/TableStatistics.java analog)."""
+
+    row_count: float
+    columns: dict[str, ColumnStats] = field(default_factory=dict)
+
+
+#: string columns beyond this row count estimate NDV from a sample
+#: (exact np.unique over tens of millions of objects is a minutes-long
+#: host sort; numeric columns stay exact — their unique is a fast
+#: vectorized sort and their lo/hi must be exact anyway)
+_NDV_SAMPLE_THRESHOLD = 2_000_000
+_NDV_SAMPLE_SIZE = 500_000
+
+
+def compute_column_stats(
+    vals: np.ndarray, valid: np.ndarray | None = None
+) -> ColumnStats:
+    """Stats of a host column (the ANALYZE primitive). lo/hi are exact;
+    NDV is exact except for very large string columns, where it uses
+    the Duj1 estimator over a uniform sample (the reference's ANALYZE
+    does the same kind of sampling via connector stats collection)."""
+    n = len(vals)
+    if valid is not None:
+        vals = vals[valid]
+    nulls = n - len(vals)
+    if len(vals) == 0:
+        return ColumnStats(ndv=0.0, null_fraction=1.0 if n else 0.0)
+    is_str = vals.dtype == object or vals.dtype.kind in ("U", "S")
+    if is_str and len(vals) > _NDV_SAMPLE_THRESHOLD:
+        rng = np.random.default_rng(0)
+        k = _NDV_SAMPLE_SIZE
+        sample = vals[rng.choice(len(vals), size=k, replace=False)]
+        uniq, counts = np.unique(sample, return_counts=True)
+        d = len(uniq)
+        f1 = int((counts == 1).sum())
+        # Duj1: D = d / (1 - (N-k)/N * f1/k)
+        denom = 1.0 - (len(vals) - k) / len(vals) * (f1 / k)
+        ndv = min(float(d) / max(denom, 1e-9), float(len(vals)))
+    else:
+        ndv = float(len(np.unique(vals)))
+    if is_str:
+        lo = hi = None
+    elif vals.dtype.kind in ("i", "u"):
+        # keep integer bounds EXACT as Python ints: float64 rounds
+        # beyond 2^53, and a lo rounded UP would corrupt value-range
+        # key packing (distinct keys silently collapsing)
+        lo, hi = int(vals.min()), int(vals.max())
+    else:
+        lo, hi = float(vals.min()), float(vals.max())
+    return ColumnStats(
+        ndv=ndv, lo=lo, hi=hi, null_fraction=nulls / n if n else 0.0
+    )
+
+
 class Connector:
     """Base connector: metadata + split enumeration + column scan."""
 
@@ -59,6 +134,13 @@ class Connector:
 
     def row_count(self, schema: str, table: str) -> int:
         raise NotImplementedError
+
+    def table_stats(self, schema: str, table: str) -> TableStats:
+        """Statistics for the planner (ConnectorMetadata.getTableStatistics
+        analog, SPI/connector/ConnectorMetadata.java). The default
+        reports the row count only; connectors override to add column
+        stats."""
+        return TableStats(float(self.row_count(schema, table)))
 
     def splits(self, schema: str, table: str, target_splits: int) -> list[Split]:
         n = self.row_count(schema, table)

@@ -13,7 +13,13 @@ import threading
 import numpy as np
 
 from trino_tpu import types as T
-from trino_tpu.connectors.base import Connector, Split, TableSchema
+from trino_tpu.connectors.base import (
+    Connector,
+    Split,
+    TableSchema,
+    TableStats,
+    compute_column_stats,
+)
 
 __all__ = ["MemoryConnector", "BlackholeConnector"]
 
@@ -29,6 +35,8 @@ class _Table:
             c: None for c, _ in schema.columns
         }
         self.n_rows = 0
+        #: memoized TableStats; dropped on insert
+        self.stats: TableStats | None = None
 
 
 def _storage_dtype(t: T.DataType):
@@ -55,6 +63,22 @@ class MemoryConnector(Connector):
 
     def row_count(self, schema: str, table: str) -> int:
         return self._table(schema, table).n_rows
+
+    def table_stats(self, schema: str, table: str) -> TableStats:
+        """Exact stats computed on demand and memoized per table
+        version (planning hits this several times per query; a
+        recompute per call would sort every column each time) —
+        invalidated on insert, like the reference's
+        MemoryMetadata.getTableStatistics."""
+        t = self._table(schema, table)
+        with self._lock:
+            if t.stats is None:
+                cols = {
+                    c: compute_column_stats(t.columns[c], t.valid[c])
+                    for c, _ in t.schema.columns
+                }
+                t.stats = TableStats(float(t.n_rows), cols)
+            return t.stats
 
     def _table(self, schema: str, table: str) -> _Table:
         try:
@@ -102,6 +126,7 @@ class MemoryConnector(Connector):
                     )
                     t.valid[c] = np.concatenate([ov, nv])
             t.n_rows += n_new or 0
+            t.stats = None  # stats reflect the pre-insert version
         return n_new or 0
 
     # ---- scan ------------------------------------------------------------

@@ -2,9 +2,19 @@
 
 from __future__ import annotations
 
+import json
+import os
+
 import numpy as np
 
-from trino_tpu.connectors.base import Connector, Split, TableSchema
+from trino_tpu.connectors.base import (
+    ColumnStats,
+    Connector,
+    Split,
+    TableSchema,
+    TableStats,
+    compute_column_stats,
+)
 from trino_tpu.connectors.tpch.generator import SCHEMAS, SCHEMA_SF, TpchData
 
 __all__ = ["TpchConnector"]
@@ -13,6 +23,7 @@ __all__ = ["TpchConnector"]
 class TpchConnector(Connector):
     def __init__(self):
         self._data: dict[float, TpchData] = {}
+        self._stats: dict[tuple[float, str], TableStats] = {}
 
     def data(self, schema: str) -> TpchData:
         sf = self._sf(schema)
@@ -42,6 +53,48 @@ class TpchConnector(Connector):
 
     def row_count(self, schema: str, table: str) -> int:
         return self.data(schema).row_count(table)
+
+    def table_stats(self, schema: str, table: str) -> TableStats:
+        """Exact per-column stats (the reference tpch connector ships
+        column statistics the same way, plugin/trino-tpch
+        TpchMetadata.getTableStatistics). Computed once from the
+        generated columns and disk-cached beside the data cache; the
+        generated data is deterministic per (sf, table), so the cache
+        never goes stale."""
+        sf = self._sf(schema)
+        key = (sf, table)
+        if key in self._stats:
+            return self._stats[key]
+        data = self.data(schema)
+        n = data.row_count(table)
+        path = data.stats_path(table)
+        cols: dict[str, ColumnStats] = {}
+        if path is not None and os.path.exists(path):
+            with open(path) as f:
+                raw = json.load(f)
+            # older cache files stored integer bounds as floats; value-
+            # range packing requires exact ints (2^53 rounding), so
+            # coerce integral floats back (exact below 2^53)
+            for v in raw.values():
+                for b in ("lo", "hi"):
+                    x = v.get(b)
+                    if (
+                        isinstance(x, float) and x.is_integer()
+                        and abs(x) < 2**53
+                    ):
+                        v[b] = int(x)
+            cols = {c: ColumnStats(**v) for c, v in raw.items()}
+        else:
+            for c in SCHEMAS[table].column_names:
+                cols[c] = compute_column_stats(data.column(table, c))
+            if path is not None:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f"{path}.tmp{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({c: vars(s) for c, s in cols.items()}, f)
+                os.replace(tmp, path)
+        self._stats[key] = ts = TableStats(float(n), cols)
+        return ts
 
     def scan(
         self, schema: str, table: str, columns: list[str], split: Split | None = None

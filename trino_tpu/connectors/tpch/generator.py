@@ -169,6 +169,11 @@ class TpchData:
             return None
         return os.path.join(root, f"sf{self.sf:g}_{table}_{name}.npy")
 
+    def stats_path(self, table: str) -> str | None:
+        """Disk-cache path for the table's column stats (JSON)."""
+        p = self._disk_path(table, "stats")
+        return None if p is None else p[:-4] + ".json"
+
     def _disk_load(self, table: str, name: str) -> np.ndarray | None:
         path = self._disk_path(table, name)
         if path is None or not os.path.exists(path):

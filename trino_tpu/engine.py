@@ -87,7 +87,14 @@ class QueryRunner:
         if self.mesh is not None:
             from trino_tpu.plan.distribute import add_exchanges
 
-            plan = add_exchanges(plan, self.metadata)
+            plan = add_exchanges(
+                plan, self.metadata,
+                n_shards=self.mesh.devices.size, session=self.session,
+            )
+        if optimized:
+            from trino_tpu.plan.stats import annotate
+
+            plan = annotate(plan, self.metadata)
         return plan
 
     def plan_sql(self, sql: str, optimized: bool = True) -> P.PlanNode:

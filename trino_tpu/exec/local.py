@@ -47,6 +47,9 @@ class LocalExecutor:
         self._jit_cache: dict = {}
         #: (catalog, schema, table) -> {column name: Column}; "" -> mask
         self._scan_cache: dict = {}
+        #: dynamic-filter effectiveness log (tests + EXPLAIN ANALYZE):
+        #: [{rows_in, rows_kept, pairs}] per join probe this executor ran
+        self.df_log: list[dict] = []
 
     def invalidate_scan(self, catalog: str, schema: str, table: str):
         """Drop cached device pages for a table (called after writes —
@@ -91,6 +94,11 @@ class LocalExecutor:
                     for s, a in n.aggregates.items()
                 ),
                 n.step,
+                # compiled programs bake key shift offsets/widths, so
+                # different ranges must compile separately
+                None if n.key_ranges is None else tuple(
+                    sorted(n.key_ranges.items())
+                ),
             )
         if isinstance(n, (P.Sort, P.TopN)):
             return (
@@ -515,10 +523,106 @@ class LocalExecutor:
         )
         return order, lo, cnt, int(jax.device_get(total_dev))
 
+    # ---- dynamic filtering (DynamicFilterService analog,
+    # MAIN/server/DynamicFilterService.java:106: collect build-side key
+    # bounds, prune the probe before the expensive join work) ----------
+
+    #: probes below this skip dynamic filtering (the two extra syncs
+    #: cost more than the saved sort time)
+    DF_MIN_PROBE = 1 << 17
+    #: apply the filter only when it drops at least this fraction
+    DF_MIN_DROP = 0.3
+
+    def _df_pairs(self, criteria, probe: Page, build: Page):
+        """Criteria usable for min/max dynamic filtering: plain integer
+        domains (ints, dates, decimals, dictionary codes are excluded —
+        code spaces already unified but bounds are meaningless across
+        remaps)."""
+        pairs = []
+        for ls, rs in criteria:
+            pc, bc = probe.column(ls), build.column(rs)
+            if pc.dictionary is not None or bc.dictionary is not None:
+                continue
+            if np.dtype(pc.data.dtype).kind != "i":
+                continue
+            pairs.append((ls, rs))
+        return pairs
+
+    def _dynamic_filter(self, node: P.Join, probe: Page, build: Page) -> Page:
+        """Prune probe rows whose key cannot match any build row.
+
+        Inner joins only: outer probes must keep unmatched rows. Cost:
+        one tiny reduction program + one filtered compaction — two host
+        syncs, the price the reference pays for its DF barrier. NULL
+        probe keys are dropped too (they never match an inner join).
+
+        Gated by the planner's df_range_keep hint: a min/max filter
+        only prunes when the build's key RANGE is narrower than the
+        probe's — uniform dense builds keep ~100% and the two syncs
+        are pure cost (the measured Q3 regression)."""
+        if node.kind != "inner" or probe.capacity < self.DF_MIN_PROBE:
+            return probe
+        if node.df_range_keep is None or node.df_range_keep > 0.7:
+            return probe
+        pairs = self._df_pairs(node.criteria, probe, build)
+        if not pairs:
+            return probe
+        key_a = ("dfA", tuple(r for _, r in pairs), self._layout_sig(build))
+        fn_a = self._jit_cache.get(key_a)
+        if fn_a is None:
+            rsyms = [r for _, r in pairs]
+
+            def fa(benv, bmask):
+                outs = []
+                for r in rsyms:
+                    d, v = benv[r]
+                    live = bmask if v is None else (bmask & v)
+                    big = jnp.iinfo(d.dtype).max
+                    small = jnp.iinfo(d.dtype).min
+                    outs.append(jnp.min(jnp.where(live, d, big)))
+                    outs.append(jnp.max(jnp.where(live, d, small)))
+                return jnp.stack([o.astype(jnp.int64) for o in outs])
+
+            fn_a = jax.jit(fa)
+            self._jit_cache[key_a] = fn_a
+        bounds = fn_a(self._env(build), build.mask)
+        key_b = ("dfB", tuple(l for l, _ in pairs), self._layout_sig(probe))
+        fn_b = self._jit_cache.get(key_b)
+        if fn_b is None:
+            lsyms = [l for l, _ in pairs]
+
+            def fb(penv, pmask, bnds):
+                keep = pmask
+                for i, l in enumerate(lsyms):
+                    d, v = penv[l]
+                    lo = bnds[2 * i].astype(d.dtype)
+                    hi = bnds[2 * i + 1].astype(d.dtype)
+                    keep = keep & (d >= lo) & (d <= hi)
+                    if v is not None:
+                        keep = keep & v
+                return keep, K.count_true(keep)
+
+            fn_b = jax.jit(fb)
+            self._jit_cache[key_b] = fn_b
+        keep, kept_dev = fn_b(self._env(probe), probe.mask, bounds)
+        in_rows = probe.num_rows()
+        kept = int(jax.device_get(kept_dev))
+        self.df_log.append(
+            {"rows_in": in_rows, "rows_kept": kept, "pairs": pairs}
+        )
+        if kept > (1.0 - self.DF_MIN_DROP) * in_rows:
+            return probe
+        filtered = Page(
+            list(probe.names), list(probe.columns), keep,
+            known_rows=kept,
+        )
+        return self._compact(filtered)
+
     def _equi_join(self, node: P.Join, probe: Page, build: Page) -> Page:
         if not node.criteria:
             raise NotImplementedError(f"{node.kind} join without equi criteria")
         self._unify_join_dicts(probe, build, node.criteria)
+        probe = self._dynamic_filter(node, probe, build)
         order, lo, cnt, total = self._join_count(node.criteria, probe, build)
         out_cap = pad_capacity(max(total, 1))
         key = (

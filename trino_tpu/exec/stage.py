@@ -60,15 +60,34 @@ def _norm_opt(data, valid):
     return bits, (None if valid is None else flag)
 
 
-def _key_width(t: T.DataType, dictionary) -> int:
+def _key_width(t: T.DataType, dictionary, value_range=None) -> int:
     """Bit width that injectively covers a key column's values — lets
-    sort_group pack several keys into one u64 sort pass."""
+    sort_group pack several keys into one u64 sort pass. An EXACT
+    ``value_range`` (lo, hi) from stats narrows the width to
+    bit_length(hi - lo): the caller shifts the column by lo first
+    (value-range key packing)."""
     if dictionary is not None:
         return max(1, len(dictionary).bit_length())
     if isinstance(t, T.BooleanType):
         return 1
     dt = np.dtype(t.np_dtype)
-    return min(dt.itemsize * 8, 64)
+    full = min(dt.itemsize * 8, 64)
+    if value_range is not None:
+        lo, hi = value_range
+        return min(max(1, int(hi - lo).bit_length()), full)
+    return full
+
+
+def _shift_key(data, valid, value_range):
+    """Shift an integer key column to its range origin so the low
+    bit_length(hi-lo) bits are injective. Dead/NULL rows may wrap —
+    they are excluded from grouping by liveness/null flags."""
+    if value_range is None:
+        return data, valid
+    lo, _hi = value_range
+    if lo == 0:
+        return data, valid
+    return data - jnp.asarray(lo, dtype=data.dtype), valid
 
 
 def _bcast(data, valid, capacity):
@@ -79,8 +98,20 @@ def _bcast(data, valid, capacity):
     return data, valid
 
 
-def plan_capacities(chain: list[P.PlanNode], in_capacity: int) -> dict[int, list[int]]:
-    """Initial [capacity, max_capacity] per Aggregate position."""
+def plan_capacities(
+    chain: list[P.PlanNode], in_capacity: int, n_shards: int = 1
+) -> dict[int, list[int]]:
+    """Initial [capacity, max_capacity] per Aggregate position.
+
+    With stats (``est_groups`` from plan.stats.annotate) the group
+    table starts at the estimated distinct count — overflow retries
+    become the exception, not the warm-up path (the reference reserves
+    FlatHash capacity from connector stats the same way). FINAL/SINGLE
+    steps in a sharded chain see only their hash partition of the key
+    space, so the estimate divides by the shard count (×1.5 margin for
+    partition imbalance); PARTIAL steps may see every key on every
+    shard. Estimates being wrong is safe: the overflow flag still
+    triggers the retry-larger loop."""
     caps: dict[int, list[int]] = {}
     cap = in_capacity
     for i, nd in enumerate(chain):
@@ -90,7 +121,17 @@ def plan_capacities(chain: list[P.PlanNode], in_capacity: int) -> dict[int, list
                 cap = 8
             else:
                 max_cap = pad_capacity(max(2 * cap, 8))
-                start = min(pad_capacity(max(cap // 16, 1024)), max_cap)
+                if nd.est_groups is not None:
+                    est = nd.est_groups
+                    if n_shards > 1 and nd.step in ("FINAL", "SINGLE"):
+                        est = est / n_shards * 1.5
+                    start = min(
+                        pad_capacity(int(est * 1.25) + 1024), max_cap
+                    )
+                else:
+                    start = min(
+                        pad_capacity(max(cap // 16, 1024)), max_cap
+                    )
                 caps[i] = [start, max_cap]
                 cap = start
         elif isinstance(nd, P.TopN):
@@ -193,16 +234,25 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
         capacity=out_cap,
     )
 
+    key_ranges = nd.key_ranges or {}
+
     def step(env, mask, flags):
         if is_global:
             info = None
             widths = ()
+            shifted = []
             out_mask = jnp.zeros((8,), dtype=jnp.bool_).at[0].set(True)
             env2 = {}
         else:
-            norm = [_norm_opt(*env[s]) for s in group_keys]
+            shifted = [
+                _shift_key(*env[s], key_ranges.get(s)) for s in group_keys
+            ]
+            norm = [_norm_opt(d, v) for d, v in shifted]
             widths = tuple(
-                _key_width(layout.types[s], layout.dicts.get(s))
+                _key_width(
+                    layout.types[s], layout.dicts.get(s),
+                    key_ranges.get(s),
+                )
                 for s in group_keys
             )
             info = K.sort_group(
@@ -252,10 +302,9 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
                 dwidths = widths + (
                     _key_width(call.args[0].type, arg_c[0].dictionary),
                 )
-                contrib = _dedupe(
-                    [env[s] for s in group_keys], arg, contrib, in_cap,
-                    dwidths,
-                )
+                # shifted key pairs match the narrowed widths
+                contrib = _dedupe(list(shifted), arg, contrib, in_cap,
+                                  dwidths)
             prepared.append((sym, call, arg, contrib))
         if info is not None:
             _presort_shared(prepared, info, share)

@@ -32,13 +32,15 @@ from trino_tpu.exec.aggregates import VARIANCE_FNS
 from trino_tpu.expr.ir import AggCall, Call, Cast, InputRef
 from trino_tpu.metadata import Metadata
 from trino_tpu.plan import nodes as P
-from trino_tpu.plan.optimizer import _estimate_rows
+from trino_tpu.metadata import Session
+from trino_tpu.plan import stats as S
 
-__all__ = ["add_exchanges", "BROADCAST_ROW_LIMIT"]
+__all__ = ["add_exchanges"]
 
-#: build sides estimated below this replicate instead of repartitioning
-#: (DetermineJoinDistributionType's size cutoff stand-in)
-BROADCAST_ROW_LIMIT = 10_000
+#: builds beyond this many rows never broadcast regardless of the cost
+#: model — each shard must hold a full replica in HBM (session
+#: property ``broadcast_join_row_limit`` overrides)
+DEFAULT_BROADCAST_ROW_LIMIT = 2_000_000
 
 #: aggregate functions whose partial state combines with the same
 #: function (min of mins, etc.)
@@ -47,8 +49,54 @@ _SELF_COMBINING = {
 }
 
 
-def add_exchanges(plan: P.PlanNode, metadata: Metadata) -> P.PlanNode:
-    node, _ = _walk(plan, metadata)
+class _Ctx:
+    """Distribution-planning context: metadata + mesh shape + session
+    knobs + a shared stats cache."""
+
+    def __init__(self, metadata: Metadata, n_shards: int, session):
+        self.md = metadata
+        self.n_shards = max(int(n_shards), 2)
+        props = session.properties if session is not None else {}
+        self.mode = str(
+            props.get("join_distribution_type", "AUTOMATIC")
+        ).upper()
+        self.broadcast_limit = float(
+            props.get(
+                "broadcast_join_row_limit", DEFAULT_BROADCAST_ROW_LIMIT
+            )
+        )
+        self.stats_cache: dict = {}
+
+    def rows(self, node: P.PlanNode) -> float:
+        return S.estimate(node, self.md, self.stats_cache).rows
+
+    def should_broadcast(self, probe: P.PlanNode, build: P.PlanNode) -> bool:
+        """DetermineJoinDistributionType analog, with the exchange cost
+        model of the collective fabric: PARTITIONED all_to_alls both
+        sides once (cost ~ probe + build rows); BROADCAST all_gathers
+        the build to every shard (cost ~ build * n_shards) and leaves
+        the probe in place. Broadcast also removes a probe-side
+        repartition ahead of downstream aggregations, so ties favor
+        it."""
+        if self.mode == "BROADCAST":
+            return True
+        if self.mode == "PARTITIONED":
+            return False
+        build_rows = self.rows(build)
+        if build_rows > self.broadcast_limit:
+            return False
+        probe_rows = self.rows(probe)
+        return build_rows * self.n_shards <= probe_rows + build_rows
+
+
+def add_exchanges(
+    plan: P.PlanNode,
+    metadata: Metadata,
+    n_shards: int = 8,
+    session: Session | None = None,
+) -> P.PlanNode:
+    ctx = _Ctx(metadata, n_shards, session)
+    node, _ = _walk(plan, ctx)
     return node
 
 
@@ -58,7 +106,7 @@ def _gather(node: P.PlanNode) -> P.PlanNode:
     )
 
 
-def _walk(node: P.PlanNode, md: Metadata) -> tuple[P.PlanNode, str]:
+def _walk(node: P.PlanNode, ctx: _Ctx) -> tuple[P.PlanNode, str]:
     """Returns (rewritten node, distribution in {'dist', 'single'})."""
     if isinstance(node, P.TableScan):
         return node, "dist"
@@ -66,30 +114,30 @@ def _walk(node: P.PlanNode, md: Metadata) -> tuple[P.PlanNode, str]:
         return node, "single"
 
     if isinstance(node, (P.Filter, P.Project)):
-        src, d = _walk(node.source, md)
+        src, d = _walk(node.source, ctx)
         return dc_replace(node, source=src), d
 
     if isinstance(node, P.Output):
-        src, d = _walk(node.source, md)
+        src, d = _walk(node.source, ctx)
         if d == "dist":
             src = _gather(src)
         return dc_replace(node, source=src), "single"
 
     if isinstance(node, P.Sort):
-        src, d = _walk(node.source, md)
+        src, d = _walk(node.source, ctx)
         if d == "dist":
             src = _gather(src)
         return dc_replace(node, source=src), "single"
 
     if isinstance(node, P.TopN):
-        src, d = _walk(node.source, md)
+        src, d = _walk(node.source, ctx)
         if d == "dist":
             partial = dc_replace(node, source=src)
             return dc_replace(node, source=_gather(partial)), "single"
         return dc_replace(node, source=src), "single"
 
     if isinstance(node, P.Limit):
-        src, d = _walk(node.source, md)
+        src, d = _walk(node.source, ctx)
         if d == "dist":
             partial = P.Limit(
                 dict(node.outputs), source=src,
@@ -100,14 +148,14 @@ def _walk(node: P.PlanNode, md: Metadata) -> tuple[P.PlanNode, str]:
         return dc_replace(node, source=src), "single"
 
     if isinstance(node, P.Aggregate):
-        return _walk_aggregate(node, md)
+        return _walk_aggregate(node, ctx)
 
     if isinstance(node, P.Join):
-        return _walk_join(node, md)
+        return _walk_join(node, ctx)
 
     if isinstance(node, P.SemiJoin):
-        src, sd = _walk(node.source, md)
-        filt, fd = _walk(node.filter_source, md)
+        src, sd = _walk(node.source, ctx)
+        filt, fd = _walk(node.filter_source, ctx)
         if sd == "single":
             if fd == "dist":
                 filt = _gather(filt)
@@ -122,7 +170,7 @@ def _walk(node: P.PlanNode, md: Metadata) -> tuple[P.PlanNode, str]:
     # unknown nodes: force single execution of every source
     srcs = []
     for s in node.sources:
-        s2, d = _walk(s, md)
+        s2, d = _walk(s, ctx)
         srcs.append(_gather(s2) if d == "dist" else s2)
     if srcs:
         from trino_tpu.plan.optimizer import _replace_sources
@@ -140,9 +188,9 @@ def _flip(node: P.Join) -> P.Join:
     )
 
 
-def _walk_join(node: P.Join, md: Metadata) -> tuple[P.PlanNode, str]:
-    left, ld = _walk(node.left, md)
-    right, rd = _walk(node.right, md)
+def _walk_join(node: P.Join, ctx: _Ctx) -> tuple[P.PlanNode, str]:
+    left, ld = _walk(node.left, ctx)
+    right, rd = _walk(node.right, ctx)
 
     if ld == "single" and rd == "single":
         return dc_replace(node, left=left, right=right), "single"
@@ -182,9 +230,7 @@ def _walk_join(node: P.Join, md: Metadata) -> tuple[P.PlanNode, str]:
             right = _gather(right)
         return dc_replace(node, left=left, right=right), "single"
 
-    small_build = (
-        rd == "single" or _estimate_rows(right, md) <= BROADCAST_ROW_LIMIT
-    )
+    small_build = rd == "single" or ctx.should_broadcast(left, right)
     if small_build:
         bcast = P.Exchange(
             dict(right.outputs), source=right, partitioning="broadcast",
@@ -200,8 +246,8 @@ def _walk_join(node: P.Join, md: Metadata) -> tuple[P.PlanNode, str]:
 
 # ---- aggregates ------------------------------------------------------------
 
-def _walk_aggregate(node: P.Aggregate, md: Metadata) -> tuple[P.PlanNode, str]:
-    src, d = _walk(node.source, md)
+def _walk_aggregate(node: P.Aggregate, ctx: _Ctx) -> tuple[P.PlanNode, str]:
+    src, d = _walk(node.source, ctx)
     if d == "single":
         return dc_replace(node, source=src), "single"
 

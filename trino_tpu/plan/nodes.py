@@ -71,6 +71,11 @@ class Aggregate(PlanNode):
     aggregates: dict[str, AggCall] = field(default_factory=dict)
     #: PARTIAL | FINAL | SINGLE — set by the optimizer when splitting
     step: str = "SINGLE"
+    #: stats annotations (plan.stats.annotate): expected distinct group
+    #: count, and EXACT (lo, hi) value bounds per integer group key for
+    #: value-range key packing
+    est_groups: float | None = None
+    key_ranges: dict[str, tuple[int, int]] | None = None
 
     @property
     def sources(self):
@@ -88,6 +93,12 @@ class Join(PlanNode):
     filter: RowExpression | None = None
     #: join distribution chosen by the optimizer: PARTITIONED|BROADCAST
     distribution: str | None = None
+    #: dynamic-filtering hints (plan.stats.annotate): expected probe
+    #: keep fraction under a build min/max range filter
+    #: (df_range_keep) and under exact build-key membership
+    #: (df_keep_frac); None = unknown, executors skip the filter
+    df_range_keep: float | None = None
+    df_keep_frac: float | None = None
 
     @property
     def sources(self):

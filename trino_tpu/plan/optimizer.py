@@ -408,31 +408,12 @@ def _rename(e: RowExpression, mapping: dict[str, str]) -> RowExpression:
 # ---- build-side choice -----------------------------------------------------
 
 def _estimate_rows(node: P.PlanNode, metadata: Metadata) -> float:
-    """Crude cardinality estimate (the StatsCalculator stand-in)."""
-    if isinstance(node, P.TableScan):
-        try:
-            conn = metadata.connector(node.catalog)
-            return float(conn.row_count(node.schema, node.table))
-        except Exception:
-            return 1e6
-    if isinstance(node, P.Filter):
-        return 0.25 * _estimate_rows(node.source, metadata)
-    if isinstance(node, P.Aggregate):
-        base = _estimate_rows(node.source, metadata)
-        return base if not node.group_keys else max(base / 10.0, 1.0)
-    if isinstance(node, P.Join):
-        l = _estimate_rows(node.left, metadata)
-        r = _estimate_rows(node.right, metadata)
-        if node.kind == "cross":
-            return l * r
-        return max(l, r)
-    if isinstance(node, (P.Limit, P.TopN)):
-        n = getattr(node, "count", -1)
-        sub = _estimate_rows(node.sources[0], metadata)
-        return min(float(n), sub) if n >= 0 else sub
-    if node.sources:
-        return max(_estimate_rows(s, metadata) for s in node.sources)
-    return 1.0
+    """Cardinality via the stats framework (plan.stats — the
+    StatsCalculator analog: connector column stats + per-predicate
+    selectivity instead of flat coefficients)."""
+    from trino_tpu.plan.stats import estimate
+
+    return estimate(node, metadata).rows
 
 
 def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:

@@ -178,6 +178,19 @@ class Coordinator:
                 ]
                 for k in done[: len(self._queries) - 200]:
                     del self._queries[k]
+            if len(self._queries) > 2000:
+                # hard bound: under burst load the grace period alone
+                # would let resultset-holding entries grow unboundedly;
+                # evict oldest finished regardless of age
+                done = sorted(
+                    (
+                        k for k, v in self._queries.items()
+                        if v.finished_at is not None
+                    ),
+                    key=lambda k: self._queries[k].finished_at,
+                )
+                for k in done[: len(self._queries) - 2000]:
+                    del self._queries[k]
 
         def run():
             if q.cancelled:
