@@ -269,3 +269,22 @@ def test_join_on_count_output_plans():
         "where t.x = s.c"
     ).rows)
     assert got == [(1,), (2,)]
+
+
+def test_outer_join_does_not_narrow_exact_bounds():
+    """LEFT JOIN keeps unmatched probe rows, so the probe key's exact
+    bounds must NOT intersect with the build side's narrower range
+    (would corrupt value-range key packing and merge distinct groups)."""
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table t1 (k bigint)")
+    r.execute("create table t2 (k bigint, w bigint)")
+    rows = ", ".join(f"({i * 1000})" for i in range(20))
+    r.execute(f"insert into t1 values {rows}")
+    r.execute("insert into t2 values (5000, 1), (6000, 2)")
+    got = sorted(r.execute(
+        "select t1.k, count(*) from t1 left join t2 on t1.k = t2.k "
+        "group by t1.k"
+    ).rows)
+    assert got == [(i * 1000, 1) for i in range(20)]

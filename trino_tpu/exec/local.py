@@ -610,6 +610,7 @@ class LocalExecutor:
         self.df_log.append(
             {"rows_in": in_rows, "rows_kept": kept, "pairs": pairs}
         )
+        del self.df_log[:-100]  # bounded: executors outlive queries
         if kept > (1.0 - self.DF_MIN_DROP) * in_rows:
             return probe
         filtered = Page(

@@ -619,6 +619,7 @@ class MeshExecutor(LocalExecutor):
         self.df_log.append(
             {"rows_in": in_rows, "rows_kept": kept, "pairs": list(criteria)}
         )
+        del self.df_log[:-100]  # bounded: executors outlive queries
         if kept > (1.0 - self.DF_MIN_DROP) * max(in_rows, 1):
             return probe
         new_cap = pad_capacity(int(max(n_keep.max(), 1)))

@@ -46,6 +46,7 @@ def optimize(plan: P.PlanNode, metadata: Metadata, session: Session) -> P.PlanNo
     plan = _rewrite_bottom_up(plan, _merge_adjacent_filters)
     plan = _rewrite_bottom_up(plan, _extract_joins)
     plan = _push_predicates(plan, metadata)
+    plan = _rewrite_bottom_up(plan, _push_semijoin_filters)
     plan = _choose_build_sides(plan, metadata)
     plan = _prune_columns(plan)
     return plan
@@ -405,6 +406,80 @@ def _rename(e: RowExpression, mapping: dict[str, str]) -> RowExpression:
     return e
 
 
+# ---- semi-join pushdown ----------------------------------------------------
+
+def _push_semijoin_filters(node: P.PlanNode) -> P.PlanNode:
+    """Push Filter(match)-over-SemiJoin through joins toward the side
+    producing the semi-join keys.
+
+    The analyzer plans an IN-subquery predicate as a SemiJoin ABOVE the
+    query's join tree; left there, the engine materializes the full
+    join output before discarding almost all of it (TPC-H Q18: 6M
+    joined rows kept: ~600). A semi-join filter over one side's
+    columns commutes with inner/cross joins (and with the probe side
+    of left joins), exactly like a scalar predicate — the reference
+    reaches the same shape through PredicatePushDown over
+    SemiJoinNodes. The rewrite recurses so the filter lands directly
+    on the key-producing relation."""
+    if not (isinstance(node, P.Filter) and isinstance(node.source, P.SemiJoin)):
+        return node
+    sj = node.source
+    conjs = _conjuncts(node.predicate)
+    match_conj = next(
+        (
+            c for c in conjs
+            if isinstance(c, InputRef) and c.name == sj.match_symbol
+        ),
+        None,
+    )
+    if match_conj is None:
+        return node
+    join = sj.source
+    if not isinstance(join, P.Join):
+        return node
+    # symbols the semi-join needs from its source side
+    need = {a for a, _ in sj.keys}
+    if sj.filter is not None:
+        need |= _refs(sj.filter) & set(join.outputs)
+    for side in ("left", "right"):
+        if join.kind == "cross":
+            pass  # both sides eligible
+        elif join.kind == "inner":
+            pass
+        elif join.kind == "left" and side == "left":
+            pass  # probe side of a left join commutes
+        else:
+            continue
+        child = getattr(join, side)
+        if not need <= set(child.outputs):
+            continue
+        inner_sj = P.SemiJoin(
+            {**child.outputs, sj.match_symbol: T.BOOLEAN},
+            source=child,
+            filter_source=sj.filter_source,
+            keys=list(sj.keys),
+            match_symbol=sj.match_symbol,
+            filter=sj.filter,
+            null_aware=sj.null_aware,
+        )
+        pushed = P.Filter(
+            dict(child.outputs), source=inner_sj, predicate=match_conj
+        )
+        # keep pushing through nested joins
+        pushed = _push_semijoin_filters(pushed)
+        new_join = dc_replace(
+            join,
+            **{side: pushed},
+            outputs={
+                s: t for s, t in join.outputs.items()
+                if s != sj.match_symbol
+            },
+        )
+        rest = [c for c in conjs if c is not match_conj]
+        return _attach(new_join, rest)
+    return node
+
+
 # ---- build-side choice -----------------------------------------------------
 
 def _estimate_rows(node: P.PlanNode, metadata: Metadata) -> float:
@@ -417,10 +492,14 @@ def _estimate_rows(node: P.PlanNode, metadata: Metadata) -> float:
 
 
 def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    from trino_tpu.plan.stats import estimate
+
+    cache: dict = {}  # shared memo: one stats walk, not O(joins^2)
+
     def fn(n: P.PlanNode) -> P.PlanNode:
         if isinstance(n, P.Join) and n.kind == "inner" and n.criteria:
-            l = _estimate_rows(n.left, metadata)
-            r = _estimate_rows(n.right, metadata)
+            l = estimate(n.left, metadata, cache).rows
+            r = estimate(n.right, metadata, cache).rows
             if r > l * 1.5:  # build side (right) should be the smaller
                 return dc_replace(
                     n, left=n.right, right=n.left,

@@ -75,10 +75,12 @@ def estimate(
     if _cache is None:
         _cache = {}
     hit = _cache.get(id(node))
-    if hit is not None:
-        return hit
+    # entries pin the node object (id-keyed caches alias freed
+    # addresses otherwise) and verify identity before use
+    if hit is not None and hit[0] is node:
+        return hit[1]
     out = _estimate(node, metadata, _cache)
-    _cache[id(node)] = out
+    _cache[id(node)] = (node, out)
     return out
 
 
@@ -434,9 +436,15 @@ def _join_stats(node: P.Join, md, cache) -> PlanStats:
             if denom <= 0:
                 denom = max(min(l.rows, r.rows), 1.0)
             rows /= denom
-            joined = _intersect_sym(l.sym(a), r.sym(b))
-            symbols[a] = joined
-            symbols[b] = joined
+            if node.kind == "inner":
+                # only an inner join guarantees surviving rows matched
+                # BOTH sides; outer joins keep unmatched rows whose
+                # keys lie outside the other side's range (and may be
+                # NULL-extended), so intersected exact bounds would
+                # corrupt value-range key packing
+                joined = _intersect_sym(l.sym(a), r.sym(b))
+                symbols[a] = joined
+                symbols[b] = joined
         rows = max(rows, 1.0)
     if node.kind == "left":
         rows = max(rows, l.rows)
