@@ -1,0 +1,189 @@
+"""Bigger-than-HBM execution: streamed scans, chunked partial
+aggregation, streamed-probe joins, grace-hash joins, streamed
+semi-joins — all under an ``hbm_budget_bytes`` session budget.
+
+The analog of the reference's spill tests
+(core/trino-main/src/test/java/io/trino/operator/join spill suites,
+TestSpillableHashAggregationBuilder): results must be identical to
+resident execution, and the tracked device working set must respect
+the budget.
+"""
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+from trino_tpu.exec import spill
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.testing.golden import (
+    assert_rows_match,
+    load_tpch_sqlite,
+    to_sqlite,
+)
+
+#: tight enough that tiny's lineitem (60k rows) must stream in several
+#: chunks, loose enough that per-chunk working sets + final results fit
+BUDGET = 2 << 20
+
+
+@pytest.fixture(autouse=True)
+def small_chunks(monkeypatch):
+    monkeypatch.setattr(spill, "MIN_CHUNK_ROWS", 8192)
+
+
+@pytest.fixture()
+def runner():
+    r = QueryRunner.tpch("tiny")
+    r.session.properties["hbm_budget_bytes"] = BUDGET
+    return r
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    data = QueryRunner.tpch("tiny").metadata.connector("tpch").data("tiny")
+    return load_tpch_sqlite(data)
+
+
+def check(runner, oracle, sql, abs_tol=1e-9):
+    result = runner.execute(sql)
+    expected = oracle.execute(to_sqlite(sql)).fetchall()
+    assert_rows_match(
+        result.rows, expected, ordered=result.ordered, abs_tol=abs_tol
+    )
+    return result
+
+
+def test_streamed_aggregation(runner, oracle):
+    check(
+        runner, oracle,
+        "select l_returnflag, l_linestatus, sum(l_quantity), "
+        "avg(l_extendedprice), count(*) from lineitem "
+        "where l_shipdate <= date '1998-09-02' "
+        "group by l_returnflag, l_linestatus order by 1, 2",
+    )
+    assert runner.executor.tracked_bytes_hwm > 0  # streaming engaged
+    assert runner.executor.tracked_bytes_hwm <= BUDGET
+
+
+def test_streamed_high_cardinality_aggregation(runner, oracle):
+    check(
+        runner, oracle,
+        "select l_orderkey, sum(l_quantity) from lineitem "
+        "group by l_orderkey order by 2 desc, 1 limit 20",
+    )
+
+
+def test_streamed_filter_only(runner, oracle):
+    check(
+        runner, oracle,
+        "select l_orderkey, l_quantity from lineitem "
+        "where l_quantity > 49 and l_discount < 0.02",
+    )
+
+
+def test_streamed_topn(runner, oracle):
+    check(
+        runner, oracle,
+        "select l_orderkey, l_extendedprice from lineitem "
+        "order by l_extendedprice desc, l_orderkey limit 7",
+    )
+
+
+def test_streamed_limit_early_exit(runner):
+    res = runner.execute("select l_orderkey from lineitem limit 5")
+    assert len(res.rows) == 5
+
+
+def test_streamed_probe_join(runner, oracle):
+    check(
+        runner, oracle,
+        "select n_name, count(*) from lineitem, supplier, nation "
+        "where l_suppkey = s_suppkey and s_nationkey = n_nationkey "
+        "group by n_name order by 1",
+    )
+
+
+def test_grace_join(runner, oracle):
+    """A full-width self-join: BOTH sides exceed the budget slab,
+    forcing the grace-hash partitioned path."""
+    check(
+        runner, oracle,
+        "select count(*) from lineitem l1, lineitem l2 "
+        "where l1.l_orderkey = l2.l_orderkey "
+        "and l1.l_linenumber = l2.l_linenumber",
+    )
+    assert runner.executor.tracked_bytes_hwm <= BUDGET
+
+
+def test_grace_left_join(runner, oracle):
+    check(
+        runner, oracle,
+        "select count(*), count(o_orderkey) from orders "
+        "left join lineitem on o_orderkey = l_orderkey "
+        "and l_quantity > 49",
+    )
+
+
+def test_streamed_semi_join(runner, oracle):
+    check(
+        runner, oracle,
+        "select count(*) from lineitem where l_orderkey in "
+        "(select o_orderkey from orders where o_orderpriority = '1-URGENT')",
+    )
+
+
+def test_budgeted_q18(runner, oracle):
+    """The VERDICT's target shape: Q18 under a device budget, results
+    matching sqlite."""
+    from trino_tpu.connectors.tpch.queries import QUERIES
+
+    check(runner, oracle, QUERIES["q18"], abs_tol=1e-6)
+    assert runner.executor.tracked_bytes_hwm <= BUDGET
+
+
+def test_budgeted_empty_result(runner, oracle):
+    check(
+        runner, oracle,
+        "select l_orderkey from lineitem where l_quantity > 1000",
+    )
+
+
+def test_results_identical_to_resident():
+    """The budget changes HOW, never WHAT: streamed and resident
+    executions must agree bit-for-bit."""
+    sql = (
+        "select l_returnflag, count(*), sum(l_extendedprice) "
+        "from lineitem, orders where l_orderkey = o_orderkey "
+        "and o_orderdate < date '1995-01-01' "
+        "group by l_returnflag order by 1"
+    )
+    resident = QueryRunner.tpch("tiny").execute(sql)
+    budgeted = QueryRunner.tpch("tiny")
+    budgeted.session.properties["hbm_budget_bytes"] = BUDGET
+    assert budgeted.execute(sql).rows == resident.rows
+
+
+def test_grace_join_varchar_keys(runner, oracle):
+    """Varchar grace keys must hash the string VALUE, not chunk-local
+    dictionary codes (codes shift between chunks/sides and would split
+    equal keys across partitions, silently losing matches)."""
+    check(
+        runner, oracle,
+        "select count(*) from lineitem l1, lineitem l2 "
+        "where l1.l_shipmode = l2.l_shipmode "
+        "and l1.l_orderkey = l2.l_orderkey "
+        "and l1.l_linenumber = l2.l_linenumber",
+    )
+
+
+def test_streamed_join_respects_inner_limit(runner, oracle):
+    """A Limit below a join must not stream per-chunk (each chunk
+    applying the limit locally would multiply the row count)."""
+    res = runner.execute(
+        "select count(*) from (select l_orderkey from lineitem limit 50) s, "
+        "orders where s.l_orderkey = o_orderkey"
+    )
+    resident = QueryRunner.tpch("tiny").execute(
+        "select count(*) from (select l_orderkey from lineitem limit 50) s, "
+        "orders where s.l_orderkey = o_orderkey"
+    )
+    assert res.rows == resident.rows

@@ -142,6 +142,14 @@ class Connector:
         stats."""
         return TableStats(float(self.row_count(schema, table)))
 
+    def column_stats(self, schema: str, table: str, column: str):
+        """Per-column stats — the planner asks column-by-column so a
+        generator-backed connector never materializes columns the query
+        doesn't touch (a full table_stats over SF100 lineitem would
+        generate 60M comment strings just to throw them away).
+        Default: delegate to table_stats."""
+        return self.table_stats(schema, table).columns.get(column)
+
     def splits(self, schema: str, table: str, target_splits: int) -> list[Split]:
         n = self.row_count(schema, table)
         target_splits = max(1, target_splits)

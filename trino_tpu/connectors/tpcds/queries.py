@@ -1,0 +1,266 @@
+"""Canonical TPC-DS query texts (spec templates with standard
+parameter substitutions), restated in the engine dialect.
+
+The analog of the reference's TPC-DS benchmark query set
+(testing/trino-benchto-benchmarks/.../benchmarks/trino/tpcds.yaml).
+Includes the BASELINE config #4 queries Q72 (deep 11-relation join
+tree over catalog_sales x inventory) and Q95 (web_sales self-join CTE
++ IN-subqueries). Date-window parameters are aligned to the
+generator's 1998-2002 sales calendar.
+"""
+
+QUERIES: dict[str, str] = {}
+
+QUERIES["q3"] = """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manufact_id = 128
+  and d_moy = 11
+group by d_year, i_brand_id, i_brand
+order by d_year, 4 desc, brand_id
+limit 100
+"""
+
+QUERIES["q7"] = """
+select i_item_id,
+       avg(ss_quantity) agg1,
+       avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3,
+       avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+
+QUERIES["q19"] = """
+select i_brand_id brand_id, i_brand brand, i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item, customer, customer_address, store
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 8
+  and d_moy = 11
+  and d_year = 1998
+  and ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5)
+  and ss_store_sk = s_store_sk
+group by i_brand_id, i_brand, i_manufact_id, i_manufact
+order by 5 desc, brand, brand_id, i_manufact_id, i_manufact
+limit 100
+"""
+
+QUERIES["q25"] = """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) as store_sales_profit,
+       sum(sr_net_loss) as store_returns_loss,
+       sum(cs_net_profit) as catalog_sales_profit
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4
+  and d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 10
+  and d2.d_year = 2001
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_moy between 4 and 10
+  and d3.d_year = 2001
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+
+QUERIES["q42"] = """
+select dt.d_year, item.i_category_id, item.i_category,
+       sum(ss_ext_sales_price)
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11
+  and dt.d_year = 2000
+group by dt.d_year, item.i_category_id, item.i_category
+order by 4 desc, 1, 2, 3
+limit 100
+"""
+
+QUERIES["q52"] = """
+select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11
+  and dt.d_year = 2000
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by 1, 4 desc, 2
+limit 100
+"""
+
+QUERIES["q55"] = """
+select i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 28
+  and d_moy = 11
+  and d_year = 1999
+group by i_brand_id, i_brand
+order by 3 desc, brand_id
+limit 100
+"""
+
+QUERIES["q62"] = """
+select w_warehouse_name, sm_type, web_name,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk <= 30)
+      then 1 else 0 end) as d30,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk > 30)
+       and (ws_ship_date_sk - ws_sold_date_sk <= 60)
+      then 1 else 0 end) as d60,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk > 60)
+       and (ws_ship_date_sk - ws_sold_date_sk <= 90)
+      then 1 else 0 end) as d90,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk > 90)
+       and (ws_ship_date_sk - ws_sold_date_sk <= 120)
+      then 1 else 0 end) as d120,
+  sum(case when (ws_ship_date_sk - ws_sold_date_sk > 120)
+      then 1 else 0 end) as dmore
+from web_sales, warehouse, ship_mode, web_site, date_dim
+where d_month_seq between 132 and 143
+  and ws_ship_date_sk = d_date_sk
+  and ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk
+  and ws_web_site_sk = web_site_sk
+group by w_warehouse_name, sm_type, web_name
+order by 1, 2, 3
+limit 100
+"""
+
+QUERIES["q68"] = """
+select c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, extended_price, extended_tax, list_price
+from (
+    select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+           sum(ss_ext_sales_price) extended_price,
+           sum(ss_ext_list_price) list_price,
+           sum(ss_ext_tax) extended_tax
+    from store_sales, date_dim, store, household_demographics,
+         customer_address
+    where ss_sold_date_sk = d_date_sk
+      and ss_store_sk = s_store_sk
+      and ss_hdemo_sk = hd_demo_sk
+      and ss_addr_sk = ca_address_sk
+      and d_dom between 1 and 2
+      and (hd_dep_count = 4 or hd_vehicle_count = 3)
+      and d_year in (1999, 2000, 2001)
+      and s_city in ('Fairview', 'Midway')
+    group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city
+) dn, customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+"""
+
+QUERIES["q72"] = """
+select i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(case when p_promo_sk is null then 1 else 0 end) no_promo,
+       sum(case when p_promo_sk is not null then 1 else 0 end) promo,
+       count(*) total_cnt
+from catalog_sales
+join inventory on cs_item_sk = inv_item_sk
+join warehouse on w_warehouse_sk = inv_warehouse_sk
+join item on i_item_sk = cs_item_sk
+join customer_demographics on cs_bill_cdemo_sk = cd_demo_sk
+join household_demographics on cs_bill_hdemo_sk = hd_demo_sk
+join date_dim d1 on cs_sold_date_sk = d1.d_date_sk
+join date_dim d2 on inv_date_sk = d2.d_date_sk
+join date_dim d3 on cs_ship_date_sk = d3.d_date_sk
+left outer join promotion on cs_promo_sk = p_promo_sk
+left outer join catalog_returns on cr_item_sk = cs_item_sk
+  and cr_order_number = cs_order_number
+where d1.d_week_seq = d2.d_week_seq
+  and inv_quantity_on_hand < cs_quantity
+  and d3.d_date > d1.d_date + 5
+  and hd_buy_potential = '>10000'
+  and d1.d_year = 1999
+  and cd_marital_status = 'D'
+group by i_item_desc, w_warehouse_name, d1.d_week_seq
+order by 6 desc, 1, 2, 3
+limit 100
+"""
+
+QUERIES["q95"] = """
+with ws_wh as (
+    select ws1.ws_order_number wh_order_number
+    from web_sales ws1, web_sales ws2
+    where ws1.ws_order_number = ws2.ws_order_number
+      and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk
+)
+select count(distinct ws_order_number) as order_count,
+       sum(ws_ext_ship_cost) as total_shipping_cost,
+       sum(ws_net_profit) as total_net_profit
+from web_sales, date_dim, customer_address, web_site
+where d_date between date '1999-02-01' and date '1999-04-02'
+  and ws_ship_date_sk = d_date_sk
+  and ws_ship_addr_sk = ca_address_sk
+  and ca_state = 'IL'
+  and ws_web_site_sk = web_site_sk
+  and web_company_name = 'pri'
+  and ws_order_number in (select wh_order_number from ws_wh)
+  and ws_order_number in (
+      select wr_order_number from web_returns, ws_wh
+      where wr_order_number = wh_order_number
+  )
+"""
+
+QUERIES["q96"] = """
+select count(*)
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = t_time_sk
+  and ss_hdemo_sk = hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and t_hour = 20
+  and t_minute >= 30
+  and hd_dep_count = 7
+  and s_store_name = 'ese'
+"""
+
+QUERIES["q98"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) as itemrevenue,
+       sum(ss_ext_sales_price) * 100 / sum(sum(ss_ext_sales_price))
+           over (partition by i_class) as revenueratio
+from store_sales, item, date_dim
+where ss_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ss_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-03-24'
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, 7
+limit 100
+"""

@@ -23,7 +23,7 @@ __all__ = ["TpchConnector"]
 class TpchConnector(Connector):
     def __init__(self):
         self._data: dict[float, TpchData] = {}
-        self._stats: dict[tuple[float, str], TableStats] = {}
+        self._stats: dict[tuple[float, str], dict[str, ColumnStats]] = {}
 
     def data(self, schema: str) -> TpchData:
         sf = self._sf(schema)
@@ -54,22 +54,23 @@ class TpchConnector(Connector):
     def row_count(self, schema: str, table: str) -> int:
         return self.data(schema).row_count(table)
 
-    def table_stats(self, schema: str, table: str) -> TableStats:
+    def column_stats(self, schema: str, table: str, column: str) -> ColumnStats:
         """Exact per-column stats (the reference tpch connector ships
         column statistics the same way, plugin/trino-tpch
-        TpchMetadata.getTableStatistics). Computed once from the
-        generated columns and disk-cached beside the data cache; the
+        TpchMetadata.getTableStatistics), computed LAZILY per column so
+        planning a query never materializes columns it doesn't touch
+        (generating SF100 comment text just for stats would take
+        minutes). Disk-cached incrementally beside the data cache; the
         generated data is deterministic per (sf, table), so the cache
         never goes stale."""
         sf = self._sf(schema)
         key = (sf, table)
-        if key in self._stats:
-            return self._stats[key]
+        cols = self._stats.setdefault(key, {})
+        if column in cols:
+            return cols[column]
         data = self.data(schema)
-        n = data.row_count(table)
         path = data.stats_path(table)
-        cols: dict[str, ColumnStats] = {}
-        if path is not None and os.path.exists(path):
+        if not cols and path is not None and os.path.exists(path):
             with open(path) as f:
                 raw = json.load(f)
             # older cache files stored integer bounds as floats; value-
@@ -83,18 +84,27 @@ class TpchConnector(Connector):
                         and abs(x) < 2**53
                     ):
                         v[b] = int(x)
-            cols = {c: ColumnStats(**v) for c, v in raw.items()}
-        else:
-            for c in SCHEMAS[table].column_names:
-                cols[c] = compute_column_stats(data.column(table, c))
-            if path is not None:
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = f"{path}.tmp{os.getpid()}"
-                with open(tmp, "w") as f:
-                    json.dump({c: vars(s) for c, s in cols.items()}, f)
-                os.replace(tmp, path)
-        self._stats[key] = ts = TableStats(float(n), cols)
-        return ts
+            cols.update({c: ColumnStats(**v) for c, v in raw.items()})
+            if column in cols:
+                return cols[column]
+        cols[column] = compute_column_stats(data.column(table, column))
+        if path is not None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({c: vars(s) for c, s in cols.items()}, f)
+            os.replace(tmp, path)
+        return cols[column]
+
+    def table_stats(self, schema: str, table: str) -> TableStats:
+        """Full-table stats: forces every column (tests use this; the
+        planner prefers column_stats)."""
+        n = self.data(schema).row_count(table)
+        cols = {
+            c: self.column_stats(schema, table, c)
+            for c in SCHEMAS[table].column_names
+        }
+        return TableStats(float(n), cols)
 
     def scan(
         self, schema: str, table: str, columns: list[str], split: Split | None = None

@@ -77,6 +77,18 @@ class QueryRunner:
         md.register_catalog("tpch", TpchConnector())
         return QueryRunner(md, Session(catalog="tpch", schema=schema), mesh=mesh)
 
+    @staticmethod
+    def tpcds(schema: str = "tiny", mesh=None) -> "QueryRunner":
+        """Runner with the TPC-DS catalog mounted (the reference's
+        TpcdsQueryRunner analog)."""
+        from trino_tpu.connectors.tpcds.connector import TpcdsConnector
+
+        md = Metadata()
+        md.register_catalog("tpcds", TpcdsConnector())
+        return QueryRunner(
+            md, Session(catalog="tpcds", schema=schema), mesh=mesh
+        )
+
     # ---- planning --------------------------------------------------------
 
     def plan_stmt(self, stmt: ast.Statement, optimized: bool = True) -> P.PlanNode:

@@ -50,6 +50,15 @@ class LocalExecutor:
         #: dynamic-filter effectiveness log (tests + EXPLAIN ANALYZE):
         #: [{rows_in, rows_kept, pairs}] per join probe this executor ran
         self.df_log: list[dict] = []
+        #: largest tracked device working set (streamed mode; tests
+        #: assert it stays within hbm_budget_bytes)
+        self.tracked_bytes_hwm = 0
+
+    def hbm_budget(self) -> int:
+        """Device-memory budget in bytes (session ``hbm_budget_bytes``;
+        0 = resident mode). Tables/joins whose working sets exceed it
+        stream through exec.spill instead of materializing."""
+        return int(self.session.properties.get("hbm_budget_bytes", 0) or 0)
 
     def invalidate_scan(self, catalog: str, schema: str, table: str):
         """Drop cached device pages for a table (called after writes —
@@ -71,6 +80,14 @@ class LocalExecutor:
             while isinstance(cur, stage.FUSABLE):
                 chain.append(cur)
                 cur = cur.sources[0]
+            budget = self.hbm_budget()
+            if budget and isinstance(cur, P.TableScan):
+                from trino_tpu.exec import spill
+
+                if spill.scan_bytes(self.metadata, cur) > budget // 4:
+                    return spill.run_chain_streamed(
+                        self, list(reversed(chain)), cur
+                    )
             base = self.execute(cur)
             return self._run_chain(list(reversed(chain)), base)
         m = getattr(self, f"_{type(node).__name__}", None)
@@ -406,19 +423,72 @@ class LocalExecutor:
     # ---- aggregation -----------------------------------------------------
 
     def _Join(self, node: P.Join) -> Page:
+        if node.kind == "right":
+            node = P.Join(
+                node.outputs, kind="left", left=node.right, right=node.left,
+                criteria=[(r, l) for l, r in node.criteria],
+                filter=node.filter,
+                df_range_keep=None, df_keep_frac=None,
+            )
+        budget = self.hbm_budget()
+        if budget and node.kind in ("inner", "left") and node.criteria:
+            plan = self._plan_budget_join(node, budget)
+            if plan is not None:
+                return plan
         left = self._compact(self.execute(node.left))
         right = self._compact(self.execute(node.right))
         if node.kind == "cross":
             return self._cross_join(node, left, right)
-        if node.kind == "right":
-            flipped = P.Join(
-                node.outputs, kind="left", left=node.right, right=node.left,
-                criteria=[(r, l) for l, r in node.criteria],
-                filter=node.filter,
-            )
-            # re-execute would recompute sources; join directly instead
-            return self._equi_join(flipped, right, left)
         return self._equi_join(node, left, right)
+
+    def _plan_budget_join(self, node: P.Join, budget: int) -> Page | None:
+        """Memory-scaled join strategies (SURVEY §5.7): streamed probe
+        against a resident build when only the probe exceeds budget,
+        grace-hash partitioning when both sides do. Returns None when
+        the resident path fits."""
+        from trino_tpu.exec import spill
+
+        l_bytes = spill.est_output_bytes(self, node.left)
+        r_bytes = spill.est_output_bytes(self, node.right)
+        slab = budget // 4
+        if l_bytes <= slab and r_bytes <= slab:
+            return None
+        probe_chain, probe_scan = self._streamable(node.left)
+        if l_bytes > slab and r_bytes <= slab and probe_scan is not None:
+            build = self._compact(self.execute(node.right))
+            return spill.streamed_probe_join(
+                self, node, probe_chain, probe_scan, build
+            )
+        if l_bytes > slab or r_bytes > slab:
+            # grace-hash handles inner AND left joins (each partition
+            # pair covers its key range exclusively, so unmatched
+            # probe rows emit exactly once)
+            return spill.grace_join(self, node)
+        return None
+
+    @staticmethod
+    def _streamable(node: P.PlanNode):
+        """(chain, scan) when the subtree is a fusable chain over a
+        TableScan — the shape the chunked scan path can stream."""
+        chain: list[P.PlanNode] = []
+        cur = node
+        while isinstance(cur, stage.FUSABLE):
+            chain.append(cur)
+            cur = cur.sources[0]
+        if isinstance(cur, P.TableScan):
+            # only row-local operators chunk safely here: aggregates
+            # reduce cardinality (and stream independently via
+            # execute()); Limit/TopN/Sort are global — a per-chunk
+            # limit concatenated across chunks would drop the
+            # truncation (run_chain_streamed handles those shapes via
+            # its partial/final split instead)
+            if any(
+                isinstance(n, (P.Aggregate, P.Limit, P.TopN, P.Sort))
+                for n in chain
+            ):
+                return None, None
+            return list(reversed(chain)), cur
+        return None, None
 
     def _cross_join(self, node: P.Join, left: Page, right: Page) -> Page:
         # callers (_Join) hand in already-compacted pages
@@ -846,6 +916,21 @@ class LocalExecutor:
     # ---- semi join -------------------------------------------------------
 
     def _SemiJoin(self, node: P.SemiJoin) -> Page:
+        budget = self.hbm_budget()
+        if budget:
+            from trino_tpu.exec import spill
+
+            src_chain, src_scan = self._streamable(node.source)
+            if (
+                src_scan is not None
+                and spill.est_output_bytes(self, node.source) > budget // 4
+                and spill.est_output_bytes(self, node.filter_source)
+                <= budget // 4
+            ):
+                filt = self._compact(self.execute(node.filter_source))
+                return spill.streamed_semi_join(
+                    self, node, src_chain, src_scan, filt
+                )
         source = self.execute(node.source)
         filt = self._compact(self.execute(node.filter_source))
         return self._semi_join_pages(node, source, filt)

@@ -144,12 +144,18 @@ def _estimate(node, md, cache) -> PlanStats:
 
 def _scan_stats(node: P.TableScan, md: Metadata) -> PlanStats:
     try:
-        ts = md.connector(node.catalog).table_stats(node.schema, node.table)
+        conn = md.connector(node.catalog)
+        rows = float(conn.row_count(node.schema, node.table))
     except Exception:
         return PlanStats(1e6)
     symbols = {}
     for sym, col in node.assignments.items():
-        cs = ts.columns.get(col)
+        # column-by-column so generator connectors only materialize
+        # what the query touches
+        try:
+            cs = conn.column_stats(node.schema, node.table, col)
+        except Exception:
+            cs = None
         if cs is None:
             symbols[sym] = _UNKNOWN
         else:
@@ -158,7 +164,7 @@ def _scan_stats(node: P.TableScan, md: Metadata) -> PlanStats:
                 null_frac=cs.null_fraction,
                 exact=cs.lo is not None,
             )
-    return PlanStats(ts.row_count, symbols)
+    return PlanStats(rows, symbols)
 
 
 def _union_sym(per: list[SymbolStats]) -> SymbolStats:

@@ -20,7 +20,20 @@ from trino_tpu import types as T
 from trino_tpu.connectors.tpch.generator import SCHEMAS, TpchData
 from trino_tpu.types import format_date
 
-__all__ = ["load_tpch_sqlite", "assert_rows_match", "to_sqlite"]
+__all__ = [
+    "load_tpch_sqlite", "load_tpcds_sqlite", "assert_rows_match",
+    "to_sqlite",
+]
+
+
+def load_tpcds_sqlite(data, tables: list[str] | None = None) -> sqlite3.Connection:
+    """Load generated TPC-DS tables into in-memory sqlite (the tpcds
+    oracle; pass ``tables`` to limit the load to a query's footprint)."""
+    from trino_tpu.connectors.tpcds.generator import SCHEMAS as DS_SCHEMAS
+
+    return _load_into(
+        sqlite3.connect(":memory:"), data, tables, schemas=DS_SCHEMAS
+    )
 
 
 def load_tpch_sqlite(
@@ -63,9 +76,12 @@ def load_tpch_sqlite(
     return _load_into(sqlite3.connect(":memory:"), data, tables)
 
 
-def _load_into(conn: sqlite3.Connection, data: TpchData, tables=None) -> sqlite3.Connection:
-    for name in tables or list(SCHEMAS):
-        schema = SCHEMAS[name]
+def _load_into(
+    conn: sqlite3.Connection, data, tables=None, schemas=None
+) -> sqlite3.Connection:
+    schemas = schemas if schemas is not None else SCHEMAS
+    for name in tables or list(schemas):
+        schema = schemas[name]
         cols = []
         for col, typ in schema.columns:
             if isinstance(typ, T.DecimalType) or isinstance(typ, (T.DoubleType, T.RealType)):
@@ -142,6 +158,12 @@ def to_sqlite(sql: str) -> str:
     out = re.sub(
         r"\bextract\s*\(\s*month\s+from\s+([a-z_0-9.]+)\s*\)",
         r"CAST(strftime('%m', \1) AS INTEGER)", out, flags=re.I,
+    )
+    # date-column arithmetic (TPC-DS q72 shape): sqlite stores dates as
+    # TEXT, so "a.d_date > b.d_date + 5" must go through julianday
+    out = re.sub(
+        r"([a-z_0-9.]*d_date)\s*>\s*([a-z_0-9.]*d_date)\s*\+\s*(\d+)",
+        r"julianday(\1) > julianday(\2) + \3", out, flags=re.I,
     )
     return out
 
