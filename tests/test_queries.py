@@ -328,3 +328,28 @@ def test_not_in_correlated_empty_per_probe_set(runner, oracle):
         "not in (select n_regionkey from nation "
         "where n_regionkey >= r_regionkey)",
     )
+
+
+def test_with_recursive_rejected(runner):
+    import pytest as _pytest
+
+    with _pytest.raises(NotImplementedError, match="RECURSIVE"):
+        runner.execute(
+            "with recursive t(n) as (select 1) select * from t"
+        )
+
+
+def test_large_cross_join_chunks(runner, oracle):
+    """Cross joins materialize chunk-wise instead of one n*m page."""
+    from trino_tpu.exec.local import LocalExecutor
+
+    old = LocalExecutor.CROSS_CHUNK_ROWS
+    LocalExecutor.CROSS_CHUNK_ROWS = 1 << 12
+    try:
+        check(
+            runner, oracle,
+            "select count(*), sum(o1.o_totalprice) from orders o1, nation "
+            "where o1.o_orderkey < 3000",
+        )
+    finally:
+        LocalExecutor.CROSS_CHUNK_ROWS = old

@@ -200,3 +200,20 @@ def test_dbapi_typed_binds(server):
     assert "2001" in str(cur.fetchone()[0])
     with _pytest.raises(dbapi.DataError):
         cur.execute("select ?", (b"bytes",))
+
+
+def test_cooperative_cancel():
+    """Cancel mid-query: the executor aborts at its next operator
+    boundary instead of running to completion."""
+    import threading
+
+    from trino_tpu.engine import QueryRunner
+    from trino_tpu.exec.local import QueryCancelled
+
+    r = QueryRunner.tpch("tiny")
+    ev = threading.Event()
+    ev.set()  # pre-cancelled: must abort before producing results
+    import pytest as _pytest
+
+    with _pytest.raises(QueryCancelled):
+        r.execute("select count(*) from lineitem", cancel_event=ev)

@@ -415,9 +415,7 @@ class TpcdsData:
         if name.endswith("_sk"):
             dim = _FK_TARGET.get(name)
             if dim is not None:
-                return rng.integers(
-                    1, self.row_count(dim) + 1, n
-                ).astype(np.int64)
+                return self._fk_values(dim, rng, n)
             return rng.integers(1, n + 1, n).astype(np.int64)
         if isinstance(typ, T.DateType):
             return rng.choice(self._dates, n)
@@ -430,6 +428,16 @@ class TpcdsData:
         return np.asarray(pool, dtype=object)[
             rng.integers(0, len(pool), n)
         ].astype(object)
+
+    def _fk_values(self, dim: str, rng, n: int) -> np.ndarray:
+        """Random foreign keys drawn from the dimension's ACTUAL key
+        domain: date_dim keys are Julian-day numbers and time_dim keys
+        are 0-based — a naive 1..row_count draw would never join."""
+        if dim == "date_dim":
+            return rng.choice(date_to_sk(self._dates), n)
+        if dim == "time_dim":
+            return rng.integers(0, 86_400, n).astype(np.int64)
+        return rng.integers(1, self.row_count(dim) + 1, n).astype(np.int64)
 
     # ---- date_dim: a real calendar ---------------------------------------
 
@@ -710,7 +718,6 @@ class TpcdsData:
         rng = self._rng(table, "doc")
         lens = rng.integers(1, 2 * avg_lines, n)  # enough docs to cover
         ends = np.cumsum(lens)
-        n_docs = int(np.searchsorted(ends, n) + 1)
         doc_of_row = np.searchsorted(ends, np.arange(n), side="right")
         return (doc_of_row + 1).astype(np.int64)
 

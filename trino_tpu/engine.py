@@ -118,9 +118,13 @@ class QueryRunner:
         plan = self.plan_sql(sql)
         return plan, self.executor.execute(plan)
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str, cancel_event=None) -> QueryResult:
         with self._lock:
-            return self._execute(sql)
+            self.executor.cancel_event = cancel_event
+            try:
+                return self._execute(sql)
+            finally:
+                self.executor.cancel_event = None
 
     def _execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)

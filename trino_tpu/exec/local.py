@@ -26,7 +26,14 @@ from trino_tpu.metadata import Metadata, Session
 from trino_tpu.page import Column, Page, pad_capacity, unify_dictionaries
 from trino_tpu.plan import nodes as P
 
-__all__ = ["LocalExecutor"]
+__all__ = ["LocalExecutor", "QueryCancelled"]
+
+
+class QueryCancelled(RuntimeError):
+    """Raised inside the executor when the query's cancel event fires
+    (cooperative cancellation: in-flight device dispatches finish, the
+    next operator boundary aborts — the reference cancels at its
+    driver-quantum boundaries the same way, MAIN/operator/Driver.java)."""
 
 
 class LocalExecutor:
@@ -53,6 +60,9 @@ class LocalExecutor:
         #: largest tracked device working set (streamed mode; tests
         #: assert it stays within hbm_budget_bytes)
         self.tracked_bytes_hwm = 0
+        #: cooperative cancellation: set by the coordinator, checked at
+        #: operator boundaries
+        self.cancel_event = None
 
     def hbm_budget(self) -> int:
         """Device-memory budget in bytes (session ``hbm_budget_bytes``;
@@ -73,7 +83,12 @@ class LocalExecutor:
         ]:
             del self._jit_cache[k]
 
+    def _check_cancel(self):
+        if self.cancel_event is not None and self.cancel_event.is_set():
+            raise QueryCancelled("Query was canceled")
+
     def execute(self, node: P.PlanNode) -> Page:
+        self._check_cancel()
         if isinstance(node, stage.FUSABLE):
             chain: list[P.PlanNode] = []
             cur = node
@@ -128,6 +143,10 @@ class LocalExecutor:
         raise NotImplementedError(type(n).__name__)
 
     def _run_chain(self, chain: list[P.PlanNode], page: Page) -> Page:
+        self._check_cancel()  # also covers streamed per-chunk calls
+        return self._run_chain_inner(chain, page)
+
+    def _run_chain_inner(self, chain: list[P.PlanNode], page: Page) -> Page:
         """Run a fused operator chain: one jitted program, one dispatch.
 
         Grouped aggregations retry with 8x larger slot tables when the
@@ -490,9 +509,42 @@ class LocalExecutor:
             return list(reversed(chain)), cur
         return None, None
 
+    #: cross joins materialize chunk-wise beyond this many output rows
+    #: (previously a moderately sized cross join OOMed in one shot)
+    CROSS_CHUNK_ROWS = 1 << 22
+
     def _cross_join(self, node: P.Join, left: Page, right: Page) -> Page:
         # callers (_Join) hand in already-compacted pages
         n_l, n_r = left.num_rows(), right.num_rows()
+        limit = self.CROSS_CHUNK_ROWS
+        budget = self.hbm_budget()
+        if budget:
+            from trino_tpu.exec import spill
+
+            limit = min(
+                limit,
+                max(
+                    (budget // spill.CHUNK_BUDGET_FRACTION)
+                    // spill.row_bytes(node.outputs),
+                    1 << 16,
+                ),
+            )
+        if n_l * n_r > limit and n_r > 0:
+            from trino_tpu.exec import spill
+
+            rows_per = max(limit // max(n_r, 1), 1)
+            runs = []
+            for lo in range(0, n_l, rows_per):
+                chunk = self._compact(
+                    _slice_page(left, lo, min(lo + rows_per, n_l))
+                )
+                out = self._cross_join(node, chunk, right)
+                run = spill.page_to_host(self._compact(out))
+                if run.n_rows:
+                    runs.append(run)
+            if not runs:
+                runs = [spill._empty_run(node.outputs)]
+            return spill.host_concat_to_page(self, runs)
         cap = pad_capacity(max(n_l * n_r, 1))
         key = (
             "cross", n_l, n_r,

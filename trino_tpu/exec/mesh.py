@@ -43,7 +43,14 @@ from trino_tpu.parallel.core import WORKER_AXIS, make_mesh
 from trino_tpu.parallel.exchange import partition_exchange
 from trino_tpu.plan import nodes as P
 
-__all__ = ["MeshExecutor", "ShardedPage"]
+__all__ = ["MeshExecutor", "ShardedPage", "SkewOverflow"]
+
+
+class SkewOverflow(RuntimeError):
+    """An exchange destination overflowed the per-shard capacity even
+    at the maximum bucket size — hash partitioning alone cannot place
+    this data (a hot key owns more rows than one shard holds). Join
+    callers recover via the skew-split path; other callers surface it."""
 
 
 @dataclass
@@ -152,6 +159,8 @@ class MeshExecutor(LocalExecutor):
         #: test hook: arm per-stage failures; stage programs retry
         #: (FailureInjector analog, MAIN/execution/FailureInjector.java:39)
         self.failure_injector = FailureInjector()
+        #: count of joins that took the skew-split path (tests/metrics)
+        self.skew_joins = 0
 
     def _attempt(self, tag: str, call):
         """Run one stage-shard program with injected-failure retry.
@@ -485,7 +494,9 @@ class MeshExecutor(LocalExecutor):
                 bucket_cap = min(bucket_cap * 4, shard_cap)
                 continue
             if bool(jax.device_get(ovf)):
-                raise RuntimeError("exchange bucket overflow at max capacity")
+                raise SkewOverflow(
+                    "exchange bucket overflow at max capacity"
+                )
             cols, i = [], 0
             for (name, has_valid), c in zip(meta, sp.columns):
                 data = out[i]
@@ -537,8 +548,21 @@ class MeshExecutor(LocalExecutor):
                 left = self._dynamic_filter_sharded(
                     node, left, right, criteria
                 )
-            probe = self.hash_exchange(left, [a for a, _ in criteria])
-            build = self.hash_exchange(right, [b for _, b in criteria])
+            out = None
+            if kind == "inner" and self._probe_is_skewed(left, criteria):
+                out = self._skew_join(node, left, right, criteria)
+            if out is not None:
+                return out
+            try:
+                probe = self.hash_exchange(left, [a for a, _ in criteria])
+                build = self.hash_exchange(right, [b for _, b in criteria])
+            except SkewOverflow:
+                if kind != "inner":
+                    raise
+                out = self._skew_join(node, left, right, criteria)
+                if out is None:
+                    raise
+                return out
             replicated = False
         out_syms = list(node.outputs)
         if kind == "right":
@@ -657,6 +681,157 @@ class MeshExecutor(LocalExecutor):
                 i += 1
             cols.append(Column(c.type, data, valid, c.dictionary))
         return ShardedPage(list(probe.names), cols, new_mask, probe.n_shards)
+
+    # ---- skew-split join (SkewedPartitionRebalancer analog,
+    # MAIN/operator/output/SkewedPartitionRebalancer.java — but for
+    # joins, which the reference just lets eat the skew) --------------
+
+    #: probes below this skip the skew histogram (one tiny dispatch)
+    SKEW_MIN_PROBE = 1 << 16
+    #: a destination this many times above the mean marks skew
+    SKEW_FACTOR = 4.0
+
+    def _probe_is_skewed(self, probe: ShardedPage, criteria) -> bool:
+        """One cheap histogram dispatch: is any exchange destination
+        loaded far beyond the mean? Without the split, a hot key
+        inflates every shard's received capacity (n_shards x bucket)
+        and serializes the whole mesh behind one shard's join."""
+        if probe.shard_capacity * probe.n_shards < self.SKEW_MIN_PROBE:
+            return False
+        _, counts = self._dest_counts(probe, [a for a, _ in criteria])
+        total = counts.sum()
+        if total == 0:
+            return False
+        mean = total / self.n_shards
+        return bool(counts.max() > self.SKEW_FACTOR * mean)
+
+    def _dest_counts(self, sp: ShardedPage, key_syms: list[str]):
+        """(dest per row, global per-destination row counts)."""
+        cols = [sp.column(k) for k in key_syms]
+        h = K.hash_columns([(c.data, c.valid) for c in cols])
+        dest = (h % jnp.uint64(self.n_shards)).astype(jnp.int32)
+        prog = self._mesh_jit_cache.get("dest-hist")
+        if prog is None:
+            n = self.n_shards
+
+            def hist(d, m):
+                return jax.ops.segment_sum(
+                    jnp.where(m, 1, 0), d, num_segments=n
+                )
+
+            prog = jax.jit(hist)
+            self._mesh_jit_cache["dest-hist"] = prog
+        counts = prog(dest, sp.mask)
+        return dest, np.asarray(jax.device_get(counts))
+
+    def _skew_join(
+        self, node: P.Join, left: ShardedPage, right: ShardedPage,
+        criteria,
+    ) -> ShardedPage | None:
+        """Inner join under destination skew: split the BUILD side into
+        hot destinations (broadcast to every shard) and cold ones
+        (hash-partitioned as usual); hot PROBE rows salt round-robin
+        across the mesh. The two joins partition the key space
+        disjointly, so their union is the exact join — a hot key's
+        probe rows spread over all shards instead of escalating one
+        bucket to shard capacity and failing."""
+        lkeys = [a for a, _ in criteria]
+        rkeys = [b for _, b in criteria]
+        p_dest, p_counts = self._dest_counts(left, lkeys)
+        b_dest, b_counts = self._dest_counts(right, rkeys)
+        shard_cap = left.shard_capacity
+        # a destination is hot when either side's load cannot fit the
+        # exchange's maximum bucket
+        mean = max(p_counts.sum() / self.n_shards, 1.0)
+        # hot = destinations overloaded on the PROBE side (what skew
+        # salting fixes); a tightly-packed-but-balanced build must not
+        # trip this — its per-dest load naturally sits near capacity
+        hot = (p_counts > shard_cap // 2) | (
+            p_counts > self.SKEW_FACTOR * mean
+        )
+        # the hot builds replicate to every shard — bail out to the
+        # plain exchange when that replica would itself be oversized
+        # (the memory blowup this path exists to avoid)
+        if (
+            not hot.any()
+            or b_counts[hot].sum() > 4 * right.shard_capacity
+        ):
+            return None
+        self.skew_joins += 1
+        hot_dev = jnp.asarray(hot)
+
+        # probe: hot rows round-robin by global position, cold rows keep
+        # their hash destination
+        rr = (
+            jnp.arange(p_dest.shape[0], dtype=jnp.int32)
+            % jnp.int32(self.n_shards)
+        )
+        salted = jnp.where(hot_dev[p_dest], rr, p_dest)
+        probe = self.exchange_by_dest(left, salted)
+
+        # build: cold rows exchange; hot rows gather into one local
+        # replicated page (hot keys are few — their build rows fit)
+        cold_mask = right.mask & ~hot_dev[b_dest]
+        cold = ShardedPage(
+            list(right.names), list(right.columns), cold_mask,
+            right.n_shards,
+        )
+        build_cold = self.hash_exchange(cold, rkeys)
+        hot_mask = right.mask & hot_dev[b_dest]
+        hot_sp = ShardedPage(
+            list(right.names), list(right.columns), hot_mask,
+            right.n_shards,
+        )
+        build_hot = self.gather(hot_sp)
+
+        out_syms = list(node.outputs)
+        part1 = self._equi_join_sharded(
+            node, probe, build_cold, False, "inner", criteria, out_syms
+        )
+        part2 = self._equi_join_sharded(
+            node, probe, build_hot, True, "inner", criteria, out_syms
+        )
+        return self._concat_sharded(part1, part2)
+
+    def _concat_sharded(self, a: ShardedPage, b: ShardedPage) -> ShardedPage:
+        """Per-shard concatenation of two same-layout sharded pages."""
+        axis = self.axis
+        a_leaves, meta = _page_leaves(a)
+        b_leaves, _ = _page_leaves(b)
+        key = (
+            "mesh-concat", self._sharded_sig(a), self._sharded_sig(b),
+        )
+        prog = self._mesh_jit_cache.get(key)
+        if prog is None:
+            n_a = len(a_leaves)
+
+            def fc(*ls):
+                xs, ys = ls[:n_a], ls[n_a:]
+                return [
+                    jnp.concatenate([x, y]) for x, y in zip(xs, ys)
+                ]
+
+            prog = jax.jit(
+                jax.shard_map(
+                    fc, mesh=self.mesh,
+                    in_specs=(PS(axis),) * (len(a_leaves) + len(b_leaves)),
+                    out_specs=[PS(axis)] * len(a_leaves),
+                    check_vma=False,
+                )
+            )
+            self._mesh_jit_cache[key] = prog
+        out = prog(*a_leaves, *b_leaves)
+        cols, i = [], 0
+        for (name, has_valid), c in zip(meta, a.columns):
+            data = out[i]
+            i += 1
+            valid = None
+            if has_valid:
+                valid = out[i]
+                i += 1
+            cols.append(Column(c.type, data, valid, c.dictionary))
+        mask = out[i]
+        return ShardedPage(list(a.names), cols, mask, a.n_shards)
 
     def _match_count_capacity(self, key, prelude, in_specs, leaves) -> int:
         """Phase A of a distributed join: per-shard match totals, one

@@ -53,6 +53,8 @@ class QueryState:
     created_at: float = field(default_factory=time.time)
     finished_at: float | None = None
     cancelled: bool = False
+    #: cooperative cancellation signal checked by the executor
+    cancel_event: object = field(default_factory=threading.Event)
 
 
 class Coordinator:
@@ -198,7 +200,11 @@ class Coordinator:
                 return
             q.state = "RUNNING"
             try:
-                result = self.runner.execute(sql)
+                # cooperative cancellation: DELETE sets the event and
+                # the executor aborts at its next operator boundary
+                result = self.runner.execute(
+                    sql, cancel_event=q.cancel_event
+                )
                 if q.cancelled:
                     q.state = "FAILED"
                 else:
@@ -218,6 +224,7 @@ class Coordinator:
         q = self._queries.get(qid)
         if q is not None:
             q.cancelled = True
+            q.cancel_event.set()
             if q.state in ("QUEUED", "RUNNING"):
                 q.state = "FAILED"
                 q.error = "Query was canceled"

@@ -180,7 +180,13 @@ class _Parser:
     def query(self) -> ast.Query:
         with_ = []
         if self.accept_kw("with"):
-            self.accept_kw("recursive")  # accepted, handled by analyzer
+            if self.accept_kw("recursive"):
+                # silently swallowing it would resolve the CTE's self-
+                # reference against an outer table and return wrong
+                # results; reject until recursion is implemented
+                raise NotImplementedError(
+                    "WITH RECURSIVE is not supported"
+                )
             while True:
                 name = self.ident()
                 self.expect_kw("as")
