@@ -529,16 +529,29 @@ class LocalExecutor:
                     1 << 16,
                 ),
             )
-        if n_l * n_r > limit and n_r > 0:
+        if n_l * n_r > limit and max(n_l, n_r) > 1:
             from trino_tpu.exec import spill
 
-            rows_per = max(limit // max(n_r, 1), 1)
+            # chunk the LARGER side: chunking the left against a
+            # right that alone exceeds the limit would recurse with a
+            # 1-row chunk forever
+            chunk_left = n_l >= n_r
+            n_big = n_l if chunk_left else n_r
+            n_other = n_r if chunk_left else n_l
+            rows_per = max(limit // max(n_other, 1), 1)
             runs = []
-            for lo in range(0, n_l, rows_per):
-                chunk = self._compact(
-                    _slice_page(left, lo, min(lo + rows_per, n_l))
-                )
-                out = self._cross_join(node, chunk, right)
+            for lo in range(0, n_big, rows_per):
+                hi = min(lo + rows_per, n_big)
+                if chunk_left:
+                    out = self._cross_join(
+                        node, self._compact(_slice_page(left, lo, hi)),
+                        right,
+                    )
+                else:
+                    out = self._cross_join(
+                        node, left,
+                        self._compact(_slice_page(right, lo, hi)),
+                    )
                 run = spill.page_to_host(self._compact(out))
                 if run.n_rows:
                     runs.append(run)

@@ -190,6 +190,7 @@ class MeshExecutor(LocalExecutor):
         return self.gather(self.execute_dist(node.source))
 
     def execute_dist(self, node: P.PlanNode) -> ShardedPage:
+        self._check_cancel()
         if isinstance(node, stage.FUSABLE):
             chain: list[P.PlanNode] = []
             cur = node
@@ -695,15 +696,29 @@ class MeshExecutor(LocalExecutor):
         """One cheap histogram dispatch: is any exchange destination
         loaded far beyond the mean? Without the split, a hot key
         inflates every shard's received capacity (n_shards x bucket)
-        and serializes the whole mesh behind one shard's join."""
+        and serializes the whole mesh behind one shard's join. The
+        (dest, counts) pair memoizes for _skew_join's immediate reuse."""
         if probe.shard_capacity * probe.n_shards < self.SKEW_MIN_PROBE:
             return False
-        _, counts = self._dest_counts(probe, [a for a, _ in criteria])
+        keys = tuple(a for a, _ in criteria)
+        dest, counts = self._dest_counts(probe, list(keys))
+        self._dest_memo = (id(probe), keys, probe, dest, counts)
         total = counts.sum()
         if total == 0:
             return False
         mean = total / self.n_shards
         return bool(counts.max() > self.SKEW_FACTOR * mean)
+
+    def _dest_counts_memo(self, sp: ShardedPage, key_syms: list[str]):
+        memo = getattr(self, "_dest_memo", None)
+        if (
+            memo is not None
+            and memo[0] == id(sp)
+            and memo[1] == tuple(key_syms)
+            and memo[2] is sp
+        ):
+            return memo[3], memo[4]
+        return self._dest_counts(sp, key_syms)
 
     def _dest_counts(self, sp: ShardedPage, key_syms: list[str]):
         """(dest per row, global per-destination row counts)."""
@@ -737,7 +752,7 @@ class MeshExecutor(LocalExecutor):
         bucket to shard capacity and failing."""
         lkeys = [a for a, _ in criteria]
         rkeys = [b for _, b in criteria]
-        p_dest, p_counts = self._dest_counts(left, lkeys)
+        p_dest, p_counts = self._dest_counts_memo(left, lkeys)
         b_dest, b_counts = self._dest_counts(right, rkeys)
         shard_cap = left.shard_capacity
         # a destination is hot when either side's load cannot fit the

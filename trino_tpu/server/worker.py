@@ -1,0 +1,185 @@
+"""Worker process: owns the device mesh, executes plans shipped over
+HTTP — the coordinator/worker seam.
+
+The analog of the reference's worker tier RPC
+(MAIN/server/TaskResource.java:135-339: POST /v1/task with a plan
+fragment, long-poll GET for status/results) standing in for the DCN
+boundary (SURVEY.md §5.8): even with both processes on one host, the
+plan travels as JSON (plan.serde) and results return as typed JSON
+rows — the host-boundary serialization layer a multi-host deployment
+needs, forced into existence.
+
+Run: ``python -m trino_tpu.server.worker --port 8091 [--mesh]``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from trino_tpu.engine import QueryRunner
+from trino_tpu.page import Page
+from trino_tpu.plan import nodes as P
+from trino_tpu.plan.serde import plan_from_json
+
+__all__ = ["WorkerServer"]
+
+
+class _Task:
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self.state = "RUNNING"
+        self.error: str | None = None
+        self.names: list[str] = []
+        self.rows: list[list] = []
+
+
+class WorkerServer:
+    """One worker process: a QueryRunner-owned executor behind a task
+    RPC. Tasks execute serially (the engine's batch model; the
+    reference's TaskExecutor concurrency maps to the mesh instead)."""
+
+    def __init__(self, runner: QueryRunner, port: int = 0):
+        self.runner = runner
+        self._tasks: dict[str, _Task] = {}
+        self._lock = threading.Lock()
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/v1/task":
+                    self._send(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                task = worker.submit(req)
+                self._send(200, {"taskId": task.task_id})
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if (
+                    len(parts) == 4
+                    and parts[:2] == ["v1", "task"]
+                    and parts[3] == "results"
+                ):
+                    t = worker._tasks.get(parts[2])
+                    if t is None:
+                        self._send(404, {"error": "no such task"})
+                        return
+                    payload = {"state": t.state}
+                    if t.state == "FINISHED":
+                        payload.update(columns=t.names, data=t.rows)
+                    elif t.state == "FAILED":
+                        payload.update(error=t.error)
+                    self._send(200, payload)
+                    return
+                if parts == ["v1", "info"]:
+                    self._send(200, {
+                        "state": "ACTIVE",
+                        "mesh": worker.runner.mesh is not None,
+                    })
+                    return
+                self._send(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "WorkerServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ---- task execution --------------------------------------------------
+
+    def submit(self, req: dict) -> _Task:
+        task = _Task(uuid.uuid4().hex[:12])
+        self._tasks[task.task_id] = task
+
+        def run():
+            try:
+                plan = plan_from_json(req["plan"])
+                for k, v in (req.get("session") or {}).items():
+                    self.runner.session.properties[k] = v
+                with self.runner._lock:
+                    page = self.runner.executor.execute(plan)
+                task.names, task.rows = _page_json(plan, page)
+                task.state = "FINISHED"
+            except Exception as e:
+                task.error = f"{type(e).__name__}: {e}"
+                task.state = "FAILED"
+
+        threading.Thread(target=run, daemon=True).start()
+        return task
+
+
+def _page_json(plan: P.PlanNode, page: Page) -> tuple[list[str], list[list]]:
+    """Result rows as JSON-safe values (dates ISO, decimals as strings
+    — the typed-JSON result encoding of the client protocol)."""
+    import datetime
+    import decimal
+
+    rows = []
+    for row in page.to_pylist():
+        out = []
+        for v in row:
+            if isinstance(v, decimal.Decimal):
+                out.append(str(v))
+            elif isinstance(v, (datetime.date, datetime.datetime)):
+                out.append(v.isoformat())
+            else:
+                out.append(v)
+        rows.append(out)
+    return list(page.names), rows
+
+
+def main():
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8091)
+    ap.add_argument("--catalog", default="tpch")
+    ap.add_argument("--schema", default="tiny")
+    ap.add_argument("--mesh", action="store_true")
+    args = ap.parse_args()
+    mesh = None
+    if args.mesh:
+        from trino_tpu.parallel.core import make_mesh
+
+        mesh = make_mesh()
+    factory = (
+        QueryRunner.tpcds if args.catalog == "tpcds" else QueryRunner.tpch
+    )
+    runner = factory(args.schema, mesh=mesh)
+    server = WorkerServer(runner, port=args.port)
+    server.start()
+    print(f"worker ready on port {server.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()
