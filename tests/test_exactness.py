@@ -99,3 +99,88 @@ def test_distributed_decimal_exactness():
     local = QueryRunner.tpch("tiny").execute(sql).rows
     dist = QueryRunner.tpch("tiny", mesh=make_mesh()).execute(sql).rows
     assert local == dist
+
+
+# ---- decimal(38): exact two-limb aggregation -------------------------------
+
+def _mem_runner():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.engine import QueryRunner
+    from trino_tpu.metadata import Metadata, Session
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    return QueryRunner(md, Session(catalog="memory", schema="default"))
+
+
+def test_decimal38_sum_exact_beyond_int64():
+    """sum(decimal) is decimal(38): totals beyond int64 must be
+    bit-exact vs Python Decimal (two-limb accumulation, the Int128
+    DecimalSumAggregation analog)."""
+    from decimal import Decimal
+
+    r = _mem_runner()
+    r.execute("create table t (g bigint, v decimal(18,2))")
+    big = Decimal("91000000000000000.25")   # 9.1e18 unscaled > int64/2
+    vals = [(i % 3, big + i) for i in range(40)]
+    rows = ", ".join(f"({g}, {v})" for g, v in vals)
+    r.execute(f"insert into t values {rows}")
+    got = dict(r.execute("select g, sum(v) from t group by g").rows)
+    expect = {}
+    for g, v in vals:
+        expect[g] = expect.get(g, Decimal(0)) + v
+    assert got == expect  # bit-exact, would wrap int64 without limbs
+    (total,) = r.execute("select sum(v) from t").rows[0]
+    assert total == sum(expect.values())
+
+
+def test_decimal38_sum_negative_and_null():
+    from decimal import Decimal
+
+    r = _mem_runner()
+    r.execute("create table t (g bigint, v decimal(18,2))")
+    r.execute(
+        "insert into t values (1, -91000000000000000.25), "
+        "(1, -91000000000000000.25), (1, 0.50), (2, null), (2, null)"
+    )
+    got = dict(r.execute("select g, sum(v) from t group by g").rows)
+    assert got[1] == Decimal("-182000000000000000.00")
+    assert got[2] is None  # all-NULL group stays NULL
+
+
+def test_decimal_avg_exact_with_limb_sum():
+    """avg uses the exact limb sum internally: large inputs must not
+    wrap int64 on the way to the (round-half-away) quotient."""
+    from decimal import ROUND_HALF_UP, Decimal
+
+    r = _mem_runner()
+    r.execute("create table t (v decimal(18,2))")
+    vals = [Decimal("91000000000000000.25")] * 150 + [Decimal("0.37")]
+    rows = ", ".join(f"({v})" for v in vals)
+    r.execute(f"insert into t values {rows}")
+    (got,) = r.execute("select avg(v) from t").rows[0]
+    total = sum(vals)
+    unscaled = (total * 100 / len(vals)).quantize(
+        Decimal(1), rounding=ROUND_HALF_UP
+    )
+    assert got == Decimal(unscaled).scaleb(-2)
+
+
+def test_decimal38_order_by_and_compare():
+    from decimal import Decimal
+
+    r = _mem_runner()
+    r.execute("create table t (g bigint, v decimal(18,2))")
+    rows = ", ".join(
+        f"({i}, {Decimal('91000000000000000.00') + i})" for i in range(9)
+    )
+    r.execute(f"insert into t values {rows}")
+    res = r.execute(
+        "select g, sum(v) s from t group by g order by s desc limit 3"
+    ).rows
+    assert [g for g, _ in res] == [8, 7, 6]
+    res2 = r.execute(
+        "select g from t group by g "
+        "having sum(v) >= 91000000000000005.00 order by g"
+    ).rows
+    assert [g for (g,) in res2] == [5, 6, 7, 8]

@@ -353,3 +353,54 @@ def test_large_cross_join_chunks(runner, oracle):
         )
     finally:
         LocalExecutor.CROSS_CHUNK_ROWS = old
+
+
+# ---- UNNEST ----------------------------------------------------------------
+
+def test_unnest_constant(runner):
+    assert runner.execute(
+        "select x from unnest(array[3,1,2]) as t(x) order by 1"
+    ).rows == [(1,), (2,), (3,)]
+
+
+def test_unnest_lateral_pivot(runner, oracle):
+    """The canonical columns->rows pivot: t, unnest(array[t.a, t.b])."""
+    got = runner.execute(
+        "select n_name, x from nation "
+        "cross join unnest(array[n_nationkey, n_regionkey]) as u(x) "
+        "where n_nationkey < 3 order by 1, 2"
+    ).rows
+    expect = oracle.execute(
+        "select n_name, n_nationkey as x from nation where n_nationkey < 3 "
+        "union all select n_name, n_regionkey from nation "
+        "where n_nationkey < 3 order by 1, 2"
+    ).fetchall()
+    assert [tuple(r) for r in got] == [tuple(r) for r in expect]
+
+
+def test_unnest_zip_null_pads(runner):
+    rows = runner.execute(
+        "select x, y from unnest(array[1,2,3], array[10,20]) as t(x, y) "
+        "order by 1"
+    ).rows
+    assert rows == [(1, 10), (2, 20), (3, None)]
+
+
+def test_unnest_strings_and_agg(runner):
+    rows = runner.execute(
+        "select s, count(*) from unnest(array['b','a','b']) as t(s) "
+        "group by s order by 1"
+    ).rows
+    assert rows == [("a", 1), ("b", 2)]
+
+
+def test_unnest_aggregate_over_lateral(runner, oracle):
+    got = runner.execute(
+        "select sum(x) from nation, "
+        "unnest(array[n_nationkey, n_regionkey * 100]) as u(x)"
+    ).rows
+    expect = oracle.execute(
+        "select (select sum(n_nationkey) from nation) + "
+        "(select sum(n_regionkey) * 100 from nation)"
+    ).fetchall()
+    assert got[0][0] == expect[0][0]

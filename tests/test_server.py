@@ -217,3 +217,46 @@ def test_cooperative_cancel():
 
     with _pytest.raises(QueryCancelled):
         r.execute("select count(*) from lineitem", cancel_event=ev)
+
+
+def test_system_runtime_queries(server):
+    """system.runtime tables answer plain SQL over live engine state
+    (MAIN/connector/system analog)."""
+    import trino_tpu.server.dbapi as dbapi
+
+    cur = dbapi.connect(server.uri).cursor()
+    cur.execute("select n_name from nation where n_nationkey = 0")
+    cur.fetchall()
+    cur.execute(
+        "select query_id, state, query from system.runtime.queries "
+        "where state = 'FINISHED'"
+    )
+    rows = cur.fetchall()
+    assert rows and any("n_name" in r[2] for r in rows)
+    cur.execute("select node_id, kind from system.runtime.nodes")
+    assert cur.fetchall()
+
+
+def test_explain_analyze_rows_and_bytes(server):
+    from trino_tpu.engine import QueryRunner
+
+    r = QueryRunner.tpch("tiny")
+    res = r.execute(
+        "explain analyze select o_orderpriority, count(*) from orders, "
+        "lineitem where o_orderkey = l_orderkey group by o_orderpriority"
+    )
+    text = "\n".join(x[0] for x in res.rows)
+    assert "in: " in text and "out: " in text and "ms]" in text
+
+
+def test_system_queries_not_cached(server):
+    """system.runtime is a live view: a second query must see the
+    first one (scan caching would freeze the snapshot)."""
+    import trino_tpu.server.dbapi as dbapi
+
+    cur = dbapi.connect(server.uri).cursor()
+    cur.execute("select count(*) from system.runtime.queries")
+    (n1,) = cur.fetchone()
+    cur.execute("select count(*) from system.runtime.queries")
+    (n2,) = cur.fetchone()
+    assert n2 > n1

@@ -311,8 +311,21 @@ class Analyzer:
     def plan_select(self, sel: ast.Select, outer: Scope | None, ctes: dict):
         # FROM
         if sel.relations:
-            rp = self.plan_relation(sel.relations[0], outer, ctes)
+            first = sel.relations[0]
+            if isinstance(first, ast.UnnestRel):
+                base = RelationPlan(
+                    P.Values({}, rows=[[]]), Scope([], parent=outer)
+                )
+                rp = self._plan_unnest(first, base)
+            else:
+                rp = self.plan_relation(first, outer, ctes)
             for r in sel.relations[1:]:
+                if isinstance(r, ast.UnnestRel):
+                    # lateral: the array expressions may reference the
+                    # relations to the left (the common
+                    # "t, unnest(array[t.a, t.b])" pivot shape)
+                    rp = self._plan_unnest(r, rp)
+                    continue
                 right = self.plan_relation(r, outer, ctes)
                 rp = self._cross_join(rp, right)
         else:
@@ -438,8 +451,56 @@ class Analyzer:
             node, Scope(left.scope.fields + right.scope.fields, parent=left.scope.parent)
         )
 
+    def _plan_unnest(
+        self, rel: "ast.UnnestRel", left: RelationPlan
+    ) -> RelationPlan:
+        """UNNEST(ARRAY[...], ...) laterally over ``left``."""
+        ea = ExprAnalyzer(self, left.scope)
+        arrays = []
+        elem_types = []
+        for items in rel.args:
+            irs = [ea.analyze(e) for e in items]
+            t = irs[0].type
+            for ir in irs[1:]:
+                t = T.common_super_type(t, ir.type)
+            irs = [
+                ir if ir.type == t else Cast(t, ir) for ir in irs
+            ]
+            arrays.append(tuple(irs))
+            elem_types.append(t)
+        alias = rel.alias.lower() if rel.alias else None
+        names = rel.column_aliases or [
+            f"col{i + 1}" for i in range(len(arrays))
+        ]
+        if len(names) != len(arrays):
+            raise AnalysisError(
+                f"UNNEST has {len(arrays)} arrays but "
+                f"{len(names)} column aliases"
+            )
+        symbols = []
+        fields = list(left.scope.fields)
+        outputs = dict(left.node.outputs)
+        for name, t in zip(names, elem_types):
+            sym = self.symbols.new(name, t)
+            symbols.append(sym)
+            outputs[sym] = t
+            fields.append(Field(name.lower(), sym, t, alias))
+        node = P.Unnest(
+            outputs, source=left.node, arrays=arrays,
+            element_symbols=symbols,
+        )
+        return RelationPlan(
+            node, Scope(fields, parent=left.scope.parent)
+        )
+
     def _plan_join(self, rel: ast.JoinRel, outer: Scope | None, ctes: dict) -> RelationPlan:
         left = self.plan_relation(rel.left, outer, ctes)
+        if isinstance(rel.right, ast.UnnestRel):
+            if rel.kind not in ("cross", "inner"):
+                raise AnalysisError(
+                    f"{rel.kind} join with UNNEST is not supported"
+                )
+            return self._plan_unnest(rel.right, left)
         right = self.plan_relation(rel.right, outer, ctes)
         combined = self._cross_join(left, right)
         if rel.kind == "cross":

@@ -101,6 +101,10 @@ def arith_result_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
         return T.common_super_type(lt, rt)
     ld = ld or T.DecimalType(18, 0)
     rd = rd or T.DecimalType(18, 0)
+    if ld.is_long or rd.is_long:
+        # arithmetic over two-limb decimals routes through DOUBLE
+        # (exact limb math is reserved for sum/avg; see types.py)
+        return T.DOUBLE
     if op in ("add", "subtract"):
         s = max(ld.scale, rd.scale)
         p = min(18, max(ld.precision - ld.scale, rd.precision - rd.scale) + s + 1)
@@ -129,7 +133,9 @@ def agg_result_type(name: str, arg_type: T.DataType | None) -> T.DataType:
         if arg_type.is_integer:
             return T.BIGINT
         if isinstance(arg_type, T.DecimalType):
-            return T.DecimalType(18, arg_type.scale)
+            # Trino: sum(decimal(p,s)) -> decimal(38,s); the engine
+            # computes it exactly in two int64 limbs
+            return T.DecimalType(38, arg_type.scale)
         if isinstance(arg_type, (T.DoubleType, T.RealType)):
             return T.DOUBLE
         raise AnalysisError(f"cannot sum {arg_type}")

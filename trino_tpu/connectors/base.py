@@ -123,6 +123,10 @@ def compute_column_stats(
 class Connector:
     """Base connector: metadata + split enumeration + column scan."""
 
+    #: False for live views (system tables): the executor must not
+    #: device-cache their scans between queries
+    cacheable = True
+
     def list_schemas(self) -> list[str]:
         return []
 

@@ -1,0 +1,116 @@
+"""System connector: engine state as queryable tables.
+
+The analog of the reference's internal system connector
+(MAIN/connector/system/: system.runtime.nodes / queries / tasks):
+``system.runtime.queries`` exposes the coordinator's query tracker and
+``system.runtime.nodes`` the mesh topology, so operators can introspect
+the engine with plain SQL.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connectors.base import Connector, Split, TableSchema
+
+__all__ = ["SystemConnector"]
+
+_QUERIES_SCHEMA = TableSchema("queries", [
+    ("query_id", T.VARCHAR),
+    ("state", T.VARCHAR),
+    ("query", T.VARCHAR),
+    ("error", T.VARCHAR),
+    ("elapsed_ms", T.DOUBLE),
+    ("rows", T.BIGINT),
+])
+
+_NODES_SCHEMA = TableSchema("nodes", [
+    ("node_id", T.VARCHAR),
+    ("kind", T.VARCHAR),
+    ("state", T.VARCHAR),
+])
+
+
+class SystemConnector(Connector):
+    """Read-only views over live engine state. ``source`` is the
+    owning Coordinator (queries) and/or runner (nodes); either may be
+    absent (empty tables)."""
+
+    cacheable = False  # live state: never device-cache these scans
+
+    def __init__(self, coordinator=None, runner=None):
+        self.coordinator = coordinator
+        self.runner = runner
+
+    def list_schemas(self) -> list[str]:
+        return ["runtime"]
+
+    def list_tables(self, schema: str) -> list[str]:
+        return ["queries", "nodes"] if schema == "runtime" else []
+
+    def table_schema(self, schema: str, table: str) -> TableSchema:
+        if schema != "runtime":
+            raise KeyError(f"{schema}.{table}")
+        if table == "queries":
+            return _QUERIES_SCHEMA
+        if table == "nodes":
+            return _NODES_SCHEMA
+        raise KeyError(f"{schema}.{table}")
+
+    def _query_rows(self):
+        if self.coordinator is None:
+            return []
+        out = []
+        with self.coordinator._lock:
+            states = list(self.coordinator._queries.values())
+        for q in states:
+            end = q.finished_at or time.time()
+            out.append((
+                q.query_id, q.state, q.sql, q.error or "",
+                (end - q.created_at) * 1e3,
+                len(q.result.rows) if q.result is not None else 0,
+            ))
+        return out
+
+    def _node_rows(self):
+        runner = self.runner
+        if runner is None and self.coordinator is not None:
+            runner = self.coordinator.runner
+        if runner is None or runner.mesh is None:
+            return [("local-0", "coordinator+worker", "ACTIVE")]
+        return [("local-0", "coordinator", "ACTIVE")] + [
+            (f"shard-{i}", "worker", "ACTIVE")
+            for i in range(runner.mesh.devices.size)
+        ]
+
+    def row_count(self, schema: str, table: str) -> int:
+        rows = (
+            self._query_rows() if table == "queries" else self._node_rows()
+        )
+        return len(rows)
+
+    def scan(
+        self, schema: str, table: str, columns: list[str],
+        split: Split | None = None,
+    ):
+        ts = self.table_schema(schema, table)
+        rows = (
+            self._query_rows() if table == "queries" else self._node_rows()
+        )
+        if split is not None:
+            rows = rows[split.start: split.start + split.count]
+        idx = {c: i for i, c in enumerate(ts.column_names)}
+        out = {}
+        for c in columns:
+            i = idx[c]
+            t = ts.column_type(c)
+            if isinstance(t, T.VarcharType):
+                out[c] = np.array([r[i] for r in rows], dtype=object)
+            else:
+                out[c] = np.array(
+                    [r[i] for r in rows], dtype=t.np_dtype
+                )
+        return out

@@ -340,17 +340,44 @@ def _timed_frontier_ms(node: P.PlanNode, stats) -> float:
     return total
 
 
+def _rows_in(node: P.PlanNode, stats) -> int:
+    """Input rows = nearest timed descendants' output rows (the
+    OperatorStats inputPositions analog)."""
+    total = 0
+    for s in node.sources:
+        if id(s) in stats:
+            total += stats[id(s)][1]
+        else:
+            total += _rows_in(s, stats)
+    return total
+
+
 def _annotated_tree(node: P.PlanNode, stats, indent: int = 0) -> str:
+    from trino_tpu.exec.spill import row_bytes
+
     own = stats.get(id(node))
     base = P.plan_tree_str(node, indent).splitlines()[0]
     if own is not None:
         ms, n_rows = own
         child_ms = _timed_frontier_ms(node, stats)
-        base += f"   [{n_rows} rows, {max(ms - child_ms, 0.0):.1f} ms]"
+        n_in = _rows_in(node, stats)
+        out_bytes = n_rows * row_bytes(node.outputs)
+        base += (
+            f"   [in: {n_in} rows, out: {n_rows} rows"
+            f" ({_fmt_bytes(out_bytes)}), {max(ms - child_ms, 0.0):.1f} ms]"
+        )
     lines = [base]
     for s in node.sources:
         lines.append(_annotated_tree(s, stats, indent + 1))
     return "\n".join(lines)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n}B"
 
 
 def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:
@@ -433,6 +460,14 @@ def _literal_value(e: ast.Expr, t):
         and isinstance(e.arg, (ast.IntLit, ast.FloatLit))
     ):
         return -e.arg.value
+    if (
+        isinstance(e, ast.Unary)
+        and e.op == "-"
+        and isinstance(e.arg, ast.DecimalLit)
+    ):
+        from decimal import Decimal
+
+        return -Decimal(e.arg.text)
     raise NotImplementedError(
         f"INSERT VALUES supports literals only, got {type(e).__name__}"
     )

@@ -199,6 +199,14 @@ def compute_aggregate(
     nonempty = cnt > 0
 
     if name == "sum":
+        if isinstance(out_type, T.DecimalType) and out_type.is_long:
+            # decimal(38) sum: EXACT two-limb int64 accumulation (the
+            # Int128 DecimalSumAggregation analog). Each int64 input
+            # splits into hi = x >> 32 (sign-extended) and lo 32 bits;
+            # both limb sums fit int64 for any page (|hi| <= 2^31,
+            # lo < 2^32, rows < 2^31), so no achievable sum overflows.
+            hi, lo = _limb_sums(red, data)
+            return jnp.stack([hi, lo], axis=-1), nonempty
         cast = (
             out_type.np_dtype
             if isinstance(out_type, (T.DoubleType, T.RealType))
@@ -208,15 +216,36 @@ def compute_aggregate(
 
     if name == "avg":
         if isinstance(out_type, T.DecimalType):
-            # unscaled int sum / count, rounded half away from zero
-            # (reference: DecimalAverageAggregation)
-            s = red.sum(data)
-            return _div_round_half_up(s, jnp.maximum(cnt, 1)), nonempty
+            # exact limb sum, then exact 96/64 long division with
+            # round-half-away (reference: DecimalAverageAggregation);
+            # the quotient always fits int64 (an average is bounded by
+            # the inputs)
+            hi, lo = _limb_sums(red, data)
+            return _limb_div_round(hi, lo, jnp.maximum(cnt, 1)), nonempty
         s = red.sum(data, dtype=jnp.float64)
         return s / jnp.maximum(cnt, 1), nonempty
 
+
     if name in ("min", "max"):
         is_min = name == "min"
+        if jnp.ndim(data) == 2:
+            # two-limb decimal: extreme of hi, then extreme of lo among
+            # rows at that hi (lexicographic == numeric order since lo
+            # is canonical non-negative)
+            hi, lo = data[:, 0], data[:, 1]
+            iinfo = jnp.iinfo(jnp.int64)
+            fill_hi = jnp.int64(iinfo.max if is_min else iinfo.min)
+            m_hi = red.minmax(hi, fill_hi, is_min)
+            if info is None:
+                at_ext = hi == m_hi[0]
+            else:
+                at_ext = hi == m_hi[
+                    jnp.clip(info.group, 0, capacity - 1)
+                ]
+            red2 = _Reducer(info, capacity, red.contrib & at_ext, share)
+            fill_lo = jnp.int64((1 << 32) if is_min else -1)
+            m_lo = red2.minmax(lo, fill_lo, is_min)
+            return jnp.stack([m_hi, m_lo], axis=-1), nonempty
         if data.dtype == jnp.bool_:
             fill = jnp.int8(1 if is_min else 0)
             out = red.minmax(data.astype(jnp.int8), fill, is_min)
@@ -257,6 +286,38 @@ def compute_aggregate(
         return var, ok
 
     raise NotImplementedError(f"aggregate {name}")
+
+
+def _limb_norm(s_hi, s_lo):
+    """Canonicalize limb sums: lo into [0, 2^32), carry into hi."""
+    carry = s_lo >> jnp.int64(32)
+    lo = s_lo & jnp.int64(0xFFFFFFFF)
+    return s_hi + carry, lo
+
+
+def _limb_sums(red, data):
+    """Exact (hi, lo) limb sums of an int64 column via two reductions."""
+    x_hi = data >> jnp.int64(32)  # arithmetic shift keeps the sign
+    x_lo = data & jnp.int64(0xFFFFFFFF)
+    return _limb_norm(red.sum(x_hi), red.sum(x_lo))
+
+
+def _limb_div_round(hi, lo, cnt):
+    """(hi*2^32 + lo) / cnt exactly, rounded half away from zero.
+
+    Schoolbook 96/64 long division in two int64 steps: q1 = hi // cnt
+    leaves r1 < cnt <= 2^31, so (r1 << 32) | lo fits int64."""
+    q1 = hi // cnt  # floor
+    r1 = hi - q1 * cnt  # in [0, cnt)
+    rem = (r1 << jnp.int64(32)) | lo
+    q2 = rem // cnt
+    r2 = rem - q2 * cnt
+    q = (q1 << jnp.int64(32)) + q2  # floor((hi*2^32+lo)/cnt)
+    # round half away from zero on the floor quotient: positive values
+    # bump at >= .5, negative at > .5 (floor already moved them down)
+    neg = (hi < 0) | ((hi == 0) & (lo < 0))
+    bump = jnp.where(neg, 2 * r2 > cnt, 2 * r2 >= cnt)
+    return q + jnp.where(bump, 1, 0)
 
 
 # ---- FINAL-step combines ---------------------------------------------------
@@ -310,7 +371,53 @@ def _var_final(kind, args, red: _Reducer):
     return var, ok
 
 
+def _decimal_sum_final(out_type, args, red: _Reducer):
+    """FINAL combine of distributed long-decimal sums: partial states
+    are two BIGINT limb-sum columns (hi32, lo)."""
+    s_hi = _state_sum(args[0], red)
+    s_lo = _state_sum(args[1], red)
+    hi, lo = _limb_norm(s_hi, s_lo)
+    _, hv = args[0]
+    cred = red.with_valid(hv)
+    return jnp.stack([hi, lo], axis=-1), cred.count() > 0
+
+
+def _decimal_avg_final(out_type, args, red: _Reducer):
+    """FINAL combine of distributed decimal averages: exact limb sum
+    of partial limb states, divided by the combined count."""
+    s_hi = _state_sum(args[0], red)
+    s_lo = _state_sum(args[1], red)
+    cnt = _state_sum(args[2], red)
+    hi, lo = _limb_norm(s_hi, s_lo)
+    nonempty = cnt > 0
+    return _limb_div_round(hi, lo, jnp.maximum(cnt, 1)), nonempty
+
+
+def _limb_partial_sum(which: str):
+    """PARTIAL limb sums over the raw decimal column: 'hi32' sums the
+    sign-extended top 32 bits, 'lo32' the low 32 bits (both exact in
+    int64 for any page)."""
+
+    def fn(out_type, args, red: _Reducer):
+        pair = args[0] if isinstance(args, list) else args
+        data, valid = pair
+        r = red.with_valid(valid)
+        part = (
+            r.sum(data >> jnp.int64(32)) if which == "hi32"
+            else r.sum(data & jnp.int64(0xFFFFFFFF))
+        )
+        # NULL when no row contributed, so the FINAL combine keeps SUM's
+        # all-NULL-group semantics
+        return part, r.count() > 0
+
+    return fn
+
+
 _FINAL_COMBINES = {
     "count_final": _count_final,
     "avg_final": _avg_final,
+    "decimal_sum_final": _decimal_sum_final,
+    "decimal_avg_final": _decimal_avg_final,
+    "sum_hi32": _limb_partial_sum("hi32"),
+    "sum_lo32": _limb_partial_sum("lo32"),
 }

@@ -97,6 +97,14 @@ def searchsorted(a: jnp.ndarray, v: jnp.ndarray, side: str = "left") -> jnp.ndar
     return jnp.searchsorted(a, v, side=side, method=method)
 
 
+def limb_parts(data: jnp.ndarray) -> list[jnp.ndarray]:
+    """A key column as 1D pieces: two-limb decimal columns ([n, 2])
+    contribute their hi and lo limbs as separate key parts."""
+    if jnp.ndim(data) == 2:
+        return [data[:, 0], data[:, 1]]
+    return [data]
+
+
 def normalize_key(data: jnp.ndarray, valid: jnp.ndarray | None):
     """(bits, null_flag) with NULL data zeroed so equal keys have equal
     bits (SQL GROUP BY / join keys treat NULLs as one group)."""

@@ -346,12 +346,22 @@ class LocalExecutor:
 
     def _TableScan(self, node: P.TableScan) -> Page:
         key = (node.catalog, node.schema, node.table)
-        cache = self._scan_cache.setdefault(key, {})
+        if not self.metadata.connector(node.catalog).cacheable:
+            cache = {}  # live views (system tables) re-scan per query
+        else:
+            cache = self._scan_cache.setdefault(key, {})
         missing = [c for c in node.assignments.values() if c not in cache]
         if missing or "" not in cache:
             connector = self.metadata.connector(node.catalog)
             cols = connector.scan(node.schema, node.table, missing)
-            n = connector.row_count(node.schema, node.table)
+            if missing:
+                # row count from the scanned arrays themselves: a
+                # second row_count() call could see a DIFFERENT
+                # snapshot on live views (system tables)
+                first = cols[missing[0]]
+                n = len(first[0] if isinstance(first, tuple) else first)
+            else:
+                n = connector.row_count(node.schema, node.table)
             cap = pad_capacity(n)
             if "" not in cache:
                 mask = np.zeros(cap, dtype=np.bool_)
@@ -609,22 +619,31 @@ class LocalExecutor:
     def _traced_join_keys(penv, benv, criteria):
         """Combined uint64 keys for probe/build sides from traced envs.
 
-        Single fixed-width key -> exact; multi-column -> hash-combined
-        and ``verify`` is True (matches re-checked after expansion).
+        Single fixed-width key -> exact; multi-column (including
+        two-limb decimal keys, which expand into hi/lo parts) ->
+        hash-combined and ``verify`` is True (matches re-checked after
+        expansion). The returned ``pairs`` are 1D (probe, build) part
+        arrays for the verification loop.
         """
-        pairs = [(penv[l], benv[r]) for l, r in criteria]
         pv = bv = None
-        for (pd, pvd), (bd, bvd) in pairs:
+        p_parts: list = []
+        b_parts: list = []
+        for l, r in criteria:
+            pd, pvd = penv[l]
+            bd, bvd = benv[r]
             pv = _and_mask(pv, pvd)
             bv = _and_mask(bv, bvd)
-        if len(pairs) == 1:
-            pk, _ = K.normalize_key(pairs[0][0][0], None)
-            bk, _ = K.normalize_key(pairs[0][1][0], None)
+            p_parts.extend(K.limb_parts(pd))
+            b_parts.extend(K.limb_parts(bd))
+        if len(p_parts) == 1:
+            pk, _ = K.normalize_key(p_parts[0], None)
+            bk, _ = K.normalize_key(b_parts[0], None)
             verify = False
         else:
-            pk = K.hash_columns([(pd, None) for (pd, _), _ in pairs])
-            bk = K.hash_columns([(bd, None) for _, (bd, _) in pairs])
+            pk = K.hash_columns([(d, None) for d in p_parts])
+            bk = K.hash_columns([(d, None) for d in b_parts])
             verify = True
+        pairs = list(zip(p_parts, b_parts))
         return pk, bk, pv, bv, pairs, verify
 
     def _join_count(self, criteria, probe: Page, build: Page):
@@ -819,7 +838,7 @@ class LocalExecutor:
                 order, lo, cnt, out_cap
             )
             if verify:
-                for (pd, _), (bd, _) in pairs:
+                for pd, bd in pairs:
                     pb, _ = K.normalize_key(pd, None)
                     bb, _ = K.normalize_key(bd, None)
                     out_live = out_live & (pb[probe_idx] == bb[build_idx])
@@ -890,7 +909,7 @@ class LocalExecutor:
             probe_idx, build_idx, out_live = K.expand_matches(
                 order, lo, cnt, out_cap
             )
-            for (pd, _), (bd, _) in pairs:
+            for pd, bd in pairs:
                 pb, _ = K.normalize_key(pd, None)
                 bb, _ = K.normalize_key(bd, None)
                 out_live = out_live & (pb[probe_idx] == bb[build_idx])
@@ -905,6 +924,124 @@ class LocalExecutor:
         return jax.jit(fb)
 
     # ---- window / set operations -----------------------------------------
+
+    def _Unnest(self, node: P.Unnest) -> Page:
+        """Static-fanout UNNEST (UnnestOperator analog,
+        MAIN/operator/unnest/UnnestOperator.java): output position
+        t = i * k + j holds element j of source row i — one reshape,
+        no data-dependent shapes. Shorter zipped arrays NULL-pad."""
+        page = self.execute(node.source)
+        k = max(len(a) for a in node.arrays)
+        cap = page.capacity
+        out_cap = cap * k
+        key = (
+            "unnest",
+            tuple(tuple(repr(e) for e in a) for a in node.arrays),
+            tuple(node.element_symbols),
+            self._layout_sig(page),
+        )
+        hit = self._jit_cache.get(key)
+        if hit is None:
+            from trino_tpu.page import StringDictionary
+
+            layout = self._layout(page)
+            producers = []  # per arg: list of ('expr', c) | ('code', int)
+            elem_dicts = []
+            for a, sym in zip(node.arrays, node.element_symbols):
+                cs = [compile_expr(e, layout) for e in a]
+                t = node.outputs[sym]
+                if isinstance(t, T.VarcharType):
+                    if all(
+                        c.is_literal and c.dictionary is not None
+                        for c in cs
+                    ):
+                        # one merged dictionary over the literal pool;
+                        # each element becomes a constant code
+                        merged = StringDictionary(np.unique(
+                            np.concatenate(
+                                [c.dictionary.values for c in cs]
+                            )
+                        ))
+                        producers.append([
+                            (
+                                "code",
+                                int(np.searchsorted(
+                                    merged.values,
+                                    c.dictionary.values[0],
+                                )),
+                            )
+                            for c in cs
+                        ])
+                        elem_dicts.append(merged)
+                        continue
+                    dict_ids = {id(c.dictionary) for c in cs}
+                    if len(dict_ids) != 1 or None in {
+                        c.dictionary for c in cs
+                    }:
+                        raise NotImplementedError(
+                            "UNNEST varchar elements must share one "
+                            "dictionary or all be literals"
+                        )
+                    elem_dicts.append(cs[0].dictionary)
+                else:
+                    elem_dicts.append(None)
+                producers.append([("expr", c) for c in cs])
+
+            def fx(env, mask):
+                idx = jnp.arange(out_cap, dtype=jnp.int32) // k
+                env2 = {}
+                for s, (d, v) in env.items():
+                    env2[s] = (
+                        d[idx], None if v is None else v[idx]
+                    )
+                for sym, prods in zip(node.element_symbols, producers):
+                    t = node.outputs[sym]
+                    cols = []
+                    vals = []
+                    for kind_, c in prods:
+                        if kind_ == "code":
+                            d = jnp.full((cap,), c, dtype=jnp.int32)
+                            v = None
+                        else:
+                            d, v = stage._bcast(*c.fn(env), cap)
+                        cols.append(d)
+                        vals.append(
+                            jnp.ones((cap,), dtype=jnp.bool_)
+                            if v is None else v
+                        )
+                    stacked = jnp.stack(cols, axis=1)  # [cap, k_m]
+                    svalid = jnp.stack(vals, axis=1)
+                    k_m = stacked.shape[1]
+                    if k_m < k:  # NULL-pad shorter zipped arrays
+                        pad = jnp.zeros((cap, k - k_m), dtype=stacked.dtype)
+                        stacked = jnp.concatenate([stacked, pad], axis=1)
+                        svalid = jnp.concatenate(
+                            [
+                                svalid,
+                                jnp.zeros(
+                                    (cap, k - k_m), dtype=jnp.bool_
+                                ),
+                            ],
+                            axis=1,
+                        )
+                    env2[sym] = (
+                        stacked.reshape(out_cap),
+                        svalid.reshape(out_cap),
+                    )
+                return env2, mask[idx]
+
+            hit = (jax.jit(fx), elem_dicts)
+            self._jit_cache[key] = hit
+        fn, elem_dicts = hit
+        env2, mask2 = fn(self._env(page), page.mask)
+        names, cols = [], []
+        for nm, c in zip(page.names, page.columns):
+            names.append(nm)
+            cols.append(Column(c.type, *env2[nm], c.dictionary))
+        for sym, d in zip(node.element_symbols, elem_dicts):
+            names.append(sym)
+            cols.append(Column(node.outputs[sym], *env2[sym], d))
+        return Page(names, cols, mask2)
 
     def _Window(self, node: P.Window) -> Page:
         from trino_tpu.exec.window import build_window_program

@@ -116,15 +116,14 @@ def _make_prelude(criteria, p_meta, b_meta, n_p, verify):
                 pv = pvx if pv is None else (pv & pvx)
             if bvx is not None:
                 bv = bvx if bv is None else (bv & bvx)
-            p_bits.append(K.normalize_key(pd, None)[0])
-            b_bits.append(K.normalize_key(bd, None)[0])
-        if verify:
-            pk = K.hash_columns(
-                [(p_env[a][0], p_env[a][1]) for a, _ in criteria]
-            )
-            bk = K.hash_columns(
-                [(b_env[b][0], b_env[b][1]) for _, b in criteria]
-            )
+            # two-limb decimal keys expand into hi/lo parts
+            for part in K.limb_parts(pd):
+                p_bits.append(K.normalize_key(part, None)[0])
+            for part in K.limb_parts(bd):
+                b_bits.append(K.normalize_key(part, None)[0])
+        if verify or len(p_bits) > len(criteria):
+            pk = K.hash_columns([(b, None) for b in p_bits])
+            bk = K.hash_columns([(b, None) for b in b_bits])
         else:
             pk, bk = p_bits[0], b_bits[0]
         probe_live = p_mask if pv is None else (p_mask & pv)
@@ -230,7 +229,9 @@ class MeshExecutor(LocalExecutor):
     def _shard_split(self, host: np.ndarray, n: int, per: int, cap: int):
         """Lay n host rows contiguously into the [n_shards * cap]
         sharded layout and put it on the mesh."""
-        out = np.zeros(self.n_shards * cap, dtype=host.dtype)
+        out = np.zeros(
+            (self.n_shards * cap,) + host.shape[1:], dtype=host.dtype
+        )
         for s in range(self.n_shards):
             take = min(max(n - s * per, 0), per)
             out[s * cap: s * cap + take] = host[s * per: s * per + take]
@@ -238,12 +239,19 @@ class MeshExecutor(LocalExecutor):
 
     def _scan_dist(self, node: P.TableScan) -> ShardedPage:
         key = (node.catalog, node.schema, node.table)
-        cache = self._dist_scan_cache.setdefault(key, {})
+        if not self.metadata.connector(node.catalog).cacheable:
+            cache = {}  # live views re-scan per query
+        else:
+            cache = self._dist_scan_cache.setdefault(key, {})
         missing = [c for c in node.assignments.values() if c not in cache]
         if missing or "" not in cache:
             connector = self.metadata.connector(node.catalog)
             cols = connector.scan(node.schema, node.table, missing)
-            n = connector.row_count(node.schema, node.table)
+            if missing:
+                first = cols[missing[0]]
+                n = len(first[0] if isinstance(first, tuple) else first)
+            else:
+                n = connector.row_count(node.schema, node.table)
             per, cap = self._shard_layout(n)
             if "" not in cache:
                 cache[""] = self._shard_split(
@@ -281,8 +289,9 @@ class MeshExecutor(LocalExecutor):
         cap = pad_capacity(len(idx))
         cols = []
         for c in sp.columns:
-            data = np.zeros(cap, dtype=np.asarray(c.data).dtype)
-            data[: len(idx)] = np.asarray(c.data)[idx]
+            src = np.asarray(c.data)
+            data = np.zeros((cap,) + src.shape[1:], dtype=src.dtype)
+            data[: len(idx)] = src[idx]
             valid = None
             if c.valid is not None:
                 v = np.zeros(cap, dtype=np.bool_)
@@ -438,7 +447,14 @@ class MeshExecutor(LocalExecutor):
         self, sp: ShardedPage, key_symbols: list[str]
     ) -> ShardedPage:
         cols = [sp.column(k) for k in key_symbols]
-        h = K.hash_columns([(c.data, c.valid) for c in cols])
+        pairs = []
+        for c in cols:
+            parts = K.limb_parts(c.data)  # 2D limb keys expand
+            pairs.extend(
+                (p, c.valid if i == 0 else None)
+                for i, p in enumerate(parts)
+            )
+        h = K.hash_columns(pairs)
         dest = (h % jnp.uint64(self.n_shards)).astype(jnp.int32)
         return self.exchange_by_dest(sp, dest)
 
@@ -702,15 +718,21 @@ class MeshExecutor(LocalExecutor):
             return False
         keys = tuple(a for a, _ in criteria)
         dest, counts = self._dest_counts(probe, list(keys))
-        self._dest_memo = (id(probe), keys, probe, dest, counts)
         total = counts.sum()
         if total == 0:
             return False
         mean = total / self.n_shards
-        return bool(counts.max() > self.SKEW_FACTOR * mean)
+        skewed = bool(counts.max() > self.SKEW_FACTOR * mean)
+        # memoize for _skew_join's immediate reuse only — holding the
+        # page/dest arrays any longer would pin device memory
+        self._dest_memo = (
+            (id(probe), keys, probe, dest, counts) if skewed else None
+        )
+        return skewed
 
     def _dest_counts_memo(self, sp: ShardedPage, key_syms: list[str]):
         memo = getattr(self, "_dest_memo", None)
+        self._dest_memo = None  # one-shot: never pin device arrays
         if (
             memo is not None
             and memo[0] == id(sp)
@@ -723,7 +745,14 @@ class MeshExecutor(LocalExecutor):
     def _dest_counts(self, sp: ShardedPage, key_syms: list[str]):
         """(dest per row, global per-destination row counts)."""
         cols = [sp.column(k) for k in key_syms]
-        h = K.hash_columns([(c.data, c.valid) for c in cols])
+        pairs = []
+        for c in cols:
+            parts = K.limb_parts(c.data)
+            pairs.extend(
+                (p, c.valid if i == 0 else None)
+                for i, p in enumerate(parts)
+            )
+        h = K.hash_columns(pairs)
         dest = (h % jnp.uint64(self.n_shards)).astype(jnp.int32)
         prog = self._mesh_jit_cache.get("dest-hist")
         if prog is None:
@@ -895,7 +924,10 @@ class MeshExecutor(LocalExecutor):
         p_leaves, p_meta = _page_leaves(probe)
         b_leaves, b_meta = _page_leaves(build)
         n_p = len(p_leaves)
-        verify = len(criteria) > 1
+        p_cols0 = {n: c for n, c in zip(probe.names, probe.columns)}
+        verify = len(criteria) > 1 or any(
+            jnp.ndim(p_cols0[a].data) == 2 for a, _ in criteria
+        )
         p_cols = {n: c for n, c in zip(probe.names, probe.columns)}
         b_cols = {n: c for n, c in zip(build.names, build.columns)}
         prelude = _make_prelude(criteria, p_meta, b_meta, n_p, verify)
@@ -1155,7 +1187,9 @@ class MeshExecutor(LocalExecutor):
         b_leaves, b_meta = _page_leaves(filt)
         n_p = len(p_leaves)
         criteria = list(node.keys)
-        verify = len(criteria) > 1
+        verify = len(criteria) > 1 or any(
+            jnp.ndim(sp.column(a).data) == 2 for a, _ in criteria
+        )
         needs_expand = verify or node.filter is not None
         p_cap = sp.shard_capacity
         in_specs = (PS(axis),) * n_p + (PS(),) * len(b_leaves)

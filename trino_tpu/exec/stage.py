@@ -68,6 +68,10 @@ def _key_width(t: T.DataType, dictionary, value_range=None) -> int:
     (value-range key packing)."""
     if dictionary is not None:
         return max(1, len(dictionary).bit_length())
+    if isinstance(t, T.DecimalType) and t.is_long:
+        raise NotImplementedError(
+            "GROUP BY / DISTINCT on decimal(38) keys"
+        )
     if isinstance(t, T.BooleanType):
         return 1
     dt = np.dtype(t.np_dtype)
@@ -416,9 +420,16 @@ def _sort_step(nd, layout: ChainLayout):
     count = nd.count if is_topn else None
 
     def step(env, mask, flags):
-        sort_keys = [
-            (env[s][0], env[s][1], asc, nf) for s, asc, nf in keys
-        ]
+        sort_keys = []
+        for s, asc, nf in keys:
+            data, valid = env[s]
+            if jnp.ndim(data) == 2:
+                # two-limb decimal: hi is the major key, lo minor
+                # (canonical lo in [0, 2^32) sorts correctly as int64)
+                sort_keys.append((data[:, 0], valid, asc, nf))
+                sort_keys.append((data[:, 1], valid, asc, nf))
+            else:
+                sort_keys.append((data, valid, asc, nf))
         perm = K.sort_perm(sort_keys, mask)
         if limit is not None:
             perm = perm[:limit]
@@ -448,4 +459,5 @@ def _pad_to(arr: jnp.ndarray, capacity: int) -> jnp.ndarray:
     n = arr.shape[0]
     if n >= capacity:
         return arr[:capacity]
-    return jnp.concatenate([arr, jnp.zeros((capacity - n,), dtype=arr.dtype)])
+    pad_shape = (capacity - n,) + arr.shape[1:]  # limb columns are 2D
+    return jnp.concatenate([arr, jnp.zeros(pad_shape, dtype=arr.dtype)])

@@ -202,6 +202,29 @@ def _eval_call(
     if name in ("count", "count_all"):
         return cnt, None
     if name == "sum":
+        if isinstance(call.type, T.DecimalType) and call.type.is_long:
+            # exact limb window sums (frames bounded by the page, so
+            # both 32-bit limb prefix sums stay within int64); the
+            # argument may itself already be a two-limb column (e.g.
+            # sum(sum(decimal)) OVER in windows-over-aggregates)
+            if jnp.ndim(data) == 2:
+                hi_in = jnp.where(contrib, data[:, 0], 0)
+                lo_in = jnp.where(contrib, data[:, 1], 0)
+            else:
+                masked = jnp.where(
+                    contrib, data, jnp.zeros((), dtype=data.dtype)
+                )
+                hi_in = masked >> jnp.int64(32)
+                lo_in = masked & jnp.int64(0xFFFFFFFF)
+            s_hi = _range_sum(hi_in, lo, hi, n)
+            s_lo = _range_sum(lo_in, lo, hi, n)
+            carry = s_lo >> jnp.int64(32)
+            return (
+                jnp.stack(
+                    [s_hi + carry, s_lo & jnp.int64(0xFFFFFFFF)], axis=-1
+                ),
+                cnt > 0,
+            )
         z = jnp.zeros((), dtype=data.dtype)
         s = _range_sum(
             jnp.where(contrib, data, z), lo, hi, n, gid=info.gid_sorted

@@ -134,11 +134,27 @@ class _Compiler:
 
         if isinstance(d_t, T.DoubleType) or isinstance(d_t, T.RealType):
             dtype = d_t.np_dtype
+            if isinstance(s_t, T.DecimalType) and s_t.is_long:
+                # two-limb -> double: hi*2^32 + lo, then unscale
+                # (float64 approximation; exactness lives in the limb
+                # aggregates, not in mixed arithmetic)
+                scale = 10.0 ** s_t.scale
+                return wrap(
+                    lambda x: (
+                        x[..., 0].astype(jnp.float64) * 4294967296.0
+                        + x[..., 1].astype(jnp.float64)
+                    ).astype(dtype) / scale
+                )
             if isinstance(s_t, T.DecimalType):
                 scale = 10.0 ** s_t.scale
                 return wrap(lambda x: x.astype(dtype) / scale)
             return wrap(lambda x: x.astype(dtype))
         if isinstance(d_t, T.DecimalType):
+            if (isinstance(s_t, T.DecimalType) and s_t.is_long) or d_t.is_long:
+                raise NotImplementedError(
+                    f"cast {s_t} -> {d_t}: long-decimal rescaling is "
+                    "not implemented (route through DOUBLE)"
+                )
             if isinstance(s_t, T.DecimalType):
                 if d_t.scale >= s_t.scale:
                     m = 10 ** (d_t.scale - s_t.scale)
@@ -348,6 +364,10 @@ class _Compiler:
         b = self.compile(rhs)
         if isinstance(lhs.type, T.VarcharType) or isinstance(rhs.type, T.VarcharType):
             return self._string_comparison(expr, a, b)
+        a_long = isinstance(lhs.type, T.DecimalType) and lhs.type.is_long
+        b_long = isinstance(rhs.type, T.DecimalType) and rhs.type.is_long
+        if a_long or b_long:
+            return self._limb_comparison(expr, a, b, a_long, b_long)
         if (
             isinstance(lhs.type, T.DecimalType)
             and isinstance(rhs.type, T.DecimalType)
@@ -360,6 +380,57 @@ class _Compiler:
             a_d, a_v = a.fn(env)
             b_d, b_v = b.fn(env)
             return op(a_d, b_d), _and_valid(a_v, b_v)
+
+        return CompiledExpr(ev, T.BOOLEAN)
+
+    def _limb_comparison(
+        self, expr: Call, a: CompiledExpr, b: CompiledExpr,
+        a_long: bool, b_long: bool,
+    ) -> CompiledExpr:
+        """Exact comparison on two-limb decimals: numeric order equals
+        lexicographic (hi, lo) order (lo canonical non-negative). Both
+        sides must share the scale (analyzer coerces mixed-scale long
+        comparisons through DOUBLE)."""
+        if (a_long and b_long) and a.type.scale != b.type.scale:
+            raise NotImplementedError(
+                "mixed-scale long-decimal comparison"
+            )
+        if a_long != b_long:
+            # widen the short side to limbs (same scale required)
+            if a.type.scale != b.type.scale:
+                raise NotImplementedError(
+                    "mixed-scale long/short decimal comparison"
+                )
+        name = expr.name
+
+        def limbs(c, is_long):
+            def get(env):
+                d, v = c.fn(env)
+                if is_long:
+                    return d[..., 0], d[..., 1], v
+                return d >> jnp.int64(32), d & jnp.int64(0xFFFFFFFF), v
+
+            return get
+
+        ga = limbs(a, a_long)
+        gb = limbs(b, b_long)
+
+        def ev(env):
+            ah, al, av = ga(env)
+            bh, bl, bv = gb(env)
+            if name == "eq":
+                out = (ah == bh) & (al == bl)
+            elif name == "ne":
+                out = (ah != bh) | (al != bl)
+            elif name == "lt":
+                out = (ah < bh) | ((ah == bh) & (al < bl))
+            elif name == "le":
+                out = (ah < bh) | ((ah == bh) & (al <= bl))
+            elif name == "gt":
+                out = (ah > bh) | ((ah == bh) & (al > bl))
+            else:  # ge
+                out = (ah > bh) | ((ah == bh) & (al >= bl))
+            return out, _and_valid(av, bv)
 
         return CompiledExpr(ev, T.BOOLEAN)
 

@@ -123,7 +123,12 @@ class Column:
         cap = capacity or pad_capacity(n)
         if type_.is_dictionary and dictionary is None:
             dictionary, values = StringDictionary.from_strings(values)
-        data = np.zeros(cap, dtype=type_.np_dtype)
+        arr = np.asarray(values)
+        if arr.dtype != object and arr.ndim == 2:
+            # two-limb decimal columns are [n, 2]
+            data = np.zeros((cap, arr.shape[1]), dtype=type_.np_dtype)
+        else:
+            data = np.zeros(cap, dtype=type_.np_dtype)
         data[:n] = np.asarray(values, dtype=type_.np_dtype)
         col_valid = None
         if valid is not None:
@@ -257,6 +262,10 @@ def _pyvalue(type_: T.DataType, v):
         # values, so render as a scaled decimal using integer math.
         import decimal
 
+        if type_.is_long:
+            # two-limb reconstruction in python ints: exact
+            unscaled = int(v[0]) * (1 << 32) + int(v[1])
+            return decimal.Decimal(unscaled).scaleb(-type_.scale)
         return decimal.Decimal(int(v)).scaleb(-type_.scale)
     if isinstance(type_, T.DateType):
         return T.format_date(int(v))

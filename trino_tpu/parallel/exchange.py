@@ -63,15 +63,18 @@ def partition_exchange(
 
     out = {}
     for name, arr in payload.items():
+        trailing = arr.shape[1:]  # two-limb decimal columns are [n, 2]
         buckets = jnp.zeros(
-            (n_partitions * bucket_capacity,), dtype=arr.dtype
+            (n_partitions * bucket_capacity,) + trailing, dtype=arr.dtype
         ).at[flat_idx].set(arr, mode="drop")
-        buckets = buckets.reshape(n_partitions, bucket_capacity)
+        buckets = buckets.reshape(
+            (n_partitions, bucket_capacity) + trailing
+        )
         # swap bucket p of this shard with bucket <this> of shard p
         received = jax.lax.all_to_all(
             buckets, axis, split_axis=0, concat_axis=0, tiled=False
         )
-        out[name] = received.reshape(-1)
+        out[name] = received.reshape((-1,) + trailing)
     sent_live = jnp.zeros(
         (n_partitions * bucket_capacity,), dtype=jnp.bool_
     ).at[flat_idx].set(True, mode="drop")

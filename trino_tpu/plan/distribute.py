@@ -300,29 +300,68 @@ def _split_aggregate(node: P.Aggregate) -> tuple[P.Aggregate, P.Aggregate]:
                 "count_final", (InputRef(T.BIGINT, sym),), call.type
             )
         elif name == "sum":
-            partial_aggs[sym] = call
-            final_aggs[sym] = AggCall(
-                "sum", (InputRef(call.type, sym),), call.type
-            )
+            if isinstance(call.type, T.DecimalType) and call.type.is_long:
+                # decimal(38): exact limb states travel as two BIGINTs
+                # (the Int128 partial-state serialization analog)
+                s_hi, s_lo = f"{sym}$hi", f"{sym}$lo"
+                partial_aggs[s_hi] = AggCall(
+                    "sum_hi32", call.args, T.BIGINT, filter=call.filter
+                )
+                partial_aggs[s_lo] = AggCall(
+                    "sum_lo32", call.args, T.BIGINT, filter=call.filter
+                )
+                final_aggs[sym] = AggCall(
+                    "decimal_sum_final",
+                    (InputRef(T.BIGINT, s_hi), InputRef(T.BIGINT, s_lo)),
+                    call.type,
+                )
+            else:
+                partial_aggs[sym] = call
+                final_aggs[sym] = AggCall(
+                    "sum", (InputRef(call.type, sym),), call.type
+                )
         elif name in _SELF_COMBINING:
             partial_aggs[sym] = call
             final_aggs[sym] = AggCall(
                 name, (InputRef(call.type, sym),), call.type
             )
         elif name == "avg":
-            state_t = call.type if isinstance(call.type, T.DecimalType) else T.DOUBLE
-            s_sum, s_cnt = f"{sym}$sum", f"{sym}$cnt"
-            partial_aggs[s_sum] = AggCall(
-                "sum", call.args, state_t, filter=call.filter
-            )
-            partial_aggs[s_cnt] = AggCall(
-                "count", call.args, T.BIGINT, filter=call.filter
-            )
-            final_aggs[sym] = AggCall(
-                "avg_final",
-                (InputRef(state_t, s_sum), InputRef(T.BIGINT, s_cnt)),
-                call.type,
-            )
+            if isinstance(call.type, T.DecimalType):
+                # exact limb states: a plain int64 partial sum would
+                # silently wrap past 2^63 (the local path is limb-exact,
+                # the distributed/chunked path must match)
+                s_hi, s_lo, s_cnt = f"{sym}$hi", f"{sym}$lo", f"{sym}$cnt"
+                partial_aggs[s_hi] = AggCall(
+                    "sum_hi32", call.args, T.BIGINT, filter=call.filter
+                )
+                partial_aggs[s_lo] = AggCall(
+                    "sum_lo32", call.args, T.BIGINT, filter=call.filter
+                )
+                partial_aggs[s_cnt] = AggCall(
+                    "count", call.args, T.BIGINT, filter=call.filter
+                )
+                final_aggs[sym] = AggCall(
+                    "decimal_avg_final",
+                    (
+                        InputRef(T.BIGINT, s_hi),
+                        InputRef(T.BIGINT, s_lo),
+                        InputRef(T.BIGINT, s_cnt),
+                    ),
+                    call.type,
+                )
+            else:
+                s_sum, s_cnt = f"{sym}$sum", f"{sym}$cnt"
+                partial_aggs[s_sum] = AggCall(
+                    "sum", call.args, T.DOUBLE, filter=call.filter
+                )
+                partial_aggs[s_cnt] = AggCall(
+                    "count", call.args, T.BIGINT, filter=call.filter
+                )
+                final_aggs[sym] = AggCall(
+                    "avg_final",
+                    (InputRef(T.DOUBLE, s_sum), InputRef(T.BIGINT, s_cnt)),
+                    call.type,
+                )
         elif name in VARIANCE_FNS:
             xd = Cast(T.DOUBLE, call.args[0])
             xx = Call(T.DOUBLE, "multiply", (xd, xd))

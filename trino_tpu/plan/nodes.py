@@ -19,7 +19,7 @@ from trino_tpu.expr.ir import AggCall, RowExpression
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "Join",
     "SemiJoin", "Sort", "TopN", "Limit", "Output", "Values", "Exchange",
-    "SortKey", "Window", "WindowCall", "Union",
+    "SortKey", "Window", "WindowCall", "Union", "Unnest",
 ]
 
 
@@ -165,6 +165,24 @@ class Window(PlanNode):
     order_keys: list[SortKey] = field(default_factory=list)
     #: output symbol -> window call (args are symbols of source)
     functions: dict[str, WindowCall] = field(default_factory=dict)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class Unnest(PlanNode):
+    """Expand ARRAY constructors into rows (UnnestOperator analog,
+    MAIN/operator/unnest/UnnestOperator.java). Each entry of ``arrays``
+    is one ARRAY[...] argument's element expressions (over source
+    symbols); multiple arrays zip, shorter ones NULL-pad (Trino
+    semantics). The fan-out is static (len of the longest array), so
+    the expansion is one fixed-shape reshape — the TPU-native form."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    arrays: list[tuple] = field(default_factory=list)
+    element_symbols: list[str] = field(default_factory=list)
 
     @property
     def sources(self):

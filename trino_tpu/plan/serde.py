@@ -178,6 +178,13 @@ def plan_to_json(node: P.PlanNode) -> dict:
             symbol_map=[[s, list(v)] for s, v in node.symbol_map.items()],
         )
         return d
+    if isinstance(node, P.Unnest):
+        d.update(
+            source=plan_to_json(node.source),
+            arrays=[[_expr(e) for e in a] for a in node.arrays],
+            element_symbols=list(node.element_symbols),
+        )
+        return d
     if isinstance(node, (P.Sort, P.TopN)):
         d.update(source=plan_to_json(node.source), keys=_sort_keys(node.keys))
         if isinstance(node, P.TopN):
@@ -278,6 +285,14 @@ def plan_from_json(d: dict) -> P.PlanNode:
             outputs,
             all_sources=[plan_from_json(s) for s in d["all_sources"]],
             symbol_map={s: list(v) for s, v in d["symbol_map"]},
+        )
+    if kind == "Unnest":
+        return P.Unnest(
+            outputs, source=plan_from_json(d["source"]),
+            arrays=[
+                tuple(_expr_back(e) for e in a) for a in d["arrays"]
+            ],
+            element_symbols=list(d["element_symbols"]),
         )
     if kind == "Sort":
         return P.Sort(

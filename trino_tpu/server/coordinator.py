@@ -68,6 +68,13 @@ class Coordinator:
         self._seq = 0
         #: finished queries stay fetchable at least this long
         self.history_grace_s = 60.0
+        # system.runtime tables over live coordinator state
+        # (MAIN/connector/system/ analog)
+        from trino_tpu.connectors.system import SystemConnector
+
+        self.runner.metadata.register_catalog(
+            "system", SystemConnector(coordinator=self, runner=self.runner)
+        )
         coordinator = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -113,15 +113,32 @@ class WorkerServer:
 
     def submit(self, req: dict) -> _Task:
         task = _Task(uuid.uuid4().hex[:12])
-        self._tasks[task.task_id] = task
+        with self._lock:
+            self._tasks[task.task_id] = task
+            if len(self._tasks) > 200:
+                # bounded history: results are large; evict oldest done
+                done = [
+                    k for k, t in self._tasks.items()
+                    if t.state in ("FINISHED", "FAILED")
+                ]
+                for k in done[: len(self._tasks) - 200]:
+                    del self._tasks[k]
 
         def run():
             try:
                 plan = plan_from_json(req["plan"])
-                for k, v in (req.get("session") or {}).items():
-                    self.runner.session.properties[k] = v
                 with self.runner._lock:
-                    page = self.runner.executor.execute(plan)
+                    # session overrides apply under the execute lock and
+                    # restore afterwards: concurrent tasks must not see
+                    # (or inherit) each other's settings
+                    saved = dict(self.runner.session.properties)
+                    self.runner.session.properties.update(
+                        req.get("session") or {}
+                    )
+                    try:
+                        page = self.runner.executor.execute(plan)
+                    finally:
+                        self.runner.session.properties = saved
                 task.names, task.rows = _page_json(plan, page)
                 task.state = "FINISHED"
             except Exception as e:

@@ -234,6 +234,17 @@ class JoinRel(Relation):
     using: Optional[list[str]] = None
 
 
+@dataclass
+class UnnestRel(Relation):
+    """UNNEST(array[...] [, array[...]]*) AS alias(c1, ...) — each arg
+    is the element-expression list of one ARRAY[...] constructor
+    (multiple args zip, Trino semantics)."""
+
+    args: list[list[Expr]]
+    alias: Optional[str] = None
+    column_aliases: Optional[list[str]] = None
+
+
 # ---- query structure -----------------------------------------------------
 
 @dataclass

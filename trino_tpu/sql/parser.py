@@ -350,6 +350,28 @@ class _Parser:
                 raise SqlSyntaxError("JOIN requires ON or USING")
 
     def relation_primary(self) -> ast.Relation:
+        # UNNEST is a soft keyword (a table may be named "unnest")
+        if (
+            self.peek().kind == "IDENT"
+            and self.peek().text == "unnest"
+            and self.peek(1).text == "("
+        ):
+            self.next()
+            self.expect_op("(")
+            args = [self._array_constructor()]
+            while self.accept_op(","):
+                args.append(self._array_constructor())
+            self.expect_op(")")
+            alias = None
+            cols = None
+            if self.accept_kw("as") or self.peek().kind == "IDENT":
+                alias = self.ident()
+                if self.accept_op("("):
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+            return ast.UnnestRel(args, alias, cols)
         if self.accept_op("("):
             if self.at_kw("select", "with") or self.at_op("("):
                 q = self.query()
@@ -362,6 +384,21 @@ class _Parser:
         parts = self.qualified_name()
         alias = self._relation_alias()
         return ast.TableRef(parts, alias)
+
+    def _array_constructor(self) -> list[ast.Expr]:
+        """ARRAY[e1, e2, ...] — the element expressions."""
+        t = self.peek()
+        if not (t.kind == "IDENT" and t.text == "array"):
+            raise SqlSyntaxError(
+                "UNNEST argument must be an ARRAY[...] constructor"
+            )
+        self.next()
+        self.expect_op("[")
+        items = [self.expr()]
+        while self.accept_op(","):
+            items.append(self.expr())
+        self.expect_op("]")
+        return items
 
     def _relation_alias(self) -> str | None:
         if self.accept_kw("as"):

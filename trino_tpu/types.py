@@ -184,11 +184,15 @@ class RealType(DataType):
 
 @dataclass(frozen=True, eq=False, repr=False)
 class DecimalType(DataType):
-    """Short decimal: unscaled int64 value, precision <= 18.
+    """Decimal with an unscaled integer representation.
 
-    The reference's long decimal (Int128, SPI/type/Int128.java) is
-    planned as a two-lane int64 representation; until then precision is
-    capped at 18 and arithmetic widens/rescales within int64.
+    precision <= 18 ("short"): one int64 per value. precision 19..38
+    ("long", the reference's Int128 analog, SPI/spi/type/Int128.java):
+    TWO int64 limbs per value — column data has shape [capacity, 2]
+    with value = hi * 2^32 + lo (hi signed, lo in [0, 2^32)). Long
+    decimals exist primarily as exact aggregate results (sum over
+    short-decimal columns); arithmetic stays in the limb domain only
+    where implemented (sum/avg/order-by/output).
     """
 
     precision: int = 18
@@ -197,10 +201,14 @@ class DecimalType(DataType):
     np_dtype = np.dtype(np.int64)
 
     def __post_init__(self):
-        if not (0 < self.precision <= 18):
+        if not (0 < self.precision <= 38):
             raise ValueError(f"unsupported decimal precision {self.precision}")
         if not (0 <= self.scale <= self.precision):
             raise ValueError(f"bad decimal scale {self.scale}")
+
+    @property
+    def is_long(self) -> bool:
+        return self.precision > 18
 
     @property
     def name(self) -> str:  # type: ignore[override]
@@ -314,6 +322,14 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
     order = {"tinyint": 0, "smallint": 1, "integer": 2, "bigint": 3}
     if a.is_integer and b.is_integer:
         return a if order[a.name] >= order[b.name] else b
+    # long (two-limb) decimals coerce through DOUBLE for mixed-type
+    # expressions: limb arithmetic exists only where exactness is the
+    # contract (sum/avg); everything else takes the numeric-approx path
+    # (which also matches the sqlite oracle's REAL behavior)
+    if (isinstance(a, DecimalType) and a.is_long and b.is_numeric) or (
+        isinstance(b, DecimalType) and b.is_long and a.is_numeric
+    ):
+        return DOUBLE
     if isinstance(a, DecimalType) and b.is_integer:
         return _decimal_int_super(a)
     if isinstance(b, DecimalType) and a.is_integer:
