@@ -184,3 +184,30 @@ def test_decimal38_order_by_and_compare():
         "having sum(v) >= 91000000000000005.00 order by g"
     ).rows
     assert [g for (g,) in res2] == [5, 6, 7, 8]
+
+
+def test_decimal38_reaggregation():
+    """sum/avg over an already-limb decimal(38) column (re-aggregating
+    a subquery's sums) must stay exact."""
+    from decimal import Decimal
+
+    r = _mem_runner()
+    r.execute("create table t (g bigint, v decimal(18,2))")
+    big = Decimal("91000000000000000.25")
+    rows = ", ".join(f"({i % 4}, {big})" for i in range(40))
+    r.execute(f"insert into t values {rows}")
+    (got,) = r.execute(
+        "select sum(s) from (select g, sum(v) s from t group by g) u"
+    ).rows[0]
+    assert got == big * 40
+
+
+def test_inner_join_unnest_applies_on():
+    from trino_tpu.engine import QueryRunner
+
+    r = QueryRunner.tpch("tiny")
+    (n,) = r.execute(
+        "select count(*) from nation inner join "
+        "unnest(array[1, 2]) as u(x) on n_nationkey = x"
+    ).rows[0]
+    assert n == 2  # the ON predicate must filter the expansion

@@ -288,3 +288,17 @@ def test_outer_join_does_not_narrow_exact_bounds():
         "group by t1.k"
     ).rows)
     assert got == [(i * 1000, 1) for i in range(20)]
+
+
+def test_distinct_agg_dedupes_before_exchange():
+    """Distributed DISTINCT aggregation inserts a shard-local dedupe so
+    the exchange carries at most NDV rows, not the raw data."""
+    plan, _ = _mesh_plan(
+        "select l_orderkey, count(distinct l_suppkey) from lineitem "
+        "group by l_orderkey"
+    )
+    ex = _find(plan, P.Exchange)
+    hash_ex = [e for e in ex if e.partitioning == "hash"]
+    assert hash_ex
+    assert isinstance(hash_ex[0].source, P.Aggregate)
+    assert hash_ex[0].source.aggregates == {}  # pure dedupe

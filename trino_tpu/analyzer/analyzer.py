@@ -500,7 +500,20 @@ class Analyzer:
                 raise AnalysisError(
                     f"{rel.kind} join with UNNEST is not supported"
                 )
-            return self._plan_unnest(rel.right, left)
+            if rel.using:
+                raise AnalysisError("JOIN UNNEST does not support USING")
+            combined = self._plan_unnest(rel.right, left)
+            if rel.on is not None:
+                # the ON predicate filters the expanded rows (silently
+                # dropping it would return the raw cross product)
+                ea = ExprAnalyzer(self, combined.scope)
+                ir = ea.analyze(rel.on)
+                node = P.Filter(
+                    dict(combined.node.outputs),
+                    source=combined.node, predicate=ir,
+                )
+                return RelationPlan(node, combined.scope)
+            return combined
         right = self.plan_relation(rel.right, outer, ctes)
         combined = self._cross_join(left, right)
         if rel.kind == "cross":

@@ -252,10 +252,39 @@ def _walk_aggregate(node: P.Aggregate, ctx: _Ctx) -> tuple[P.PlanNode, str]:
         return dc_replace(node, source=src), "single"
 
     if any(c.distinct for c in node.aggregates.values()):
-        # DISTINCT needs every row of a group on one shard: route raw
-        # rows by group-key hash, then aggregate in one step
-        # (MarkDistinct-over-repartitioned-input analog)
+        # DISTINCT needs every row of a group on one shard. Instead of
+        # exchanging RAW rows (O(data) shuffle), dedupe per shard first
+        # when every distinct argument is a plain column: a shard-local
+        # group-by over (group keys + distinct args) collapses
+        # duplicates, so the exchange carries at most NDV rows per
+        # shard (the MarkDistinct-before-exchange analog; VERDICT
+        # flagged the raw-row route as a full-data shuffle).
+        distinct_syms = []
+        simple = True
+        for c in node.aggregates.values():
+            if not c.distinct:
+                continue
+            for a in c.args:
+                if isinstance(a, InputRef):
+                    distinct_syms.append(a.name)
+                else:
+                    simple = False
         if node.group_keys:
+            if simple and distinct_syms:
+                dedupe_keys = list(dict.fromkeys(
+                    list(node.group_keys) + distinct_syms
+                ))
+                if set(dedupe_keys) == set(src.outputs) and not any(
+                    not c.distinct for c in node.aggregates.values()
+                ):
+                    # only safe when NO aggregate needs the raw rows
+                    # (a non-distinct agg alongside would lose rows)
+                    pre = P.Aggregate(
+                        dict(src.outputs), source=src,
+                        group_keys=dedupe_keys, aggregates={},
+                        step="PARTIAL",
+                    )
+                    src = pre
             ex = P.Exchange(
                 dict(src.outputs), source=src, partitioning="hash",
                 hash_symbols=list(node.group_keys),
