@@ -106,7 +106,7 @@ class QueryRunner:
         if optimized:
             from trino_tpu.plan.stats import annotate
 
-            plan = annotate(plan, self.metadata)
+            plan = annotate(plan, self.metadata, self.session)
         return plan
 
     def plan_sql(self, sql: str, optimized: bool = True) -> P.PlanNode:

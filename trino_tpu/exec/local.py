@@ -29,6 +29,30 @@ from trino_tpu.plan import nodes as P
 __all__ = ["LocalExecutor", "QueryCancelled"]
 
 
+def _hash_varchar_column(t, values, valid, capacity) -> Column:
+    """Build a hash-coded varchar column: [hash64, source_row_id]
+    lanes + a host string pool (one hash pass + a one-time injectivity
+    proof — no sorted-dictionary build). On the astronomically rare
+    hash collision, fall back to dictionary coding."""
+    from trino_tpu.page import HashCollision, HashStringPool
+
+    pool = HashStringPool(values)
+    try:
+        pool.verify_injective()
+    except HashCollision:
+        return Column.from_numpy(t, values, valid=valid, capacity=capacity)
+    n = len(values)
+    data = np.zeros((capacity, 2), dtype=np.int64)
+    data[:n, 0] = pool.hashes()
+    data[:n, 1] = np.arange(n)
+    col_valid = None
+    if valid is not None:
+        v = np.zeros(capacity, dtype=np.bool_)
+        v[:n] = valid
+        col_valid = jnp.asarray(v)
+    return Column(t, jnp.asarray(data), col_valid, None, pool)
+
+
 class QueryCancelled(RuntimeError):
     """Raised inside the executor when the query's cancel event fires
     (cooperative cancellation: in-flight device dispatches finish, the
@@ -244,6 +268,11 @@ class LocalExecutor:
                         for n, c in zip(page.names, page.columns)
                     },
                     capacity=page.capacity,
+                    pools={
+                        n: c.hash_pool
+                        for n, c in zip(page.names, page.columns)
+                        if c.hash_pool is not None
+                    },
                 )
                 fn, out_layout = stage.build_chain(chain, in_layout, caps)
 
@@ -279,6 +308,7 @@ class LocalExecutor:
                     env[s][0],
                     env[s][1],
                     out_layout.dicts.get(s),
+                    out_layout.pools.get(s),
                 )
                 for s in out_layout.names
             ]
@@ -322,7 +352,11 @@ class LocalExecutor:
 
     def _layout_sig(self, page: Page) -> tuple:
         return tuple(
-            (n, repr(c.type), id(c.dictionary), c.valid is not None)
+            (
+                n, repr(c.type), id(c.dictionary),
+                None if c.hash_pool is None else c.hash_pool.token,
+                c.valid is not None,
+            )
             for n, c in zip(page.names, page.columns)
         ) + (page.capacity,)
 
@@ -350,15 +384,26 @@ class LocalExecutor:
             cache = {}  # live views (system tables) re-scan per query
         else:
             cache = self._scan_cache.setdefault(key, {})
-        missing = [c for c in node.assignments.values() if c not in cache]
+        hashed_syms = set(node.hash_varchar or [])
+        # hash-coded and dictionary-coded variants of a column cache
+        # under distinct keys (a symbol's encoding is plan-dependent)
+        def ckey(sym, cname):
+            return f"#hash:{cname}" if sym in hashed_syms else cname
+
+        missing = [
+            (s, c) for s, c in node.assignments.items()
+            if ckey(s, c) not in cache
+        ]
         if missing or "" not in cache:
             connector = self.metadata.connector(node.catalog)
-            cols = connector.scan(node.schema, node.table, missing)
+            cols = connector.scan(
+                node.schema, node.table, [c for _, c in missing]
+            )
             if missing:
                 # row count from the scanned arrays themselves: a
                 # second row_count() call could see a DIFFERENT
                 # snapshot on live views (system tables)
-                first = cols[missing[0]]
+                first = cols[missing[0][1]]
                 n = len(first[0] if isinstance(first, tuple) else first)
             else:
                 n = connector.row_count(node.schema, node.table)
@@ -367,19 +412,25 @@ class LocalExecutor:
                 mask = np.zeros(cap, dtype=np.bool_)
                 mask[:n] = True
                 cache[""] = jnp.asarray(mask)
-            by_col = {c: s for s, c in node.assignments.items()}
-            for cname in missing:
+            for sym, cname in missing:
                 v = cols[cname]
                 valid = None
                 if isinstance(v, tuple):
                     v, valid = v
-                cache[cname] = Column.from_numpy(
-                    node.outputs[by_col[cname]], v, valid=valid,
-                    capacity=cap,
-                )
+                if sym in hashed_syms:
+                    cache[ckey(sym, cname)] = _hash_varchar_column(
+                        node.outputs[sym], np.asarray(v, dtype=object),
+                        valid, cap,
+                    )
+                else:
+                    cache[cname] = Column.from_numpy(
+                        node.outputs[sym], v, valid=valid, capacity=cap,
+                    )
             cache["#rows"] = n
         names = list(node.assignments)
-        columns = [cache[c] for c in node.assignments.values()]
+        columns = [
+            cache[ckey(s, c)] for s, c in node.assignments.items()
+        ]
         return Page(
             names, columns, cache[""],
             known_rows=cache["#rows"], packed=True,
@@ -441,7 +492,7 @@ class LocalExecutor:
             self._jit_cache[key] = fn
         env2, mask2 = fn(self._env(page), page.mask)
         cols = [
-            Column(c.type, *env2[s], c.dictionary)
+            Column(c.type, *env2[s], c.dictionary, c.hash_pool)
             for s, c in zip(page.names, page.columns)
         ]
         out = Page(list(page.names), cols, mask2)
@@ -599,7 +650,7 @@ class LocalExecutor:
         for page in (left, right):
             for nm, c in zip(page.names, page.columns):
                 names.append(nm)
-                cols.append(Column(c.type, *env2[nm], c.dictionary))
+                cols.append(Column(c.type, *env2[nm], c.dictionary, c.hash_pool))
         out = Page(names, cols, mask)
         out.known_rows = n_l * n_r
         out.packed = True
@@ -607,34 +658,66 @@ class LocalExecutor:
 
     def _unify_join_dicts(self, probe: Page, build: Page, criteria):
         """Remap VARCHAR key pairs onto shared dictionaries (host-side
-        dictionary union + one device gather per remapped column)."""
+        dictionary union + one device gather per remapped column).
+        Hash-coded pairs skip remapping entirely — hash codes are
+        globally consistent — but must pass the cross-pool injectivity
+        proof so hash equality implies string equality."""
         for lsym, rsym in criteria:
             pc, bc = probe.column(lsym), build.column(rsym)
+            if pc.hash_pool is not None and bc.hash_pool is not None:
+                pc.hash_pool.verify_joinable(bc.hash_pool)
+                continue
             if pc.dictionary is not None or bc.dictionary is not None:
                 pc2, bc2 = unify_dictionaries(pc, bc)
                 probe.columns[probe.names.index(lsym)] = pc2
                 build.columns[build.names.index(rsym)] = bc2
 
     @staticmethod
-    def _traced_join_keys(penv, benv, criteria):
+    def _join_key_kinds(probe, build, criteria):
+        """Static per-criterion key kind from the page columns:
+        'hash' = hash-coded varchar (key is the hash lane alone; the id
+        lane is row identity), 'auto' = plain/limb columns."""
+        kinds = []
+        for l, r in criteria:
+            pc = probe.column(l)
+            bc = build.column(r)
+            if pc.hash_pool is not None or bc.hash_pool is not None:
+                if pc.hash_pool is None or bc.hash_pool is None:
+                    raise NotImplementedError(
+                        "join between hash-coded and dictionary-coded "
+                        "varchar (the planner co-encodes join pairs)"
+                    )
+                kinds.append("hash")
+            else:
+                kinds.append("auto")
+        return kinds
+
+    @staticmethod
+    def _traced_join_keys(penv, benv, criteria, kinds=None):
         """Combined uint64 keys for probe/build sides from traced envs.
 
         Single fixed-width key -> exact; multi-column (including
         two-limb decimal keys, which expand into hi/lo parts) ->
         hash-combined and ``verify`` is True (matches re-checked after
-        expansion). The returned ``pairs`` are 1D (probe, build) part
+        expansion). Hash-coded varchar keys ('hash' kind) contribute
+        their hash lane only — the id lane is row identity, not value
+        identity. The returned ``pairs`` are 1D (probe, build) part
         arrays for the verification loop.
         """
         pv = bv = None
         p_parts: list = []
         b_parts: list = []
-        for l, r in criteria:
+        for i, (l, r) in enumerate(criteria):
             pd, pvd = penv[l]
             bd, bvd = benv[r]
             pv = _and_mask(pv, pvd)
             bv = _and_mask(bv, bvd)
-            p_parts.extend(K.limb_parts(pd))
-            b_parts.extend(K.limb_parts(bd))
+            if kinds is not None and kinds[i] == "hash":
+                p_parts.append(pd[:, 0])
+                b_parts.append(bd[:, 0])
+            else:
+                p_parts.extend(K.limb_parts(pd))
+                b_parts.extend(K.limb_parts(bd))
         if len(p_parts) == 1:
             pk, _ = K.normalize_key(p_parts[0], None)
             bk, _ = K.normalize_key(b_parts[0], None)
@@ -658,10 +741,11 @@ class LocalExecutor:
         fn = self._jit_cache.get(key)
         if fn is None:
             crit = list(criteria)
+            kinds = self._join_key_kinds(probe, build, crit)
 
             def fa(penv, pmask, benv, bmask):
                 pk, bk, pv, bv, _, _ = self._traced_join_keys(
-                    penv, benv, crit
+                    penv, benv, crit, kinds
                 )
                 probe_live = pmask if pv is None else (pmask & pv)
                 build_live = bmask if bv is None else (bmask & bv)
@@ -697,6 +781,10 @@ class LocalExecutor:
             pc, bc = probe.column(ls), build.column(rs)
             if pc.dictionary is not None or bc.dictionary is not None:
                 continue
+            if pc.hash_pool is not None or bc.hash_pool is not None:
+                continue  # hashes carry no order; min/max cannot prune
+            if jnp.ndim(pc.data) != 1:
+                continue  # two-limb columns have no 1D order domain
             if np.dtype(pc.data.dtype).kind != "i":
                 continue
             pairs.append((ls, rs))
@@ -795,7 +883,7 @@ class LocalExecutor:
             order, lo, cnt,
         )
         cols = [
-            Column(t, *env2[s], d) for s, _fp, t, d in out_meta
+            Column(t, *env2[s], d, hp) for s, _fp, t, d, hp in out_meta
         ]
         out = Page([s for s, *_ in out_meta], cols, mask2)
         if (
@@ -818,11 +906,13 @@ class LocalExecutor:
         criteria = list(node.criteria)
         kind = node.kind
         p_cap, b_cap = probe.capacity, build.capacity
-        out_meta = []  # (sym, from_probe, type, dictionary)
+        out_meta = []  # (sym, from_probe, type, dictionary, hash_pool)
         for sym in node.outputs:
             from_probe = sym in probe.names
             c = (probe if from_probe else build).column(sym)
-            out_meta.append((sym, from_probe, c.type, c.dictionary))
+            out_meta.append(
+                (sym, from_probe, c.type, c.dictionary, c.hash_pool)
+            )
         filter_c = None
         fsyms: list[str] = []
         if node.filter is not None:
@@ -830,9 +920,11 @@ class LocalExecutor:
             fsyms = sorted(_expr_symbols(node.filter))
         probe_names = set(probe.names)
 
+        kinds = self._join_key_kinds(probe, build, criteria)
+
         def fb(penv, pmask, benv, bmask, order, lo, cnt):
             pk, bk, pv, bv, pairs, verify = self._traced_join_keys(
-                penv, benv, criteria
+                penv, benv, criteria, kinds
             )
             probe_idx, build_idx, out_live = K.expand_matches(
                 order, lo, cnt, out_cap
@@ -843,7 +935,7 @@ class LocalExecutor:
                     bb, _ = K.normalize_key(bd, None)
                     out_live = out_live & (pb[probe_idx] == bb[build_idx])
             inner = {}
-            for sym, from_probe, _t, _d in out_meta:
+            for sym, from_probe, _t, _d, _hp in out_meta:
                 d, v = (penv if from_probe else benv)[sym]
                 idx = probe_idx if from_probe else build_idx
                 inner[sym] = (d[idx], None if v is None else v[idx])
@@ -859,7 +951,7 @@ class LocalExecutor:
             if kind in ("left", "full"):
                 matched = K.range_any(cnt, out_live)
                 unmatched = pmask & ~matched
-                for sym, from_probe, _t, _d in out_meta:
+                for sym, from_probe, _t, _d, _hp in out_meta:
                     if from_probe:
                         sections[sym].append(penv[sym])
                     else:
@@ -872,7 +964,7 @@ class LocalExecutor:
             if kind == "full":
                 bmatched = K.scatter_any(build_idx, out_live, b_cap)
                 bunmatched = bmask & ~bmatched
-                for sym, from_probe, _t, _d in out_meta:
+                for sym, from_probe, _t, _d, _hp in out_meta:
                     if from_probe:
                         d0, _ = penv[sym]
                         sections[sym].append((
@@ -902,9 +994,11 @@ class LocalExecutor:
             fsyms = sorted(_expr_symbols(node.filter))
         probe_names = set(source.names)
 
+        kinds = self._join_key_kinds(source, filt, criteria)
+
         def fb(penv, benv, order, lo, cnt):
             pk, bk, pv, bv, pairs, _verify = self._traced_join_keys(
-                penv, benv, criteria
+                penv, benv, criteria, kinds
             )
             probe_idx, build_idx, out_live = K.expand_matches(
                 order, lo, cnt, out_cap
@@ -1037,7 +1131,7 @@ class LocalExecutor:
         names, cols = [], []
         for nm, c in zip(page.names, page.columns):
             names.append(nm)
-            cols.append(Column(c.type, *env2[nm], c.dictionary))
+            cols.append(Column(c.type, *env2[nm], c.dictionary, c.hash_pool))
         for sym, d in zip(node.element_symbols, elem_dicts):
             names.append(sym)
             cols.append(Column(node.outputs[sym], *env2[sym], d))
@@ -1168,10 +1262,11 @@ class LocalExecutor:
             fn = self._jit_cache.get(key)
             if fn is None:
                 crit = list(node.keys)
+                kinds = self._join_key_kinds(source, filt, crit)
 
                 def fa(penv, pmask, benv, bmask):
                     pk, bk, pv2, bv2, _, _ = self._traced_join_keys(
-                        penv, benv, crit
+                        penv, benv, crit, kinds
                     )
                     probe_live = pmask if pv2 is None else (pmask & pv2)
                     build_live = bmask if bv2 is None else (bmask & bv2)
@@ -1327,7 +1422,7 @@ def _concat_pages(pages: list[Page]) -> Page:
             ])
         else:
             valid = None
-        cols.append(Column(c.type, data, valid, c.dictionary))
+        cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
     mask = jnp.concatenate([p.mask for p in pages])
     return Page(list(first.names), cols, mask)
 

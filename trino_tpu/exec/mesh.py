@@ -74,6 +74,24 @@ class ShardedPage:
         return self.columns[self.names.index(name)]
 
 
+def _exchange_key_pairs(cols):
+    """(bits, valid) pairs for exchange-key hashing: hash-coded varchar
+    contributes its hash lane only (the id lane is row identity and
+    would split equal strings across destinations); two-limb decimals
+    contribute both limbs."""
+    pairs = []
+    for c in cols:
+        if c.hash_pool is not None:
+            pairs.append((c.data[:, 0], c.valid))
+            continue
+        parts = K.limb_parts(c.data)
+        pairs.extend(
+            (p, c.valid if i == 0 else None)
+            for i, p in enumerate(parts)
+        )
+    return pairs
+
+
 def _page_leaves(page) -> tuple[list, list[tuple[str, bool]]]:
     """Flatten a (Sharded)Page into [data, valid?...] leaves + mask."""
     leaves, meta = [], []
@@ -99,23 +117,29 @@ def _env_from_leaves(leaves, meta):
     return env, leaves[i]
 
 
-def _make_prelude(criteria, p_meta, b_meta, n_p, verify):
+def _make_prelude(criteria, p_meta, b_meta, n_p, verify, kinds=None):
     """Shared shard-local join-key builder for equi and semi joins:
     splits the flat leaves back into probe/build envs and produces
-    normalized key bits, combined keys, and 3VL-aware live masks."""
+    normalized key bits, combined keys, and 3VL-aware live masks.
+    ``kinds[i] == 'hash'`` marks hash-coded varchar criteria (key =
+    hash lane only)."""
 
     def prelude(ls):
         p_env, p_mask = _env_from_leaves(list(ls[:n_p]), p_meta)
         b_env, b_mask = _env_from_leaves(list(ls[n_p:]), b_meta)
         pv = bv = None
         p_bits, b_bits = [], []
-        for lsym, rsym in criteria:
+        for i, (lsym, rsym) in enumerate(criteria):
             pd, pvx = p_env[lsym]
             bd, bvx = b_env[rsym]
             if pvx is not None:
                 pv = pvx if pv is None else (pv & pvx)
             if bvx is not None:
                 bv = bvx if bv is None else (bv & bvx)
+            if kinds is not None and kinds[i] == "hash":
+                p_bits.append(K.normalize_key(pd[:, 0], None)[0])
+                b_bits.append(K.normalize_key(bd[:, 0], None)[0])
+                continue
             # two-limb decimal keys expand into hi/lo parts
             for part in K.limb_parts(pd):
                 p_bits.append(K.normalize_key(part, None)[0])
@@ -243,12 +267,22 @@ class MeshExecutor(LocalExecutor):
             cache = {}  # live views re-scan per query
         else:
             cache = self._dist_scan_cache.setdefault(key, {})
-        missing = [c for c in node.assignments.values() if c not in cache]
+        hashed_syms = set(node.hash_varchar or [])
+
+        def ckey(sym, cname):
+            return f"#hash:{cname}" if sym in hashed_syms else cname
+
+        missing = [
+            (sym, c) for sym, c in node.assignments.items()
+            if ckey(sym, c) not in cache
+        ]
         if missing or "" not in cache:
             connector = self.metadata.connector(node.catalog)
-            cols = connector.scan(node.schema, node.table, missing)
+            cols = connector.scan(
+                node.schema, node.table, [c for _, c in missing]
+            )
             if missing:
-                first = cols[missing[0]]
+                first = cols[missing[0][1]]
                 n = len(first[0] if isinstance(first, tuple) else first)
             else:
                 n = connector.row_count(node.schema, node.table)
@@ -257,17 +291,26 @@ class MeshExecutor(LocalExecutor):
                 cache[""] = self._shard_split(
                     np.ones(n, dtype=np.bool_), n, per, cap
                 )
-            by_col = {c: s for s, c in node.assignments.items()}
-            for cname in missing:
+            for sym, cname in missing:
                 v = cols[cname]
                 valid = None
                 if isinstance(v, tuple):
                     v, valid = v
-                col = Column.from_numpy(
-                    node.outputs[by_col[cname]], v, valid=valid,
-                    capacity=max(n, 1),
-                )
-                cache[cname] = Column(
+                if sym in hashed_syms:
+                    from trino_tpu.exec.local import _hash_varchar_column
+
+                    # global pool + global row ids: the id lane stays
+                    # meaningful on every shard (pools are host-side)
+                    col = _hash_varchar_column(
+                        node.outputs[sym], np.asarray(v, dtype=object),
+                        valid, max(n, 1),
+                    )
+                else:
+                    col = Column.from_numpy(
+                        node.outputs[sym], v, valid=valid,
+                        capacity=max(n, 1),
+                    )
+                cache[ckey(sym, cname)] = Column(
                     col.type,
                     self._shard_split(
                         np.asarray(col.data[:n]), n, per, cap
@@ -276,9 +319,12 @@ class MeshExecutor(LocalExecutor):
                         np.asarray(col.valid[:n]), n, per, cap
                     ),
                     col.dictionary,
+                    col.hash_pool,
                 )
         names = list(node.assignments)
-        columns = [cache[c] for c in node.assignments.values()]
+        columns = [
+            cache[ckey(s, c)] for s, c in node.assignments.items()
+        ]
         return ShardedPage(names, columns, cache[""], self.n_shards)
 
     def gather(self, sp: ShardedPage) -> Page:
@@ -297,7 +343,7 @@ class MeshExecutor(LocalExecutor):
                 v = np.zeros(cap, dtype=np.bool_)
                 v[: len(idx)] = np.asarray(c.valid)[idx]
                 valid = jnp.asarray(v)
-            cols.append(Column(c.type, jnp.asarray(data), valid, c.dictionary))
+            cols.append(Column(c.type, jnp.asarray(data), valid, c.dictionary, c.hash_pool))
         out_mask = np.zeros(cap, dtype=np.bool_)
         out_mask[: len(idx)] = True
         return Page(
@@ -323,6 +369,7 @@ class MeshExecutor(LocalExecutor):
                     self._shard_split(np.asarray(c.data)[idx], n, per, cap),
                     valid,
                     c.dictionary,
+                    c.hash_pool,
                 )
             )
         mask = self._shard_split(np.ones(n, dtype=np.bool_), n, per, cap)
@@ -339,7 +386,11 @@ class MeshExecutor(LocalExecutor):
 
     def _sharded_sig(self, sp: ShardedPage) -> tuple:
         return tuple(
-            (n, repr(c.type), id(c.dictionary), c.valid is not None)
+            (
+                n, repr(c.type), id(c.dictionary),
+                None if c.hash_pool is None else c.hash_pool.token,
+                c.valid is not None,
+            )
             for n, c in zip(sp.names, sp.columns)
         ) + (sp.shard_capacity, self.n_shards)
 
@@ -369,6 +420,11 @@ class MeshExecutor(LocalExecutor):
                         for n, c in zip(sp.names, sp.columns)
                     },
                     capacity=shard_cap,
+                    pools={
+                        n: c.hash_pool
+                        for n, c in zip(sp.names, sp.columns)
+                        if c.hash_pool is not None
+                    },
                 )
                 fn, out_layout = stage.build_chain(chain, in_layout, caps)
                 leaves, meta = _page_leaves(sp)
@@ -434,6 +490,7 @@ class MeshExecutor(LocalExecutor):
                     env[s][0],
                     env[s][1],
                     out_layout.dicts.get(s),
+                    out_layout.pools.get(s),
                 )
                 for s in out_layout.names
             ]
@@ -447,14 +504,7 @@ class MeshExecutor(LocalExecutor):
         self, sp: ShardedPage, key_symbols: list[str]
     ) -> ShardedPage:
         cols = [sp.column(k) for k in key_symbols]
-        pairs = []
-        for c in cols:
-            parts = K.limb_parts(c.data)  # 2D limb keys expand
-            pairs.extend(
-                (p, c.valid if i == 0 else None)
-                for i, p in enumerate(parts)
-            )
-        h = K.hash_columns(pairs)
+        h = K.hash_columns(_exchange_key_pairs(cols))
         dest = (h % jnp.uint64(self.n_shards)).astype(jnp.int32)
         return self.exchange_by_dest(sp, dest)
 
@@ -522,7 +572,7 @@ class MeshExecutor(LocalExecutor):
                 if has_valid:
                     valid = out[i]
                     i += 1
-                cols.append(Column(c.type, data, valid, c.dictionary))
+                cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
             return ShardedPage(list(sp.names), cols, rlive, self.n_shards)
 
     # ---- distributed joins ----------------------------------------------
@@ -533,6 +583,11 @@ class MeshExecutor(LocalExecutor):
         shard regardless of which table they came from."""
         for ls, rs in criteria:
             lc, rc = left.column(ls), right.column(rs)
+            if lc.hash_pool is not None and rc.hash_pool is not None:
+                # hash codes are globally consistent; only the
+                # cross-pool injectivity proof is needed
+                lc.hash_pool.verify_joinable(rc.hash_pool)
+                continue
             if lc.dictionary is not None or rc.dictionary is not None:
                 lc2, rc2 = unify_dictionaries(lc, rc)
                 left.columns[left.names.index(ls)] = lc2
@@ -617,8 +672,9 @@ class MeshExecutor(LocalExecutor):
         p_leaves, p_meta = _page_leaves(probe)
         b_leaves, b_meta = _page_leaves(build)
         n_p = len(p_leaves)
+        kinds = self._join_key_kinds(probe, build, criteria)
         prelude = _make_prelude(
-            criteria, p_meta, b_meta, n_p, len(criteria) > 1
+            criteria, p_meta, b_meta, n_p, len(criteria) > 1, kinds
         )
         leaves = p_leaves + b_leaves
         key_b = (
@@ -696,7 +752,7 @@ class MeshExecutor(LocalExecutor):
             if has_valid:
                 valid = out[i]
                 i += 1
-            cols.append(Column(c.type, data, valid, c.dictionary))
+            cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
         return ShardedPage(list(probe.names), cols, new_mask, probe.n_shards)
 
     # ---- skew-split join (SkewedPartitionRebalancer analog,
@@ -745,14 +801,7 @@ class MeshExecutor(LocalExecutor):
     def _dest_counts(self, sp: ShardedPage, key_syms: list[str]):
         """(dest per row, global per-destination row counts)."""
         cols = [sp.column(k) for k in key_syms]
-        pairs = []
-        for c in cols:
-            parts = K.limb_parts(c.data)
-            pairs.extend(
-                (p, c.valid if i == 0 else None)
-                for i, p in enumerate(parts)
-            )
-        h = K.hash_columns(pairs)
+        h = K.hash_columns(_exchange_key_pairs(cols))
         dest = (h % jnp.uint64(self.n_shards)).astype(jnp.int32)
         prog = self._mesh_jit_cache.get("dest-hist")
         if prog is None:
@@ -873,7 +922,7 @@ class MeshExecutor(LocalExecutor):
             if has_valid:
                 valid = out[i]
                 i += 1
-            cols.append(Column(c.type, data, valid, c.dictionary))
+            cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
         mask = out[i]
         return ShardedPage(list(a.names), cols, mask, a.n_shards)
 
@@ -909,7 +958,11 @@ class MeshExecutor(LocalExecutor):
             if isinstance(page, ShardedPage) else page.capacity
         )
         return tuple(
-            (n, repr(c.type), id(c.dictionary), c.valid is not None)
+            (
+                n, repr(c.type), id(c.dictionary),
+                None if c.hash_pool is None else c.hash_pool.token,
+                c.valid is not None,
+            )
             for n, c in zip(page.names, page.columns)
         ) + (cap, replicated)
 
@@ -925,12 +978,16 @@ class MeshExecutor(LocalExecutor):
         b_leaves, b_meta = _page_leaves(build)
         n_p = len(p_leaves)
         p_cols0 = {n: c for n, c in zip(probe.names, probe.columns)}
+        kinds = self._join_key_kinds(probe, build, criteria)
         verify = len(criteria) > 1 or any(
-            jnp.ndim(p_cols0[a].data) == 2 for a, _ in criteria
+            k != "hash" and jnp.ndim(p_cols0[a].data) == 2
+            for k, (a, _) in zip(kinds, criteria)
         )
         p_cols = {n: c for n, c in zip(probe.names, probe.columns)}
         b_cols = {n: c for n, c in zip(build.names, build.columns)}
-        prelude = _make_prelude(criteria, p_meta, b_meta, n_p, verify)
+        prelude = _make_prelude(
+            criteria, p_meta, b_meta, n_p, verify, kinds
+        )
         in_specs = (PS(axis),) * n_p + (
             (PS(),) if replicated else (PS(axis),)
         ) * len(b_leaves)
@@ -1073,7 +1130,7 @@ class MeshExecutor(LocalExecutor):
             if has_valid:
                 valid = outs[i]
                 i += 1
-            cols.append(Column(node.outputs[s], data, valid, src.dictionary))
+            cols.append(Column(node.outputs[s], data, valid, src.dictionary, src.hash_pool))
         return ShardedPage(
             [s for s, _, _ in out_meta], cols, mask, self.n_shards
         )
@@ -1160,7 +1217,7 @@ class MeshExecutor(LocalExecutor):
             if has_valid:
                 valid = outs[i]
                 i += 1
-            cols.append(Column(node.outputs[s], data, valid, src.dictionary))
+            cols.append(Column(node.outputs[s], data, valid, src.dictionary, src.hash_pool))
         return ShardedPage(
             [s for s, _, _ in out_meta], cols, mask, self.n_shards
         )
@@ -1187,8 +1244,10 @@ class MeshExecutor(LocalExecutor):
         b_leaves, b_meta = _page_leaves(filt)
         n_p = len(p_leaves)
         criteria = list(node.keys)
+        kinds = self._join_key_kinds(sp, filt, criteria)
         verify = len(criteria) > 1 or any(
-            jnp.ndim(sp.column(a).data) == 2 for a, _ in criteria
+            k != "hash" and jnp.ndim(sp.column(a).data) == 2
+            for k, (a, _) in zip(kinds, criteria)
         )
         needs_expand = verify or node.filter is not None
         p_cap = sp.shard_capacity
@@ -1212,7 +1271,7 @@ class MeshExecutor(LocalExecutor):
                 ColumnLayout(types=pair_types, dictionaries=pair_dicts),
             )
 
-        prelude = _make_prelude(criteria, p_meta, b_meta, n_p, verify)
+        prelude = _make_prelude(criteria, p_meta, b_meta, n_p, verify, kinds)
         out_cap = None
         if needs_expand:
             key_a = (

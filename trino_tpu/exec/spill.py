@@ -157,6 +157,8 @@ def page_to_host(page: Page) -> HostRun:
         valid = None if c.valid is None else np.asarray(c.valid)[sel]
         if c.dictionary is not None:
             data = c.dictionary.values[data].astype(object)
+        elif c.hash_pool is not None:
+            data = c.hash_pool.values[data[:, 1]].astype(object)
         cols.append((data, valid))
     return HostRun(
         list(page.names),

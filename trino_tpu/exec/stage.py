@@ -21,7 +21,7 @@ with an 8x larger table when one trips (FlatHash rehash analog).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace as dc_replace
+from dataclasses import dataclass, field, replace as dc_replace
 
 import jax.numpy as jnp
 import numpy as np
@@ -48,6 +48,8 @@ class ChainLayout:
     types: dict[str, T.DataType]
     dicts: dict[str, StringDictionary | None]
     capacity: int
+    #: hash-coded varchar pools by symbol (data = [cap,2] hash+id)
+    pools: dict = field(default_factory=dict)
 
     def expr_layout(self) -> ColumnLayout:
         return ColumnLayout(types=dict(self.types), dictionaries=dict(self.dicts))
@@ -191,11 +193,18 @@ def _project_step(nd: P.Project, layout: ChainLayout):
         for sym, e in nd.assignments.items()
     }
     cap = layout.capacity
+    from trino_tpu.expr.ir import InputRef as _Ref
+
     out_layout = ChainLayout(
         names=list(nd.assignments),
         types={s: e.type for s, e in nd.assignments.items()},
         dicts={s: c.dictionary for s, c in compiled.items()},
         capacity=cap,
+        pools={
+            s: layout.pools.get(e.name)
+            for s, e in nd.assignments.items()
+            if isinstance(e, _Ref) and layout.pools.get(e.name) is not None
+        },
     )
 
     def step(env, mask, flags):
@@ -236,6 +245,10 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
             },
         },
         capacity=out_cap,
+        pools={
+            s: layout.pools[s]
+            for s in group_keys if layout.pools.get(s) is not None
+        },
     )
 
     key_ranges = nd.key_ranges or {}
@@ -248,17 +261,25 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
             out_mask = jnp.zeros((8,), dtype=jnp.bool_).at[0].set(True)
             env2 = {}
         else:
-            shifted = [
-                _shift_key(*env[s], key_ranges.get(s)) for s in group_keys
-            ]
-            norm = [_norm_opt(d, v) for d, v in shifted]
-            widths = tuple(
-                _key_width(
-                    layout.types[s], layout.dicts.get(s),
-                    key_ranges.get(s),
+            shifted = []
+            width_list = []
+            for s in group_keys:
+                data, valid = env[s]
+                if layout.pools.get(s) is not None:
+                    # hash-coded varchar: the hash lane IS the key (the
+                    # id lane is row identity, not value identity)
+                    shifted.append((data[:, 0], valid))
+                    width_list.append(64)
+                    continue
+                shifted.append(_shift_key(data, valid, key_ranges.get(s)))
+                width_list.append(
+                    _key_width(
+                        layout.types[s], layout.dicts.get(s),
+                        key_ranges.get(s),
+                    )
                 )
-                for s in group_keys
-            )
+            norm = [_norm_opt(d, v) for d, v in shifted]
+            widths = tuple(width_list)
             info = K.sort_group(
                 tuple(b for b, _ in norm),
                 tuple(fl for _, fl in norm),
@@ -303,12 +324,21 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
                 fd, fv = filter_c.fn(env)
                 contrib = contrib & (fd if fv is None else (fd & fv))
             if call.distinct:
-                dwidths = widths + (
-                    _key_width(call.args[0].type, arg_c[0].dictionary),
-                )
+                d_arg = arg
+                if (
+                    isinstance(call.args[0].type, T.VarcharType)
+                    and jnp.ndim(arg[0]) == 2
+                ):
+                    # hash-coded varchar: dedupe on the hash lane
+                    d_arg = (arg[0][:, 0], arg[1])
+                    dwidth = 64
+                else:
+                    dwidth = _key_width(
+                        call.args[0].type, arg_c[0].dictionary
+                    )
                 # shifted key pairs match the narrowed widths
-                contrib = _dedupe(list(shifted), arg, contrib, in_cap,
-                                  dwidths)
+                contrib = _dedupe(list(shifted), d_arg, contrib, in_cap,
+                                  widths + (dwidth,))
             prepared.append((sym, call, arg, contrib))
         if info is not None:
             _presort_shared(prepared, info, share)

@@ -40,6 +40,10 @@ class TableScan(PlanNode):
     table: str = ""
     #: output symbol -> connector column name
     assignments: dict[str, str] = field(default_factory=dict)
+    #: symbols to scan as hash-coded varchar (plan.stats.annotate:
+    #: high-NDV columns used only in equality/grouping/count contexts —
+    #: skips the sorted-dictionary build)
+    hash_varchar: list[str] | None = None
 
 
 @dataclass

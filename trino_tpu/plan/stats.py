@@ -486,7 +486,156 @@ def _intersect_sym(a: SymbolStats, b: SymbolStats) -> SymbolStats:
 
 # ---- plan annotation -------------------------------------------------------
 
-def annotate(plan: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+#: varchar columns beyond this NDV scan hash-coded when eligible
+#: (session ``varchar_hash_ndv`` overrides; the sorted-dictionary
+#: build is an O(n log n) host string sort — the SF1 l_comment cliff)
+VARCHAR_HASH_NDV = 1 << 20
+
+
+def _hash_varchar_candidates(plan: P.PlanNode, metadata, threshold):
+    """Scan symbols eligible for hash-coded varchar: used ONLY as group
+    keys, plain join criteria (both sides eligible), count/distinct
+    arguments, or raw output — never in ordering, range/LIKE
+    predicates, projections or other expressions (those need sorted
+    dictionary codes)."""
+    from trino_tpu.expr.ir import InputRef as Ref
+
+    scans: dict[str, tuple[P.TableScan, str]] = {}
+    unsafe: set[str] = set()
+    join_edges: list[tuple[str, str]] = []
+    #: identity-projection renames (out symbol -> source symbol):
+    #: unsafety flows back through them to the scan symbol
+    aliases: list[tuple[str, str]] = []
+
+    def expr_refs(e):
+        out = set()
+
+        def w(x):
+            if isinstance(x, Ref):
+                out.add(x.name)
+            for a in getattr(x, "args", ()):
+                w(a)
+            arg = getattr(x, "arg", None)
+            if arg is not None:
+                w(arg)
+
+        if e is not None:
+            w(e)
+        return out
+
+    def walk(node):
+        for s in node.sources:
+            walk(s)
+        if isinstance(node, P.TableScan):
+            for sym, col in node.assignments.items():
+                if isinstance(node.outputs.get(sym), T.VarcharType):
+                    scans[sym] = (node, col)
+            return
+        if isinstance(node, P.Filter):
+            unsafe.update(expr_refs(node.predicate))
+        elif isinstance(node, P.Project):
+            for out_sym, e in node.assignments.items():
+                if isinstance(e, Ref):
+                    aliases.append((out_sym, e.name))
+                else:
+                    unsafe.update(expr_refs(e))
+        elif isinstance(node, P.Aggregate):
+            for call in node.aggregates.values():
+                names = set()
+                for a in call.args:
+                    names |= expr_refs(a)
+                names |= expr_refs(call.filter)
+                if call.name not in ("count", "count_all"):
+                    unsafe.update(names)
+                elif not all(isinstance(a, Ref) for a in call.args):
+                    unsafe.update(names)
+        elif isinstance(node, P.Join):
+            join_edges.extend(node.criteria)
+            unsafe.update(expr_refs(node.filter))
+        elif isinstance(node, P.SemiJoin):
+            join_edges.extend(node.keys)
+            unsafe.update(expr_refs(node.filter))
+        elif isinstance(node, (P.Sort, P.TopN)):
+            unsafe.update(k.symbol for k in node.keys)
+        elif isinstance(node, P.Window):
+            unsafe.update(k.symbol for k in node.order_keys)
+            # partition keys are equality-style, but the window
+            # executor has no hash-lane handling yet
+            unsafe.update(node.partition_by)
+            for call in node.functions.values():
+                for a in call.args:
+                    unsafe.update(expr_refs(a))
+        elif isinstance(node, P.Unnest):
+            for a in node.arrays:
+                for e in a:
+                    unsafe.update(expr_refs(e))
+        elif isinstance(node, P.Union):
+            for ins in node.symbol_map.values():
+                unsafe.update(ins)  # branch remaps need dictionaries
+
+    walk(plan)
+    # unsafety propagates backwards through identity renames to the
+    # scan symbol (ORDER BY on an alias is an ordered use of the base)
+    changed = True
+    while changed:
+        changed = False
+        for out_sym, in_sym in aliases:
+            if out_sym in unsafe and in_sym not in unsafe:
+                unsafe.add(in_sym)
+                changed = True
+
+    def eligible(sym):
+        if sym in unsafe or sym not in scans:
+            return False
+        node, col = scans[sym]
+        try:
+            cs = metadata.connector(node.catalog).column_stats(
+                node.schema, node.table, col
+            )
+        except Exception:
+            return False
+        return cs is not None and cs.ndv is not None and cs.ndv > threshold
+
+    # join-connected symbols hash together or not at all (a mixed
+    # hash/dictionary join would need cross-encoding remaps); an edge
+    # touching any symbol we cannot prove hash-eligible (including
+    # renamed/derived ones) disqualifies its partner too
+    chosen = {s for s in scans if eligible(s)}
+    # resolve projection renames back to base symbols so an aliased
+    # join edge still couples (or disqualifies) its endpoints
+    alias_to_base = {}
+    for out_sym, in_sym in aliases:
+        alias_to_base[out_sym] = in_sym
+
+    def base_of(sym):
+        seen = set()
+        while sym in alias_to_base and sym not in seen:
+            seen.add(sym)
+            sym = alias_to_base[sym]
+        return sym
+
+    changed = True
+    while changed:
+        changed = False
+        for a0, b0 in join_edges:
+            a, b = base_of(a0), base_of(b0)
+            if a not in scans and b not in scans:
+                continue
+            if not (a in chosen and b in chosen):
+                for s in (a, b):
+                    if s in chosen:
+                        chosen.discard(s)
+                        changed = True
+    for sym in chosen:
+        node, _ = scans[sym]
+        node.hash_varchar = sorted(
+            set(node.hash_varchar or []) | {sym}
+        )
+
+
+def annotate(
+    plan: P.PlanNode, metadata: Metadata, session=None
+) -> P.PlanNode:
     """Annotate the final plan with executor-facing statistics:
 
     - ``Aggregate.est_groups``: expected distinct group count — sizes
@@ -556,6 +705,19 @@ def annotate(plan: P.PlanNode, metadata: Metadata) -> P.PlanNode:
             node.key_ranges = ranges or None
 
     walk(plan)
+    threshold = VARCHAR_HASH_NDV
+    budgeted = False
+    if session is not None:
+        threshold = int(
+            session.properties.get("varchar_hash_ndv", threshold)
+        )
+        # streamed (budget-mode) scans chunk per Split and would build
+        # chunk-local pools mixing with resident hash columns; hash
+        # coding stays off under a budget until the streamed path
+        # carries pools
+        budgeted = bool(session.properties.get("hbm_budget_bytes"))
+    if threshold > 0 and not budgeted:
+        _hash_varchar_candidates(plan, metadata, threshold)
     return plan
 
 
