@@ -154,3 +154,60 @@ def test_max_by_distributed():
     local = QueryRunner.tpch("tiny").execute(sql).rows
     dist = QueryRunner.tpch("tiny", mesh=make_mesh()).execute(sql).rows
     assert local == dist
+
+
+# ---- approx_percentile -----------------------------------------------------
+
+def test_approx_percentile_global(runner):
+    import numpy as np
+
+    vals = np.asarray(
+        runner.metadata.connector("tpch").data("tiny").column(
+            "lineitem", "l_quantity"
+        )
+    )
+    (got,) = runner.execute(
+        "select approx_percentile(l_quantity, 0.5) from lineitem"
+    ).rows[0]
+    s = np.sort(vals)
+    expect = s[round(0.5 * (len(s) - 1))]
+    from decimal import Decimal
+
+    assert got == Decimal(int(expect)).scaleb(-2)
+
+
+def test_approx_percentile_grouped(runner):
+    import numpy as np
+
+    data = runner.metadata.connector("tpch").data("tiny")
+    qty = np.asarray(data.column("lineitem", "l_quantity"))
+    ln = np.asarray(data.column("lineitem", "l_linenumber"))
+    rows = runner.execute(
+        "select l_linenumber, approx_percentile(l_quantity, 0.9) "
+        "from lineitem group by l_linenumber order by 1"
+    ).rows
+    from decimal import Decimal
+
+    for lnum, got in rows:
+        s = np.sort(qty[ln == lnum])
+        expect = s[round(0.9 * (len(s) - 1))]
+        assert got == Decimal(int(expect)).scaleb(-2), lnum
+
+
+def test_approx_percentile_with_filter_and_nulls():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.engine import QueryRunner
+    from trino_tpu.metadata import Metadata, Session
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table t (g bigint, v bigint)")
+    r.execute(
+        "insert into t values (1, 10), (1, 20), (1, 30), (1, null), "
+        "(2, 5), (2, null)"
+    )
+    got = dict(r.execute(
+        "select g, approx_percentile(v, 0.5) from t group by g"
+    ).rows)
+    assert got == {1: 20, 2: 5}

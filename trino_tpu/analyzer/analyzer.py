@@ -882,6 +882,27 @@ class Analyzer:
                     call = AggCall(
                         "count", args[:1], T.BIGINT, distinct=True
                     )
+                elif name == "approx_percentile":
+                    if len(args) != 2:
+                        raise AnalysisError(
+                            "approx_percentile takes (value, percentile)"
+                        )
+                    if fc.distinct:
+                        raise AnalysisError(
+                            "DISTINCT is not supported for "
+                            "approx_percentile"
+                        )
+                    qarg = args[1]
+                    if not isinstance(
+                        qarg.type, (T.DoubleType, T.RealType)
+                    ):
+                        # a 0.5 literal parses as DECIMAL; the executor
+                        # reads the fraction as a double
+                        qarg = Cast(T.DOUBLE, qarg)
+                    call = AggCall(
+                        name, (args[0], qarg),
+                        agg_result_type(name, args[0].type),
+                    )
                 else:
                     rt = agg_result_type(name, args[0].type if args else None)
                     call = AggCall(name, args, rt, distinct=fc.distinct)

@@ -151,6 +151,8 @@ def agg_result_type(name: str, arg_type: T.DataType | None) -> T.DataType:
         return T.BOOLEAN
     if name in ("count_if", "approx_distinct"):
         return T.BIGINT
+    if name == "approx_percentile":
+        return arg_type  # value argument's type
     if name in ("max_by", "min_by"):
         return arg_type  # first argument's type
     raise AnalysisError(f"unknown aggregate function {name}")
@@ -160,6 +162,7 @@ AGG_FNS = {
     "count", "sum", "avg", "min", "max", "any_value", "arbitrary",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "count_if", "approx_distinct",
+    "approx_percentile",
     "max_by", "min_by",
 }
 

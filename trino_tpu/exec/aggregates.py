@@ -196,6 +196,40 @@ def compute_aggregate(
             eff = eff & valid
         return _Reducer(info, capacity, eff, share).count(), None
 
+    if name == "approx_percentile":
+        # EXACT sorted-rank percentile (the reference's qdigest sketch
+        # approximates, MAIN/operator/aggregation/ApproximateLongPercentileAggregations;
+        # a sort-based engine gets the exact answer for the same cost
+        # class): rows re-sort (group, contributing-first, value) and
+        # each group reads index round(q * (cnt-1)) of its run.
+        (vd, vv), (qd, _qv) = arg
+        if jnp.ndim(vd) == 2:
+            raise NotImplementedError(
+                "approx_percentile over decimal(38) values"
+            )
+        eff = contrib if vv is None else (contrib & vv)
+        q = qd.reshape(-1)[0].astype(jnp.float64)
+        vbits = K.order_bits(vd)
+        n = vd.shape[0]
+        p = jnp.argsort(vbits, stable=True).astype(jnp.int32)
+        p = p[jnp.argsort((~eff)[p], stable=True)]
+        er = _Reducer(info, capacity, eff, share)
+        cnt2 = er.count()
+        if info is None:
+            starts = jnp.zeros((1,), dtype=jnp.int64)
+        else:
+            # group runs occupy the same [start, end) ranges as info's
+            # ordering (identical per-group populations, dead rows last)
+            p = p[jnp.argsort(info.group[p], stable=True)]
+            starts = info.starts.astype(jnp.int64)
+        offs = jnp.clip(
+            jnp.round(q * (cnt2.astype(jnp.float64) - 1.0)).astype(jnp.int64),
+            0, jnp.maximum(cnt2 - 1, 0),
+        )
+        at = jnp.clip(starts + offs, 0, max(n - 1, 0))
+        out = vd[p[at.astype(jnp.int32)]]
+        return out, cnt2 > 0
+
     if name in ("max_by", "min_by"):
         (vd, vv), (kd, kv) = arg
         is_min = name == "min_by"

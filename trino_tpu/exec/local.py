@@ -1397,6 +1397,7 @@ def _slice_page(page: Page, lo: int, hi: int) -> Page:
             c.type, c.data[lo:hi],
             None if c.valid is None else c.valid[lo:hi],
             c.dictionary,
+            c.hash_pool,
         )
         for c in page.columns
     ]

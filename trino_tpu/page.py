@@ -114,13 +114,16 @@ class HashStringPool:
     alias a freed pool's address; tokens never repeat).
     """
 
-    __slots__ = ("values", "by_hash", "token", "_hashes", "_joinable")
+    __slots__ = (
+        "values", "token", "_hashes", "_sorted", "_joinable",
+    )
 
     def __init__(self, values: np.ndarray):
         self.values = values  # host object array, id lane indexes it
-        self.by_hash: dict[int, str] | None = None
         self.token = next(_POOL_TOKENS)
         self._hashes: np.ndarray | None = None
+        #: (unique sorted hashes, one representative string per hash)
+        self._sorted: tuple[np.ndarray, np.ndarray] | None = None
         self._joinable: set[int] = set()
 
     def hashes(self) -> np.ndarray:
@@ -132,35 +135,43 @@ class HashStringPool:
         return self._hashes
 
     def verify_injective(self) -> None:
-        """Prove hash64 is injective on this pool's values (memoized).
-        A collision (probability ~n^2/2^64) falls back by raising —
+        """Prove hash64 is injective on this pool's values (memoized,
+        vectorized: sort hashes, string-compare only within equal-hash
+        runs — any run holding two distinct strings has an adjacent
+        differing pair). A collision (probability ~n^2/2^64) raises —
         callers rebuild with a sorted dictionary."""
-        if self.by_hash is not None:
+        if self._sorted is not None:
             return
-        by_hash: dict[int, str] = {}
-        for h, s in zip(self.hashes(), self.values):
-            prev = by_hash.setdefault(int(h), s)
-            if prev != s:
-                raise HashCollision(prev, s)
-        self.by_hash = by_hash
+        h = self.hashes()
+        order = np.argsort(h, kind="stable")
+        hs = h[order]
+        vs = self.values[order]
+        same_h = hs[1:] == hs[:-1]
+        if same_h.any():
+            diff = same_h & (vs[1:] != vs[:-1])
+            if diff.any():
+                i = int(np.argmax(diff))
+                raise HashCollision(vs[i], vs[i + 1])
+        # dedupe to one representative per hash (injectivity proven)
+        first = np.concatenate([[True], ~same_h])
+        self._sorted = (hs[first], vs[first])
 
     def verify_joinable(self, other: "HashStringPool") -> None:
         """Prove injectivity across BOTH pools (join exactness);
-        memoized per pool pair — the cross probe is host work that
-        must not repeat on every query."""
+        memoized per pool pair and fully vectorized: compare the
+        representative strings at hash values common to both sides."""
         if other.token in self._joinable or other is self:
             return
         self.verify_injective()
         other.verify_injective()
-        small, big = (
-            (self, other)
-            if len(self.by_hash) <= len(other.by_hash)
-            else (other, self)
+        ha, va = self._sorted
+        hb, vb = other._sorted
+        common, ia, ib = np.intersect1d(
+            ha, hb, assume_unique=True, return_indices=True
         )
-        for h, s in small.by_hash.items():
-            o = big.by_hash.get(h)
-            if o is not None and o != s:
-                raise HashCollision(s, o)
+        if len(common) and (va[ia] != vb[ib]).any():
+            bad = int(np.argmax(va[ia] != vb[ib]))
+            raise HashCollision(va[ia][bad], vb[ib][bad])
         self._joinable.add(other.token)
         other._joinable.add(self.token)
 

@@ -110,6 +110,7 @@ def plan_to_json(node: P.PlanNode) -> dict:
         d.update(
             catalog=node.catalog, schema=node.schema, table=node.table,
             assignments=list(node.assignments.items()),
+            hash_varchar=node.hash_varchar,
         )
         return d
     if isinstance(node, P.Values):
@@ -220,6 +221,7 @@ def plan_from_json(d: dict) -> P.PlanNode:
         return P.TableScan(
             outputs, catalog=d["catalog"], schema=d["schema"],
             table=d["table"], assignments=dict(d["assignments"]),
+            hash_varchar=d.get("hash_varchar"),
         )
     if kind == "Values":
         return P.Values(outputs, rows=d["rows"])
