@@ -211,3 +211,58 @@ def test_approx_percentile_with_filter_and_nulls():
         "select g, approx_percentile(v, 0.5) from t group by g"
     ).rows)
     assert got == {1: 20, 2: 5}
+
+
+# ---- regex (JoniRegexpFunctions analog: host-eval over dictionary) ---------
+
+def test_regexp_like(runner, oracle):
+    got = runner.execute(
+        "select n_name from nation where regexp_like(n_name, '^[AB]') "
+        "order by 1"
+    ).rows
+    import re as _re
+
+    expect = sorted(
+        (r[0],) for r in oracle.execute("select n_name from nation")
+        if _re.search("^[AB]", r[0])
+    )
+    assert got == expect
+
+
+def test_regexp_extract_and_replace(runner):
+    rows = runner.execute(
+        "select n_name, regexp_extract(n_name, '([A-Z]+)IA', 1), "
+        "regexp_replace(n_name, '[AEIOU]', '.') "
+        "from nation where n_nationkey < 3 order by 1"
+    ).rows
+    import re as _re
+
+    for name, ext, repl in rows:
+        m = _re.search("([A-Z]+)IA", name)
+        assert ext == (m.group(1) if m else "")
+        assert repl == _re.sub("[AEIOU]", ".", name)
+
+
+def test_regexp_replace_group_refs(runner):
+    rows = runner.execute(
+        "select regexp_replace(n_name, '^(..)', '$1-') from nation "
+        "where n_nationkey = 0"
+    ).rows
+    assert rows == [("AL-GERIA",)]
+
+
+def test_approx_percentile_validation(runner):
+    import pytest as _pytest
+
+    from trino_tpu.analyzer.scope import AnalysisError
+
+    with _pytest.raises(AnalysisError, match="0, 1"):
+        runner.execute("select approx_percentile(l_quantity, 1.5) from lineitem")
+    with _pytest.raises(AnalysisError, match="constant"):
+        runner.execute(
+            "select approx_percentile(l_quantity, l_discount) from lineitem"
+        )
+    with _pytest.raises(AnalysisError, match="DISTINCT"):
+        runner.execute(
+            "select approx_percentile(distinct l_quantity, 0.5) from lineitem"
+        )

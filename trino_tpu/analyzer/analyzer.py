@@ -893,14 +893,28 @@ class Analyzer:
                             "approx_percentile"
                         )
                     qarg = args[1]
-                    if not isinstance(
-                        qarg.type, (T.DoubleType, T.RealType)
-                    ):
-                        # a 0.5 literal parses as DECIMAL; the executor
-                        # reads the fraction as a double
-                        qarg = Cast(T.DOUBLE, qarg)
+                    q_lit = qarg
+                    while isinstance(q_lit, Cast):
+                        q_lit = q_lit.arg
+                    if not isinstance(q_lit, Literal) or q_lit.value is None:
+                        raise AnalysisError(
+                            "approx_percentile percentile must be a "
+                            "constant (the executor applies ONE "
+                            "fraction per aggregate)"
+                        )
+                    try:
+                        q_val = float(q_lit.value)
+                    except (TypeError, ValueError):
+                        raise AnalysisError(
+                            "approx_percentile percentile must be numeric"
+                        ) from None
+                    if not (0.0 <= q_val <= 1.0):
+                        raise AnalysisError(
+                            f"percentile must be in [0, 1], got {q_val}"
+                        )
                     call = AggCall(
-                        name, (args[0], qarg),
+                        name,
+                        (args[0], Literal(T.DOUBLE, q_val)),
                         agg_result_type(name, args[0].type),
                     )
                 else:

@@ -176,6 +176,11 @@ SCALAR_FNS = {
     "round": ("round", lambda ts: ts[0]),
     "substr": ("substr", lambda ts: T.VARCHAR),
     "lower": ("lower", lambda ts: T.VARCHAR),
+    # regex (reference: joni-backed MAIN/operator/scalar/JoniRegexpFunctions.java;
+    # here python `re` over dictionary values -> device LUT/remap)
+    "regexp_like": ("regexp_like", lambda ts: T.BOOLEAN),
+    "regexp_extract": ("regexp_extract", lambda ts: T.VARCHAR),
+    "regexp_replace": ("regexp_replace", lambda ts: T.VARCHAR),
     "upper": ("upper", lambda ts: T.VARCHAR),
     "trim": ("trim", lambda ts: T.VARCHAR),
     "year": ("extract_year", lambda ts: T.BIGINT),

@@ -548,6 +548,16 @@ class _Compiler:
             )
             if expr.name == "not_like":
                 lut = ~lut
+        elif expr.name == "regexp_like":
+            # Trino regexp_like is a SEARCH (substring match), not a
+            # full match (JoniRegexpFunctions.regexpLike)
+            pattern = str(expr.args[1].value)  # type: ignore[attr-defined]
+            rx = re.compile(pattern)
+            lut = np.fromiter(
+                (rx.search(str(v)) is not None for v in a.dictionary.values),
+                dtype=np.bool_,
+                count=len(a.dictionary),
+            )
         else:
             raise NotImplementedError(expr.name)
         lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros(1, dtype=jnp.bool_)
@@ -566,9 +576,13 @@ class _Compiler:
             raise NotImplementedError(f"{expr.name} requires a dictionary input")
         f = _STRING_TRANSFORMS[expr.name]
         lits = [l.value for l in expr.args[1:]]  # type: ignore[attr-defined]
-        transformed = np.asarray(
-            [f(str(v), *lits) for v in a.dictionary.values], dtype=object
-        )
+        try:
+            transformed = np.asarray(
+                [f(str(v), *lits) for v in a.dictionary.values],
+                dtype=object,
+            )
+        except (re.error, IndexError) as e:
+            raise ValueError(f"{expr.name}: {e}") from e
         if len(transformed):
             new_dict, codes = StringDictionary.from_strings(transformed)
             remap = jnp.asarray(codes)
@@ -826,7 +840,7 @@ _CMP_OPS = {
 #: operator under argument swap: a OP b == b MIRROR(OP) a
 _MIRRORED_CMP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
 
-_STRING_PREDICATES = {"like", "not_like"}
+_STRING_PREDICATES = {"like", "not_like", "regexp_like"}
 
 _STRING_TRANSFORMS: dict[str, Callable] = {
     "substr": lambda s, start, length=None: (
@@ -841,7 +855,43 @@ _STRING_TRANSFORMS: dict[str, Callable] = {
     "rtrim": lambda s: s.rstrip(),
     "reverse": lambda s: s[::-1],
     "replace": lambda s, find, repl="": s.replace(find, repl),
+    # Trino regex semantics (JoniRegexpFunctions): extract returns the
+    # group (NULL-as-empty here: dictionary transforms cannot produce
+    # NULL) or '' when unmatched; replace substitutes every match
+    "regexp_extract": lambda s, pattern, group=0: (
+        (lambda m: m.group(int(group)) or "" if m else "")(
+            re.search(str(pattern), s)
+        )
+    ),
+    "regexp_replace": lambda s, pattern, repl="": re.sub(
+        str(pattern), _dollar_refs(str(repl)), s
+    ),
 }
+
+
+def _dollar_refs(repl: str) -> str:
+    r"""Trino replacement strings use $N group references (with \$ as
+    the literal-dollar escape); python re.sub wants \g<N> (which,
+    unlike \N, also handles $0 = whole match)."""
+    out = []
+    i = 0
+    while i < len(repl):
+        c = repl[i]
+        if c == "\\" and i + 1 < len(repl) and repl[i + 1] == "$":
+            out.append("$")
+            i += 2
+            continue
+        if c == "$":
+            j = i + 1
+            while j < len(repl) and repl[j].isdigit():
+                j += 1
+            if j > i + 1:
+                out.append(f"\\g<{repl[i + 1:j]}>")
+                i = j
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 #: varchar -> numeric/boolean per-dictionary-value functions: evaluate
 #: on the (small) dictionary host-side, gather by code on device

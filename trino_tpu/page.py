@@ -143,6 +143,9 @@ class HashStringPool:
         if self._sorted is not None:
             return
         h = self.hashes()
+        if len(h) == 0:
+            self._sorted = (h, self.values)
+            return
         order = np.argsort(h, kind="stable")
         hs = h[order]
         vs = self.values[order]
