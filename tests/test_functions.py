@@ -239,7 +239,8 @@ def test_regexp_extract_and_replace(runner):
 
     for name, ext, repl in rows:
         m = _re.search("([A-Z]+)IA", name)
-        assert ext == (m.group(1) if m else "")
+        # Trino semantics: NULL when the pattern does not match
+        assert ext == (m.group(1) if m else None)
         assert repl == _re.sub("[AEIOU]", ".", name)
 
 
@@ -266,3 +267,17 @@ def test_approx_percentile_validation(runner):
         runner.execute(
             "select approx_percentile(distinct l_quantity, 0.5) from lineitem"
         )
+
+
+def test_regexp_extract_null_and_group_refs(runner):
+    (n_null,) = runner.execute(
+        "select count(*) from nation "
+        "where regexp_extract(n_name, 'ZZZQ') is null"
+    ).rows[0]
+    assert n_null == 25  # no-match is NULL, Trino semantics
+    rows = runner.execute(
+        "select regexp_replace(n_name, '(A)', '$10') from nation "
+        "where n_nationkey = 0"
+    ).rows
+    # $10 with one group = group 1 + literal '0' (Java appendReplacement)
+    assert rows == [("A0LGERIA0",)]

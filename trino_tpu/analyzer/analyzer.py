@@ -894,8 +894,20 @@ class Analyzer:
                         )
                     qarg = args[1]
                     q_lit = qarg
-                    while isinstance(q_lit, Cast):
-                        q_lit = q_lit.arg
+                    q_neg = False
+                    while True:
+                        if isinstance(q_lit, Cast):
+                            q_lit = q_lit.arg
+                            continue
+                        if (
+                            isinstance(q_lit, Call)
+                            and q_lit.name == "negate"
+                            and len(q_lit.args) == 1
+                        ):
+                            q_neg = not q_neg
+                            q_lit = q_lit.args[0]
+                            continue
+                        break
                     if not isinstance(q_lit, Literal) or q_lit.value is None:
                         raise AnalysisError(
                             "approx_percentile percentile must be a "
@@ -904,6 +916,8 @@ class Analyzer:
                         )
                     try:
                         q_val = float(q_lit.value)
+                        if q_neg:
+                            q_val = -q_val
                     except (TypeError, ValueError):
                         raise AnalysisError(
                             "approx_percentile percentile must be numeric"

@@ -264,3 +264,59 @@ group by i_item_id, i_item_desc, i_category, i_class, i_current_price
 order by i_category, i_class, i_item_id, i_item_desc, 7
 limit 100
 """
+
+QUERIES["q37"] = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 10 and 150
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between date '2000-02-01' and date '2000-04-01'
+  and i_manufact_id in (810, 872, 215, 901)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+
+QUERIES["q82"] = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 10 and 150
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between date '2000-05-25' and date '2000-07-24'
+  and i_manufact_id in (990, 465, 354, 497)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+
+QUERIES["q99"] = """
+select w_warehouse_name, sm_type, cc_name,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk <= 30)
+      then 1 else 0 end) as d30,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 30)
+       and (cs_ship_date_sk - cs_sold_date_sk <= 60)
+      then 1 else 0 end) as d60,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 60)
+       and (cs_ship_date_sk - cs_sold_date_sk <= 90)
+      then 1 else 0 end) as d90,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 90)
+       and (cs_ship_date_sk - cs_sold_date_sk <= 120)
+      then 1 else 0 end) as d120,
+  sum(case when (cs_ship_date_sk - cs_sold_date_sk > 120)
+      then 1 else 0 end) as dmore
+from catalog_sales, warehouse, ship_mode, call_center, date_dim
+where d_month_seq between 132 and 143
+  and cs_ship_date_sk = d_date_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_ship_mode_sk = sm_ship_mode_sk
+  and cs_call_center_sk = cc_call_center_sk
+group by w_warehouse_name, sm_type, cc_name
+order by 1, 2, 3
+limit 100
+"""

@@ -538,28 +538,23 @@ class _Compiler:
         a = self.compile(expr.args[0])
         if a.dictionary is None:
             raise NotImplementedError(f"{expr.name} requires a dictionary input")
+        pattern = str(expr.args[1].value)  # type: ignore[attr-defined]
         if expr.name in ("like", "not_like"):
-            pattern = str(expr.args[1].value)  # type: ignore[attr-defined]
             rx = re.compile(_like_to_regex(pattern), re.DOTALL)
-            lut = np.fromiter(
-                (rx.fullmatch(str(v)) is not None for v in a.dictionary.values),
-                dtype=np.bool_,
-                count=len(a.dictionary),
-            )
-            if expr.name == "not_like":
-                lut = ~lut
+            matcher = rx.fullmatch
         elif expr.name == "regexp_like":
             # Trino regexp_like is a SEARCH (substring match), not a
             # full match (JoniRegexpFunctions.regexpLike)
-            pattern = str(expr.args[1].value)  # type: ignore[attr-defined]
-            rx = re.compile(pattern)
-            lut = np.fromiter(
-                (rx.search(str(v)) is not None for v in a.dictionary.values),
-                dtype=np.bool_,
-                count=len(a.dictionary),
-            )
+            matcher = re.compile(pattern).search
         else:
             raise NotImplementedError(expr.name)
+        lut = np.fromiter(
+            (matcher(str(v)) is not None for v in a.dictionary.values),
+            dtype=np.bool_,
+            count=len(a.dictionary),
+        )
+        if expr.name == "not_like":
+            lut = ~lut
         lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros(1, dtype=jnp.bool_)
 
         def ev(env):
@@ -577,12 +572,17 @@ class _Compiler:
         f = _STRING_TRANSFORMS[expr.name]
         lits = [l.value for l in expr.args[1:]]  # type: ignore[attr-defined]
         try:
-            transformed = np.asarray(
-                [f(str(v), *lits) for v in a.dictionary.values],
-                dtype=object,
-            )
+            raw = [f(str(v), *lits) for v in a.dictionary.values]
         except (re.error, IndexError) as e:
             raise ValueError(f"{expr.name}: {e}") from e
+        # a transform may return None per value (regexp_extract with no
+        # match is NULL, Trino semantics): carry a per-code null LUT
+        null_lut = np.fromiter(
+            (v is None for v in raw), dtype=np.bool_, count=len(raw)
+        )
+        transformed = np.asarray(
+            ["" if v is None else v for v in raw], dtype=object
+        )
         if len(transformed):
             new_dict, codes = StringDictionary.from_strings(transformed)
             remap = jnp.asarray(codes)
@@ -590,10 +590,19 @@ class _Compiler:
             new_dict, remap = StringDictionary(np.asarray([], dtype=object)), jnp.zeros(
                 1, dtype=jnp.int32
             )
+        has_nulls = bool(null_lut.any())
+        null_dev = (
+            jnp.asarray(null_lut) if has_nulls and len(null_lut)
+            else None
+        )
 
         def ev(env):
             data, valid = a.fn(env)
-            return jnp.take(remap, data, mode="clip"), valid
+            out = jnp.take(remap, data, mode="clip")
+            if null_dev is not None:
+                notnull = ~jnp.take(null_dev, data, mode="clip")
+                valid = notnull if valid is None else (valid & notnull)
+            return out, valid
 
         return CompiledExpr(ev, expr.type, new_dict)
 
@@ -859,37 +868,50 @@ _STRING_TRANSFORMS: dict[str, Callable] = {
     # group (NULL-as-empty here: dictionary transforms cannot produce
     # NULL) or '' when unmatched; replace substitutes every match
     "regexp_extract": lambda s, pattern, group=0: (
-        (lambda m: m.group(int(group)) or "" if m else "")(
+        (lambda m: (m.group(int(group)) or "") if m else None)(
             re.search(str(pattern), s)
         )
     ),
     "regexp_replace": lambda s, pattern, repl="": re.sub(
-        str(pattern), _dollar_refs(str(repl)), s
+        str(pattern),
+        _java_replacement(
+            str(repl), re.compile(str(pattern)).groups
+        ),
+        s,
     ),
 }
 
 
-def _dollar_refs(repl: str) -> str:
-    r"""Trino replacement strings use $N group references (with \$ as
-    the literal-dollar escape); python re.sub wants \g<N> (which,
-    unlike \N, also handles $0 = whole match)."""
+def _java_replacement(repl: str, n_groups: int) -> str:
+    r"""Java appendReplacement semantics (what Trino's regexp_replace
+    uses) -> python re.sub replacement: $N group references backtrack
+    to the largest VALID group number ($10 with one group = group 1 +
+    literal '0'); backslash escapes the next character literally; the
+    output escapes python's own backslash handling."""
+    def lit(c: str) -> str:
+        return "\\\\" if c == "\\" else c
+
     out = []
     i = 0
     while i < len(repl):
         c = repl[i]
-        if c == "\\" and i + 1 < len(repl) and repl[i + 1] == "$":
-            out.append("$")
+        if c == "\\" and i + 1 < len(repl):
+            out.append(lit(repl[i + 1]))
             i += 2
             continue
         if c == "$":
             j = i + 1
             while j < len(repl) and repl[j].isdigit():
                 j += 1
+            # backtrack to the largest group number the pattern has
+            while j > i + 1 and int(repl[i + 1:j]) > max(n_groups, 0) \
+                    and j - (i + 1) > 1:
+                j -= 1
             if j > i + 1:
                 out.append(f"\\g<{repl[i + 1:j]}>")
                 i = j
                 continue
-        out.append(c)
+        out.append(lit(c))
         i += 1
     return "".join(out)
 
