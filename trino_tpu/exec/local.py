@@ -53,6 +53,20 @@ def _hash_varchar_column(t, values, valid, capacity) -> Column:
     return Column(t, jnp.asarray(data), col_valid, None, pool)
 
 
+def _scan_column(t, raw, capacity, hashed: bool = False) -> Column:
+    """One scanned connector value -> device Column (shared by the
+    cached full-table scan and fleet split scans). ``raw`` is either a
+    host array or a (values, valid) tuple."""
+    valid = None
+    if isinstance(raw, tuple):
+        raw, valid = raw
+    if hashed:
+        return _hash_varchar_column(
+            t, np.asarray(raw, dtype=object), valid, capacity
+        )
+    return Column.from_numpy(t, raw, valid=valid, capacity=capacity)
+
+
 class QueryCancelled(RuntimeError):
     """Raised inside the executor when the query's cancel event fires
     (cooperative cancellation: in-flight device dispatches finish, the
@@ -378,7 +392,18 @@ class LocalExecutor:
 
     # ---- leaf nodes ------------------------------------------------------
 
+    def _RemoteSource(self, node: P.RemoteSource) -> Page:
+        """Pages for remote sources arrive out-of-band (fleet tasks
+        resolve spool partitions before execution — the analog of the
+        ExchangeOperator's pulled pages, MAIN/operator/ExchangeOperator.java:43)."""
+        pages = getattr(self, "remote_pages", None) or {}
+        if node.source_id not in pages:
+            raise RuntimeError(f"no pages bound for remote source {node.source_id!r}")
+        return pages[node.source_id]
+
     def _TableScan(self, node: P.TableScan) -> Page:
+        if node.split is not None:
+            return self._scan_split(node)
         key = (node.catalog, node.schema, node.table)
         if not self.metadata.connector(node.catalog).cacheable:
             cache = {}  # live views (system tables) re-scan per query
@@ -413,19 +438,10 @@ class LocalExecutor:
                 mask[:n] = True
                 cache[""] = jnp.asarray(mask)
             for sym, cname in missing:
-                v = cols[cname]
-                valid = None
-                if isinstance(v, tuple):
-                    v, valid = v
-                if sym in hashed_syms:
-                    cache[ckey(sym, cname)] = _hash_varchar_column(
-                        node.outputs[sym], np.asarray(v, dtype=object),
-                        valid, cap,
-                    )
-                else:
-                    cache[cname] = Column.from_numpy(
-                        node.outputs[sym], v, valid=valid, capacity=cap,
-                    )
+                cache[ckey(sym, cname)] = _scan_column(
+                    node.outputs[sym], cols[cname], cap,
+                    hashed=sym in hashed_syms,
+                )
             cache["#rows"] = n
         names = list(node.assignments)
         columns = [
@@ -434,6 +450,36 @@ class LocalExecutor:
         return Page(
             names, columns, cache[""],
             known_rows=cache["#rows"], packed=True,
+        )
+
+    def _scan_split(self, node: P.TableScan) -> Page:
+        """Scan one row-range split of a table (fleet-mode source
+        parallelism). Split scans are not device-cached: a worker sees
+        a different split per task, and fleet tables are read once per
+        stage wave."""
+        from trino_tpu.connectors.base import Split
+
+        start, count = node.split
+        connector = self.metadata.connector(node.catalog)
+        split = Split(node.table, start, count)
+        cols = connector.scan(
+            node.schema, node.table, list(node.assignments.values()),
+            split=split,
+        )
+        cap = pad_capacity(count)
+        hashed_syms = set(node.hash_varchar or [])
+        names, columns = [], []
+        for sym, cname in node.assignments.items():
+            names.append(sym)
+            columns.append(_scan_column(
+                node.outputs[sym], cols[cname], cap,
+                hashed=sym in hashed_syms,
+            ))
+        mask = np.zeros(cap, dtype=np.bool_)
+        mask[:count] = True
+        return Page(
+            names, columns, jnp.asarray(mask),
+            known_rows=count, packed=True,
         )
 
     def _Exchange(self, node: P.Exchange) -> Page:

@@ -30,7 +30,45 @@ import numpy as np
 
 from trino_tpu import types as T
 
-__all__ = ["StringDictionary", "HashStringPool", "HashCollision", "Column", "Page", "pad_capacity"]
+__all__ = [
+    "StringDictionary", "HashStringPool", "HashCollision", "Column",
+    "Page", "pad_capacity", "content_hash64",
+]
+
+
+def content_hash64(strings: np.ndarray) -> np.ndarray:
+    """Deterministic 64-bit content hash of a string array, vectorized.
+
+    Views the fixed-width UCS4 representation as uint32 lanes and folds
+    them with an FNV-style polynomial — one vector op per character
+    column instead of a per-row Python loop. Unlike ``hash()`` (which
+    PYTHONHASHSEED randomizes per process) the result is identical in
+    every process, so fleet workers hashing the same value — for spool
+    partitioning or HLL registers — always agree."""
+    arr = np.asarray(strings)
+    if arr.dtype.kind != "U":
+        arr = arr.astype(str)
+    n = len(arr)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    width = max(arr.dtype.itemsize // 4, 1)
+    lanes = np.ascontiguousarray(arr).view(np.uint32).reshape(n, width)
+    h = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    prime = np.uint64(0x100000001B3)
+    with np.errstate(over="ignore"):
+        for j in range(width):
+            lane = lanes[:, j].astype(np.uint64)
+            # skip zero lanes (UCS4 tail padding): the hash must not
+            # depend on the array's fixed width, or the same string in
+            # two differently-sized columns lands in different spool
+            # partitions
+            upd = (h ^ lane) * prime
+            h = np.where(lane != 0, upd, h)
+        # final avalanche so short strings spread over all 64 bits
+        h ^= h >> np.uint64(33)
+        h *= np.uint64(0xFF51AFD7ED558CCD)
+        h ^= h >> np.uint64(33)
+    return h
 
 
 def pad_capacity(n: int, minimum: int = 8) -> int:

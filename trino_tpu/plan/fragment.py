@@ -1,0 +1,126 @@
+"""Plan fragmentation for fleet mode: cut a distributed plan into a
+stage DAG at exchange boundaries.
+
+The analog of the reference's PlanFragmenter
+(MAIN/sql/planner/PlanFragmenter.java:91): the optimizer's exchanged
+plan (plan.distribute.add_exchanges) is cut at every repartitioning
+boundary into fragments; each fragment becomes a stage whose tasks run
+the fragment on workers with leaf ``RemoteSource`` nodes standing for
+upstream stage outputs read from the spooled exchange (exec.spool).
+
+Differences from the in-process mesh executor (exec.mesh): the mesh
+lowers exchanges to ICI collectives inside one program; fleet mode
+lowers them to durable hash-partitioned spool files crossing worker
+processes (the DCN/FTE tier, SURVEY.md §5.8). PARTITIONED joins —
+which the mesh repartitions internally — get explicit cut points here:
+both children become hash stages on the join keys so the join fragment
+reads co-partitioned inputs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from dataclasses import replace as dc_replace
+
+from trino_tpu.plan import nodes as P
+
+__all__ = ["StageInput", "Stage", "fragment_plan"]
+
+
+@dataclass
+class StageInput:
+    """One RemoteSource of a stage: where its pages come from."""
+
+    source_id: str
+    stage_id: str
+    #: "aligned" — task p reads partition p (hash exchange);
+    #: "all" — every task reads the producer's full output (gather /
+    #: broadcast)
+    mode: str
+
+
+@dataclass
+class Stage:
+    stage_id: str
+    root: P.PlanNode
+    #: how THIS stage's output lands in the spool: "hash" over
+    #: ``hash_symbols`` into n_partitions buckets, or "single" (one
+    #: bucket — gather and broadcast consumers read it whole)
+    partitioning: str
+    hash_symbols: list[str] = field(default_factory=list)
+    inputs: list[StageInput] = field(default_factory=list)
+
+    def scans(self) -> list[P.TableScan]:
+        out = []
+
+        def walk(n):
+            if isinstance(n, P.TableScan):
+                out.append(n)
+            for s in n.sources:
+                walk(s)
+
+        walk(self.root)
+        return out
+
+    @property
+    def aligned(self) -> bool:
+        return any(i.mode == "aligned" for i in self.inputs)
+
+
+def fragment_plan(plan: P.PlanNode) -> list[Stage]:
+    """Cut an exchanged plan into stages, children before parents.
+    The last stage is the root (single output partition)."""
+    f = _Fragmenter()
+    root = f.build(plan, "single", [])
+    assert f.stages[-1] is root
+    return f.stages
+
+
+class _Fragmenter:
+    def __init__(self):
+        self.stages: list[Stage] = []
+        self._ids = itertools.count()
+
+    def build(
+        self, node: P.PlanNode, partitioning: str, hash_symbols: list[str]
+    ) -> Stage:
+        stage = Stage(
+            stage_id=str(next(self._ids)), root=None,
+            partitioning=partitioning, hash_symbols=list(hash_symbols),
+        )
+        stage.root = self._cut(node, stage)
+        self.stages.append(stage)
+        return stage
+
+    def _remote(self, stage: Stage, child: Stage, outputs, mode: str):
+        sid = f"rs{child.stage_id}"
+        stage.inputs.append(StageInput(sid, child.stage_id, mode))
+        return P.RemoteSource(dict(outputs), source_id=sid)
+
+    def _cut(self, node: P.PlanNode, stage: Stage) -> P.PlanNode:
+        if isinstance(node, P.Exchange):
+            if node.partitioning == "hash":
+                child = self.build(node.source, "hash", node.hash_symbols)
+                return self._remote(stage, child, node.outputs, "aligned")
+            # single (gather) and broadcast both spool to one bucket;
+            # the consumer-side difference is only which tasks read it
+            child = self.build(node.source, "single", [])
+            return self._remote(stage, child, node.outputs, "all")
+        if isinstance(node, P.Join) and node.distribution == "PARTITIONED":
+            lkeys = [a for a, _ in node.criteria]
+            rkeys = [b for _, b in node.criteria]
+            lchild = self.build(node.left, "hash", lkeys)
+            rchild = self.build(node.right, "hash", rkeys)
+            return dc_replace(
+                node,
+                left=self._remote(stage, lchild, node.left.outputs, "aligned"),
+                right=self._remote(stage, rchild, node.right.outputs, "aligned"),
+            )
+        # descend
+        from trino_tpu.plan.optimizer import _replace_sources
+
+        srcs = [self._cut(s, stage) for s in node.sources]
+        if srcs:
+            node = _replace_sources(node, srcs)
+        return node

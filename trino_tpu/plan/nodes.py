@@ -19,7 +19,7 @@ from trino_tpu.expr.ir import AggCall, RowExpression
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "Join",
     "SemiJoin", "Sort", "TopN", "Limit", "Output", "Values", "Exchange",
-    "SortKey", "Window", "WindowCall", "Union", "Unnest",
+    "SortKey", "Window", "WindowCall", "Union", "Unnest", "RemoteSource",
 ]
 
 
@@ -44,6 +44,21 @@ class TableScan(PlanNode):
     #: high-NDV columns used only in equality/grouping/count contexts —
     #: skips the sorted-dictionary build)
     hash_varchar: list[str] | None = None
+    #: optional (start_row, row_count) split assigned to this scan —
+    #: the unit of source parallelism in fleet mode (the analog of a
+    #: ConnectorSplit riding a task RPC, SPI/connector/ConnectorSplit.java)
+    split: tuple[int, int] | None = None
+
+
+@dataclass
+class RemoteSource(PlanNode):
+    """Leaf standing for the output of an upstream stage, read from the
+    spooled exchange (the analog of the reference's RemoteSourceNode,
+    MAIN/sql/planner/plan/RemoteSourceNode.java: an ExchangeOperator
+    pulling pages produced by another stage's tasks). The executor is
+    handed the pages out-of-band (task inputs resolved from spool)."""
+
+    source_id: str = ""
 
 
 @dataclass

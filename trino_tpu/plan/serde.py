@@ -112,6 +112,11 @@ def plan_to_json(node: P.PlanNode) -> dict:
             assignments=list(node.assignments.items()),
             hash_varchar=node.hash_varchar,
         )
+        if node.split is not None:
+            d.update(split=list(node.split))
+        return d
+    if isinstance(node, P.RemoteSource):
+        d.update(source_id=node.source_id)
         return d
     if isinstance(node, P.Values):
         d.update(rows=node.rows)
@@ -222,7 +227,10 @@ def plan_from_json(d: dict) -> P.PlanNode:
             outputs, catalog=d["catalog"], schema=d["schema"],
             table=d["table"], assignments=dict(d["assignments"]),
             hash_varchar=d.get("hash_varchar"),
+            split=(tuple(d["split"]) if d.get("split") else None),
         )
+    if kind == "RemoteSource":
+        return P.RemoteSource(outputs, source_id=d["source_id"])
     if kind == "Values":
         return P.Values(outputs, rows=d["rows"])
     if kind == "Filter":

@@ -1,0 +1,296 @@
+"""Fleet execution: stage-wave scheduling across N worker processes
+with durable spooled stage outputs.
+
+The analog of the reference's fault-tolerant query scheduler
+(MAIN/execution/scheduler/faulttolerant/EventDrivenFaultTolerantQueryScheduler.java:200):
+the coordinator plans SQL locally, cuts the plan into stages
+(plan.fragment), and runs the stages as batch-synchronous waves.
+Every task's output is committed to the spooled exchange (exec.spool)
+before the next stage starts, so:
+
+- inter-stage data crosses worker processes through durable
+  hash-partitioned files (the DCN/FTE exchange tier, SURVEY.md §5.8) —
+  never through worker memory;
+- a task failure (or a kill -9'd worker) retries JUST that task on a
+  surviving worker, reading identical spooled inputs — the query
+  completes with oracle-exact results (TASK retry policy,
+  MAIN/execution/QueryManagerConfig.java retry-policy);
+- workers that vanish are excluded from further placement (the
+  HeartbeatFailureDetector analog collapsed into RPC-failure
+  detection, MAIN/failuredetector/HeartbeatFailureDetector.java:76).
+
+Tasks per stage: a stage with aligned (hash) inputs runs one task per
+partition; a stage scanning a table splits it into row ranges (one
+task per split, SPI/connector/ConnectorSplit.java analog); everything
+else runs as one task.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+import uuid
+from collections import deque
+from dataclasses import dataclass
+
+from trino_tpu.engine import QueryResult, QueryRunner, _has_order
+from trino_tpu.exec import spool
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.plan import nodes as P
+from trino_tpu.plan.fragment import Stage, fragment_plan
+from trino_tpu.plan.serde import plan_to_json
+from trino_tpu.server.remote import _FakeMesh
+
+__all__ = ["FleetRunner", "FleetWorker"]
+
+
+@dataclass
+class FleetWorker:
+    uri: str
+    alive: bool = True
+
+
+@dataclass
+class _TaskSpec:
+    task_id: str
+    plan_json: dict
+    partition: int | None
+    fail_first: bool = False
+
+
+class FleetRunner:
+    """QueryRunner-compatible facade scheduling stage waves over a
+    fleet of worker processes."""
+
+    def __init__(
+        self,
+        worker_uris: list[str],
+        metadata: Metadata,
+        session: Session,
+        spool_root: str,
+        n_partitions: int = 4,
+        poll_s: float = 0.02,
+        timeout_s: float = 600.0,
+        max_attempts: int = 3,
+        stage_hook=None,
+        keep_spool: bool = False,
+    ):
+        self.workers = [FleetWorker(u.rstrip("/")) for u in worker_uris]
+        self.metadata = metadata
+        self.session = session
+        self.spool_root = spool_root
+        self.n_partitions = n_partitions
+        self.poll_s = poll_s
+        self.timeout_s = timeout_s
+        self.max_attempts = max_attempts
+        #: test hook called after each stage completes (stage_id) —
+        #: deterministic point to kill a worker mid-query
+        self.stage_hook = stage_hook
+        self.keep_spool = keep_spool
+        #: task ids to fail on their first attempt (FailureInjector
+        #: analog, keyed "stage:task_index")
+        self.inject_failures: set[str] = set()
+        #: test hook called after each successful task submission
+        #: (stage_id, task_id, worker) — deterministic point to crash
+        #: the worker a task just landed on
+        self.post_hook = None
+        self._planner = QueryRunner(metadata, session)
+        self._planner.mesh = _FakeMesh(max(n_partitions, 2))
+
+    # ---- query entry -----------------------------------------------------
+
+    def execute(self, sql: str) -> QueryResult:
+        plan = self._planner.plan_sql(sql)
+        stages = fragment_plan(plan)
+        query_id = uuid.uuid4().hex[:12]
+        qroot = os.path.join(self.spool_root, query_id)
+        os.makedirs(qroot, exist_ok=True)
+        tasks_by_stage: dict[str, list[str]] = {}
+        try:
+            for stage in stages:
+                specs = self._make_tasks(stage)
+                self._run_wave(stage, specs, qroot, tasks_by_stage)
+                tasks_by_stage[stage.stage_id] = [s.task_id for s in specs]
+                if self.stage_hook is not None:
+                    self.stage_hook(stage.stage_id)
+            root = stages[-1]
+            payload = spool.read_partition(
+                qroot, root.stage_id, tasks_by_stage[root.stage_id], None
+            )
+            page = spool.host_to_page(payload)
+            rows = page.to_pylist()
+            return QueryResult(
+                names=list(page.names), rows=rows,
+                ordered=_has_order(plan), plan=plan,
+            )
+        finally:
+            if not self.keep_spool:
+                import shutil
+
+                shutil.rmtree(qroot, ignore_errors=True)
+
+    # ---- task construction -----------------------------------------------
+
+    def _make_tasks(self, stage: Stage) -> list[_TaskSpec]:
+        sid = stage.stage_id
+        if stage.aligned:
+            wire = plan_to_json(stage.root)
+            return [
+                _TaskSpec(
+                    f"s{sid}p{p}", wire, p,
+                    fail_first=f"{sid}:{p}" in self.inject_failures,
+                )
+                for p in range(self.n_partitions)
+            ]
+        scans = stage.scans()
+        if len(scans) == 1 and scans[0].split is None:
+            scan = scans[0]
+            connector = self.metadata.connector(scan.catalog)
+            n_live = max(2, sum(1 for w in self.workers if w.alive))
+            splits = connector.splits(scan.schema, scan.table, n_live)
+            specs = []
+            for i, sp in enumerate(splits):
+                bound = _bind_split(stage.root, scan, (sp.start, sp.count))
+                specs.append(
+                    _TaskSpec(
+                        f"s{sid}t{i}", plan_to_json(bound), None,
+                        fail_first=f"{sid}:{i}" in self.inject_failures,
+                    )
+                )
+            return specs
+        return [
+            _TaskSpec(
+                f"s{sid}t0", plan_to_json(stage.root), None,
+                fail_first=f"{sid}:0" in self.inject_failures,
+            )
+        ]
+
+    # ---- wave scheduling with retry --------------------------------------
+
+    def _run_wave(
+        self, stage: Stage, specs: list[_TaskSpec], qroot: str,
+        tasks_by_stage: dict[str, list[str]],
+    ) -> None:
+        pending = deque(specs)
+        inflight: dict[str, tuple[FleetWorker, _TaskSpec, int]] = {}
+        attempts = {s.task_id: 0 for s in specs}
+        done: set[str] = set()
+        deadline = time.monotonic() + self.timeout_s
+        while len(done) < len(specs):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"stage {stage.stage_id} timed out")
+            live = [w for w in self.workers if w.alive]
+            if not live:
+                raise RuntimeError("no live workers remain")
+            busy = {id(w) for (w, _, _) in inflight.values()}
+            for w in live:
+                if not pending:
+                    break
+                if id(w) in busy:
+                    continue
+                spec = pending.popleft()
+                a = attempts[spec.task_id]
+                try:
+                    self._post_task(w, stage, spec, a, qroot, tasks_by_stage)
+                    inflight[spec.task_id] = (w, spec, a)
+                    busy.add(id(w))
+                    if self.post_hook is not None:
+                        self.post_hook(stage.stage_id, spec.task_id, w)
+                except Exception:
+                    w.alive = False
+                    pending.appendleft(spec)
+            for tid, (w, spec, a) in list(inflight.items()):
+                try:
+                    state = self._poll_task(w, tid, a)
+                except Exception:
+                    # the worker vanished mid-task (crash/kill -9):
+                    # exclude it and reschedule from spooled inputs
+                    w.alive = False
+                    del inflight[tid]
+                    self._bump_attempt(spec, attempts, "worker died")
+                    pending.append(spec)
+                    continue
+                if state["state"] == "FINISHED":
+                    done.add(tid)
+                    del inflight[tid]
+                elif state["state"] == "FAILED":
+                    del inflight[tid]
+                    self._bump_attempt(
+                        spec, attempts, state.get("error", "task failed")
+                    )
+                    pending.append(spec)
+            if inflight or not pending:
+                time.sleep(self.poll_s)
+
+    def _bump_attempt(self, spec: _TaskSpec, attempts: dict, error: str):
+        attempts[spec.task_id] += 1
+        if attempts[spec.task_id] >= self.max_attempts:
+            raise RuntimeError(
+                f"task {spec.task_id} failed after "
+                f"{attempts[spec.task_id]} attempts: {error}"
+            )
+
+    # ---- worker RPC ------------------------------------------------------
+
+    def _post_task(
+        self, w: FleetWorker, stage: Stage, spec: _TaskSpec, attempt: int,
+        qroot: str, tasks_by_stage: dict[str, list[str]],
+    ) -> None:
+        req = {
+            "task_id": spec.task_id,
+            "attempt": attempt,
+            "plan": spec.plan_json,
+            "partition": spec.partition,
+            "sources": [
+                {
+                    "source_id": i.source_id,
+                    "stage_id": i.stage_id,
+                    "mode": i.mode,
+                    "task_ids": tasks_by_stage[i.stage_id],
+                }
+                for i in stage.inputs
+            ],
+            "output": {
+                "stage_id": stage.stage_id,
+                "partitioning": stage.partitioning,
+                "hash_symbols": stage.hash_symbols,
+                "n_partitions": self.n_partitions,
+            },
+            "spool": qroot,
+            "session": dict(self.session.properties),
+            "fail": bool(spec.fail_first and attempt == 0),
+        }
+        body = json.dumps(req).encode()
+        r = urllib.request.Request(
+            f"{w.uri}/v1/stagetask", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            json.loads(resp.read())
+
+    def _poll_task(self, w: FleetWorker, task_id: str, attempt: int) -> dict:
+        with urllib.request.urlopen(
+            f"{w.uri}/v1/stagetask/{task_id}.{attempt}", timeout=30
+        ) as resp:
+            return json.loads(resp.read())
+
+
+def _bind_split(
+    root: P.PlanNode, scan: P.TableScan, split: tuple[int, int]
+) -> P.PlanNode:
+    """Rebind the fragment's scan leaf to one split."""
+    from dataclasses import replace as dc_replace
+
+    from trino_tpu.plan.optimizer import _replace_sources
+
+    def walk(n: P.PlanNode) -> P.PlanNode:
+        if n is scan:
+            return dc_replace(n, split=split)
+        srcs = n.sources
+        if not srcs:
+            return n
+        return _replace_sources(n, [walk(s) for s in srcs])
+
+    return walk(root)

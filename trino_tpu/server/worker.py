@@ -36,6 +36,12 @@ class _Task:
         self.rows: list[list] = []
 
 
+class InjectedTaskFailure(RuntimeError):
+    """Coordinator-requested failure (FailureInjector analog,
+    MAIN/execution/FailureInjector.java:39) — exercises the fleet
+    retry path without killing the process."""
+
+
 class WorkerServer:
     """One worker process: a QueryRunner-owned executor behind a task
     RPC. Tasks execute serially (the engine's batch model; the
@@ -60,13 +66,29 @@ class WorkerServer:
                 self.wfile.write(body)
 
             def do_POST(self):
-                if self.path != "/v1/task":
-                    self._send(404, {"error": "not found"})
-                    return
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
-                task = worker.submit(req)
-                self._send(200, {"taskId": task.task_id})
+                if self.path == "/v1/task":
+                    task = worker.submit(req)
+                    self._send(200, {"taskId": task.task_id})
+                    return
+                if self.path == "/v1/stagetask":
+                    task = worker.submit_stage(req)
+                    self._send(200, {"taskId": task.task_id})
+                    return
+                self._send(404, {"error": "not found"})
+
+            def _task_status(self, task_id: str, with_results: bool):
+                t = worker._tasks.get(task_id)
+                if t is None:
+                    self._send(404, {"error": "no such task"})
+                    return
+                payload = {"state": t.state}
+                if t.state == "FINISHED" and with_results:
+                    payload.update(columns=t.names, data=t.rows)
+                elif t.state == "FAILED":
+                    payload.update(error=t.error)
+                self._send(200, payload)
 
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
@@ -75,16 +97,13 @@ class WorkerServer:
                     and parts[:2] == ["v1", "task"]
                     and parts[3] == "results"
                 ):
-                    t = worker._tasks.get(parts[2])
-                    if t is None:
-                        self._send(404, {"error": "no such task"})
-                        return
-                    payload = {"state": t.state}
-                    if t.state == "FINISHED":
-                        payload.update(columns=t.names, data=t.rows)
-                    elif t.state == "FAILED":
-                        payload.update(error=t.error)
-                    self._send(200, payload)
+                    self._task_status(parts[2], with_results=True)
+                    return
+                if (
+                    len(parts) == 3
+                    and parts[:2] == ["v1", "stagetask"]
+                ):
+                    self._task_status(parts[2], with_results=False)
                     return
                 if parts == ["v1", "info"]:
                     self._send(200, {
@@ -140,6 +159,74 @@ class WorkerServer:
                     finally:
                         self.runner.session.properties = saved
                 task.names, task.rows = _page_json(plan, page)
+                task.state = "FINISHED"
+            except Exception as e:
+                task.error = f"{type(e).__name__}: {e}"
+                task.state = "FAILED"
+
+        threading.Thread(target=run, daemon=True).start()
+        return task
+
+    def submit_stage(self, req: dict) -> "_Task":
+        """Execute one fleet stage task: a plan fragment whose
+        RemoteSource leaves resolve from the spooled exchange, output
+        hash-partitioned back into the spool (the worker half of the
+        FTE tier — TaskResource.createOrUpdateTask + spooled output,
+        MAIN/server/TaskResource.java:139,
+        plugin/trino-exchange-filesystem/.../FileSystemExchangeManager.java:38)."""
+        from trino_tpu.exec import spool
+
+        tkey = f"{req['task_id']}.{req['attempt']}"
+        task = _Task(tkey)
+        with self._lock:
+            self._tasks[tkey] = task
+
+        def run():
+            try:
+                if req.get("fail"):
+                    raise InjectedTaskFailure(
+                        f"injected failure for task {req['task_id']} "
+                        f"attempt {req['attempt']}"
+                    )
+                delay = float(
+                    (req.get("session") or {}).get("fleet_task_delay_ms", 0)
+                    or 0
+                )
+                if delay:
+                    # test hook: widens the window in which a crash can
+                    # interrupt a RUNNING task (BaseFailureRecoveryTest
+                    # injects timeouts the same way)
+                    import time as _time
+
+                    _time.sleep(delay / 1000.0)
+                plan = plan_from_json(req["plan"])
+                root = req["spool"]
+                partition = req.get("partition")
+                pages = {}
+                for src in req["sources"]:
+                    part = partition if src["mode"] == "aligned" else None
+                    payload = spool.read_partition(
+                        root, src["stage_id"], src["task_ids"], part
+                    )
+                    pages[src["source_id"]] = spool.host_to_page(payload)
+                out = req["output"]
+                with self.runner._lock:
+                    saved = dict(self.runner.session.properties)
+                    self.runner.session.properties.update(
+                        req.get("session") or {}
+                    )
+                    ex = self.runner.executor
+                    ex.remote_pages = pages
+                    try:
+                        page = ex.execute(plan)
+                    finally:
+                        ex.remote_pages = {}
+                        self.runner.session.properties = saved
+                spool.write_task_output(
+                    root, out["stage_id"], req["task_id"],
+                    int(req["attempt"]), page, out["partitioning"],
+                    out["hash_symbols"], int(out["n_partitions"]),
+                )
                 task.state = "FINISHED"
             except Exception as e:
                 task.error = f"{type(e).__name__}: {e}"
