@@ -166,16 +166,26 @@ def test_fleet_survives_worker_kill9(workers, spool_root, oracle):
         spool_root=spool_root, n_partitions=4,
     )
     # slow tasks widen the in-flight window; kill the victim as soon
-    # as a stage>0 task lands on it (stage 0's output is already
-    # committed to the spool — the retry must read it back)
+    # as a SECOND-wave task lands on it (the first wave's output is
+    # already committed to the spool — the retry must read it back).
+    # Stage ids are parent-first, so wave order is tracked via
+    # stage_hook, not id comparison.
     fleet.session.properties["fleet_task_delay_ms"] = 300
-    state = {"killed": False}
+    state = {"killed": False, "waves_done": 0}
+
+    def stage_hook(stage_id):
+        state["waves_done"] += 1
 
     def post_hook(stage_id, task_id, w):
-        if stage_id != "0" and not state["killed"] and str(victim_port) in w.uri:
+        if (
+            state["waves_done"] > 0
+            and not state["killed"]
+            and str(victim_port) in w.uri
+        ):
             os.kill(victim.pid, signal.SIGKILL)
             state["killed"] = True
 
+    fleet.stage_hook = stage_hook
     fleet.post_hook = post_hook
     sql = (
         "select l_returnflag, l_linestatus, sum(l_quantity), "
@@ -183,7 +193,7 @@ def test_fleet_survives_worker_kill9(workers, spool_root, oracle):
         "group by l_returnflag, l_linestatus order by 1, 2"
     )
     result = fleet.execute(sql)
-    assert state["killed"], "victim worker was never scheduled past stage 0"
+    assert state["killed"], "victim worker was never scheduled past wave 1"
     expected = oracle.execute(to_sqlite(sql)).fetchall()
     assert_rows_match(
         result.rows, expected, ordered=result.ordered, abs_tol=0.006
@@ -206,17 +216,21 @@ def test_fleet_spool_survives_producer_death(workers, spool_root, oracle):
         md, Session(catalog="tpch", schema="tiny"),
         spool_root=spool_root, n_partitions=4,
     )
-    state = {"used": False, "killed": False}
+    state = {"used": False, "killed": False, "first_wave": True}
 
     def post_hook(stage_id, task_id, w):
-        if stage_id == "0" and str(victim_port) in w.uri:
+        # victim produced part of the FIRST wave's output
+        if state["first_wave"] and str(victim_port) in w.uri:
             state["used"] = True
 
     def stage_hook(stage_id):
-        # stage 0 committed; victim's output now lives only in the spool
-        if stage_id == "0" and state["used"] and not state["killed"]:
-            os.kill(victim.pid, signal.SIGKILL)
-            state["killed"] = True
+        # first wave committed; the victim's output now lives only in
+        # the spool — kill it before any consumer stage runs
+        if state["first_wave"]:
+            state["first_wave"] = False
+            if state["used"] and not state["killed"]:
+                os.kill(victim.pid, signal.SIGKILL)
+                state["killed"] = True
 
     fleet.post_hook = post_hook
     fleet.stage_hook = stage_hook

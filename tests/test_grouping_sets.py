@@ -1,0 +1,165 @@
+"""GROUPING SETS / ROLLUP / CUBE via the GroupId plan node.
+
+The analog of the reference's GroupId tests
+(MAIN/sql/planner/plan/GroupIdNode.java, MAIN/operator/GroupIdOperator.java):
+the input replicates once per grouping set with NULLed non-member keys
+and a set-id column, one aggregation groups on (id, keys). sqlite has
+no ROLLUP/CUBE, so oracle queries are spelled as explicit UNION ALLs.
+"""
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+from trino_tpu.parallel.core import make_mesh
+from trino_tpu.testing.golden import (
+    assert_rows_match,
+    load_tpch_sqlite,
+    to_sqlite,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+@pytest.fixture(scope="module")
+def dist():
+    return QueryRunner.tpch("tiny", mesh=make_mesh(8))
+
+
+@pytest.fixture(scope="module")
+def oracle(runner):
+    data = runner.metadata.connector("tpch").data("tiny")
+    return load_tpch_sqlite(data)
+
+
+ROLLUP_SQL = (
+    "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+    "from lineitem group by rollup(l_returnflag, l_linestatus)"
+)
+ROLLUP_ORACLE = (
+    "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+    "from lineitem group by l_returnflag, l_linestatus "
+    "union all "
+    "select l_returnflag, null, sum(l_quantity), count(*) "
+    "from lineitem group by l_returnflag "
+    "union all "
+    "select null, null, sum(l_quantity), count(*) from lineitem"
+)
+
+
+def check(r, oracle, sql, oracle_sql, abs_tol=0.006):
+    result = r.execute(sql)
+    expected = oracle.execute(to_sqlite(oracle_sql)).fetchall()
+    assert_rows_match(
+        result.rows, expected, ordered=result.ordered, abs_tol=abs_tol
+    )
+
+
+def test_rollup_local(runner, oracle):
+    check(runner, oracle, ROLLUP_SQL, ROLLUP_ORACLE)
+
+
+def test_rollup_distributed(dist, oracle):
+    check(dist, oracle, ROLLUP_SQL, ROLLUP_ORACLE)
+
+
+def test_cube(runner, oracle):
+    check(
+        runner, oracle,
+        "select o_orderstatus, o_orderpriority, count(*) from orders "
+        "group by cube(o_orderstatus, o_orderpriority)",
+        "select o_orderstatus, o_orderpriority, count(*) from orders "
+        "group by o_orderstatus, o_orderpriority "
+        "union all select o_orderstatus, null, count(*) from orders "
+        "group by o_orderstatus "
+        "union all select null, o_orderpriority, count(*) from orders "
+        "group by o_orderpriority "
+        "union all select null, null, count(*) from orders",
+    )
+
+
+def test_grouping_sets_explicit(runner, oracle):
+    check(
+        runner, oracle,
+        "select l_shipmode, l_linestatus, count(*) from lineitem "
+        "group by grouping sets ((l_shipmode), (l_linestatus))",
+        "select l_shipmode, null, count(*) from lineitem group by l_shipmode "
+        "union all select null, l_linestatus, count(*) from lineitem "
+        "group by l_linestatus",
+    )
+
+
+def test_mixed_plain_and_rollup(runner, oracle):
+    # GROUP BY a, ROLLUP(b): cross product of {a} x {(b),()}
+    check(
+        runner, oracle,
+        "select l_returnflag, l_linestatus, count(*) from lineitem "
+        "group by l_returnflag, rollup(l_linestatus)",
+        "select l_returnflag, l_linestatus, count(*) from lineitem "
+        "group by l_returnflag, l_linestatus "
+        "union all select l_returnflag, null, count(*) from lineitem "
+        "group by l_returnflag",
+    )
+
+
+def test_grouping_function(runner):
+    rows = runner.execute(
+        "select l_returnflag, l_linestatus, "
+        "grouping(l_returnflag, l_linestatus) g, count(*) "
+        "from lineitem group by rollup(l_returnflag, l_linestatus) "
+        "order by 3, 1, 2"
+    ).rows
+    for rf, ls, g, _c in rows:
+        expect = (0 if rf is not None else 2) | (0 if ls is not None else 1)
+        assert g == expect, (rf, ls, g)
+
+
+def test_rollup_with_having_and_ordering(runner, oracle):
+    check(
+        runner, oracle,
+        "select l_returnflag, l_linestatus, sum(l_quantity) q "
+        "from lineitem group by rollup(l_returnflag, l_linestatus) "
+        "having count(*) > 500 order by q desc",
+        "select l_returnflag, l_linestatus, q from ("
+        "select l_returnflag, l_linestatus, sum(l_quantity) q, count(*) c "
+        "from lineitem group by l_returnflag, l_linestatus "
+        "union all select l_returnflag, null, sum(l_quantity), count(*) "
+        "from lineitem group by l_returnflag "
+        "union all select null, null, sum(l_quantity), count(*) "
+        "from lineitem) where c > 500 order by q desc",
+    )
+
+
+def test_real_null_vs_grouped_out_null():
+    """A real NULL key value must stay distinct from a NULLed-out key
+    (the GroupId id column keeps sets apart)."""
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.metadata import Metadata, Session
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table t (a varchar, b bigint)")
+    r.execute(
+        "insert into t values ('x', 1), (null, 2), ('x', 3), (null, 4)"
+    )
+    rows = r.execute(
+        "select a, sum(b), grouping(a) from t group by rollup(a) "
+        "order by 3, 1"
+    ).rows
+    # set 0: groups 'x' (1+3) and NULL (2+4); set 1: grand total 10
+    assert rows == [("x", 4, 0), (None, 6, 0), (None, 10, 1)]
+
+
+def test_rollup_fleet_serde_roundtrip(runner):
+    """GroupId plans survive the JSON wire format (fleet workers
+    deserialize them)."""
+    import json
+
+    from trino_tpu.plan.serde import plan_from_json, plan_to_json
+
+    plan = runner.plan_sql(ROLLUP_SQL)
+    back = plan_from_json(json.loads(json.dumps(plan_to_json(plan))))
+    assert repr(back) == repr(plan)

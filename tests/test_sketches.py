@@ -1,0 +1,200 @@
+"""Sketch aggregates: HLL approx_distinct and mergeable
+approx_percentile across the local, distributed (8-device mesh) and
+chunked (HBM-budget) execution tiers.
+
+The analog of the reference's approximate-aggregation tests
+(MAIN/operator/aggregation/ApproximateCountDistinctAggregations.java,
+ApproximateDoublePercentileAggregations.java): the partial state is a
+CONSTANT-size register array / quantile summary per group — bounded
+bytes through every exchange regardless of NDV — and partial states
+merge associatively, so distributed and chunked runs agree with the
+single-pass estimate.
+"""
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+from trino_tpu.parallel.core import make_mesh
+
+
+@pytest.fixture(scope="module")
+def local():
+    return QueryRunner.tpch("tiny")
+
+
+@pytest.fixture(scope="module")
+def dist():
+    return QueryRunner.tpch("tiny", mesh=make_mesh(8))
+
+
+def _one(runner, sql):
+    return runner.execute(sql).rows[0][0]
+
+
+def test_approx_distinct_distributed_matches_local(local, dist):
+    """Partial/final HLL merge across the mesh must equal the
+    single-pass estimate exactly (same registers, same hashes)."""
+    for col, table in (
+        ("o_custkey", "orders"),
+        ("l_comment", "lineitem"),     # dictionary varchar
+        ("o_comment", "orders"),
+    ):
+        sql = f"select approx_distinct({col}) from {table}"
+        assert _one(local, sql) == _one(dist, sql), col
+
+
+def test_approx_distinct_error_bound(local):
+    """<=2% error on the high-NDV comment columns (HLL m=4096,
+    rse 1.6%; data and hashes are deterministic so this is a stable
+    regression bound, not a statistical gamble)."""
+    for col, table in (("l_comment", "lineitem"), ("o_comment", "orders")):
+        est = _one(local, f"select approx_distinct({col}) from {table}")
+        exact = _one(
+            local, f"select count(distinct {col}) from {table}"
+        )
+        assert abs(est - exact) <= 0.02 * exact, (col, est, exact)
+
+
+def test_approx_distinct_partial_state_is_bounded(local):
+    """The distributed plan's exchange carries HLL register columns
+    (SketchType), never O(NDV) rows."""
+    from trino_tpu import types as T
+    from trino_tpu.plan import nodes as P
+    from trino_tpu.plan.distribute import add_exchanges
+
+    plan = local.plan_sql(
+        "select o_orderstatus, approx_distinct(o_comment) from orders "
+        "group by o_orderstatus"
+    )
+    dplan = add_exchanges(plan, local.metadata, 8, local.session)
+
+    found = []
+
+    def walk(n):
+        if isinstance(n, P.Aggregate) and n.step == "PARTIAL":
+            found.extend(
+                a.type for a in n.aggregates.values()
+                if isinstance(a.type, T.SketchType)
+            )
+        for s in n.sources:
+            walk(s)
+
+    walk(dplan)
+    assert found and all(t.kind == "hll" for t in found)
+
+
+def test_approx_distinct_chunked(local):
+    """Streamed/chunked execution under an HBM budget goes through the
+    same partial/final split; the estimate must match resident mode."""
+    sql = "select approx_distinct(l_partkey) from lineitem"
+    resident = _one(local, sql)
+    budget = QueryRunner.tpch("tiny")
+    budget.session.properties["hbm_budget_bytes"] = 4 << 20
+    assert _one(budget, sql) == resident
+
+
+def test_approx_distinct_distributed_grouped(local, dist):
+    sql = (
+        "select l_shipmode, approx_distinct(l_orderkey) from lineitem "
+        "group by l_shipmode order by 1"
+    )
+    exact = dict(local.execute(
+        "select l_shipmode, count(distinct l_orderkey) from lineitem "
+        "group by l_shipmode order by 1"
+    ).rows)
+    for mode, est in dist.execute(sql).rows:
+        e = exact[mode]
+        # grouped registers are 512-wide (rse ~4.6%)
+        assert abs(est - e) <= max(0.15 * e, 3), (mode, est, e)
+
+
+def test_approx_percentile_distributed(local, dist):
+    """The distributed plan splits into summary partials + a weighted
+    merge; the result must stay within the summary's rank-error bound
+    of the exact percentile."""
+    import numpy as np
+
+    data = local.metadata.connector("tpch").data("tiny")
+    vals = np.sort(np.asarray(data.column("lineitem", "l_extendedprice")))
+    for q in (0.1, 0.5, 0.9):
+        got = _one(
+            dist,
+            f"select approx_percentile(l_extendedprice, {q}) from lineitem",
+        )
+        # rank-error bound: 8 shards x (count/1024) per shard
+        eps = 8 * len(vals) // 1024 + 1
+        r = round(q * (len(vals) - 1))
+        lo = vals[max(r - eps, 0)]
+        hi = vals[min(r + eps, len(vals) - 1)]
+        from decimal import Decimal
+
+        lo_d = Decimal(int(lo)).scaleb(-2)
+        hi_d = Decimal(int(hi)).scaleb(-2)
+        assert lo_d <= got <= hi_d, (q, got, lo_d, hi_d)
+
+
+def test_approx_percentile_distributed_grouped(dist, local):
+    import numpy as np
+
+    data = local.metadata.connector("tpch").data("tiny")
+    qty = np.asarray(data.column("lineitem", "l_quantity"))
+    ln = np.asarray(data.column("lineitem", "l_linenumber"))
+    rows = dist.execute(
+        "select l_linenumber, approx_percentile(l_quantity, 0.5) "
+        "from lineitem group by l_linenumber order by 1"
+    ).rows
+    from decimal import Decimal
+
+    for lnum, got in rows:
+        s = np.sort(qty[ln == lnum])
+        eps = 8 * len(s) // 256 + 1
+        r = round(0.5 * (len(s) - 1))
+        lo = Decimal(int(s[max(r - eps, 0)])).scaleb(-2)
+        hi = Decimal(int(s[min(r + eps, len(s) - 1)])).scaleb(-2)
+        assert lo <= got <= hi, (lnum, got, lo, hi)
+
+
+def test_approx_percentile_chunked(local):
+    """approx_percentile is now splittable: the chunked tier keeps
+    partial summaries instead of materializing all raw values."""
+    sql = "select approx_percentile(l_extendedprice, 0.5) from lineitem"
+    import numpy as np
+
+    data = local.metadata.connector("tpch").data("tiny")
+    vals = np.sort(np.asarray(data.column("lineitem", "l_extendedprice")))
+    budget = QueryRunner.tpch("tiny")
+    budget.session.properties["hbm_budget_bytes"] = 4 << 20
+    got = _one(budget, sql)
+    r = round(0.5 * (len(vals) - 1))
+    eps = 64 * len(vals) // 1024 + 1  # many chunks x per-chunk error
+    from decimal import Decimal
+
+    lo = Decimal(int(vals[max(r - eps, 0)])).scaleb(-2)
+    hi = Decimal(int(vals[min(r + eps, len(vals) - 1)])).scaleb(-2)
+    assert lo <= got <= hi, (got, lo, hi)
+
+
+def test_approx_distinct_nulls_and_filter():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.metadata import Metadata, Session
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table t (g bigint, v bigint)")
+    r.execute(
+        "insert into t values (1, 10), (1, 10), (1, null), (2, 7), "
+        "(2, 8), (2, null)"
+    )
+    rows = dict(r.execute(
+        "select g, approx_distinct(v) from t group by g"
+    ).rows)
+    assert rows == {1: 1, 2: 2}
+    (f,) = r.execute(
+        "select approx_distinct(v) from t where g = 2"
+    ).rows[0]
+    assert f == 2
+    (z,) = r.execute(
+        "select approx_distinct(v) from t where g = 99"
+    ).rows[0]
+    assert z == 0

@@ -1,0 +1,154 @@
+"""Skew-proof distributed aggregation: hot group keys must not
+escalate (or overflow) the exchange.
+
+The raw-row routes the round-3 VERDICT flagged — DISTINCT aggregates
+and max_by/min_by exchanged raw rows hashed on the group keys, so a
+90%-one-key GROUP BY sent 90% of rows to one shard, escalated the
+exchange buckets to shard capacity, and died with SkewOverflow —
+are replaced by:
+- two-level distinct: exchange on (group keys + distinct column),
+  global dedupe, then a partial/final exchange on the group keys
+  (reference: pre-aggregation + MarkDistinct before the exchange);
+- max_by/min_by partial/final split (one pair per shard per group).
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.parallel.core import make_mesh
+
+
+@pytest.fixture(scope="module")
+def skewed_runner():
+    """A memory table where 90% of rows share one group key."""
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(
+        md, Session(catalog="memory", schema="default"), mesh=make_mesh(8)
+    )
+    r.execute("create table skewed (g bigint, v bigint, w varchar)")
+    rng = np.random.default_rng(11)
+    n = 40_000
+    g = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 50, n))
+    v = rng.integers(0, 5_000, n)
+    w = np.array(["w%03d" % x for x in rng.integers(0, 300, n)], dtype=object)
+    conn = md.connector("memory")
+    conn.insert("default", "skewed", {
+        "g": (g.astype(np.int64), None),
+        "v": (v.astype(np.int64), None),
+        "w": (w, None),
+    })
+    return r
+
+
+def test_skewed_distinct_group_by(skewed_runner):
+    r = skewed_runner
+    r.executor.exchange_escalations = 0
+    rows = dict(r.execute(
+        "select g, count(distinct v) from skewed group by g"
+    ).rows)
+    # oracle: host-side exact
+    conn = r.metadata.connector("memory")
+    cols = conn.scan("default", "skewed", ["g", "v"])
+    g = cols["g"][0] if isinstance(cols["g"], tuple) else cols["g"]
+    v = cols["v"][0] if isinstance(cols["v"], tuple) else cols["v"]
+    import collections
+
+    exact = collections.defaultdict(set)
+    for gi, vi in zip(g, v):
+        exact[int(gi)].add(int(vi))
+    assert rows == {k: len(s) for k, s in exact.items()}
+    assert r.executor.exchange_escalations == 0, (
+        "hot-key distinct GROUP BY escalated the exchange"
+    )
+
+
+def test_skewed_distinct_varchar(skewed_runner):
+    r = skewed_runner
+    r.executor.exchange_escalations = 0
+    rows = dict(r.execute(
+        "select g, count(distinct w) from skewed group by g"
+    ).rows)
+    conn = r.metadata.connector("memory")
+    cols = conn.scan("default", "skewed", ["g", "w"])
+    g = cols["g"][0] if isinstance(cols["g"], tuple) else cols["g"]
+    w = cols["w"][0] if isinstance(cols["w"], tuple) else cols["w"]
+    import collections
+
+    exact = collections.defaultdict(set)
+    for gi, wi in zip(g, w):
+        exact[int(gi)].add(str(wi))
+    assert rows == {k: len(s) for k, s in exact.items()}
+    assert r.executor.exchange_escalations == 0
+
+
+def test_skewed_max_by_group_by(skewed_runner):
+    """max_by now splits partial/final: one pair per shard per group
+    rides the exchange instead of raw rows."""
+    r = skewed_runner
+    r.executor.exchange_escalations = 0
+    rows = dict(r.execute(
+        "select g, max_by(w, v) from skewed group by g"
+    ).rows)
+    conn = r.metadata.connector("memory")
+    cols = conn.scan("default", "skewed", ["g", "v", "w"])
+    g = cols["g"][0] if isinstance(cols["g"], tuple) else cols["g"]
+    v = cols["v"][0] if isinstance(cols["v"], tuple) else cols["v"]
+    w = cols["w"][0] if isinstance(cols["w"], tuple) else cols["w"]
+    best: dict = {}
+    for gi, vi, wi in zip(g, v, w):
+        k = int(gi)
+        if k not in best or vi > best[k][0]:
+            best[k] = (vi, str(wi))
+    # ties on v are arbitrary (Trino semantics): compare the v, and
+    # check w is one of the argmax values
+    for k, got in rows.items():
+        vmax, _ = best[k]
+        candidates = {
+            str(wi) for gi, vi, wi in zip(g, v, w)
+            if int(gi) == k and vi == vmax
+        }
+        assert got in candidates, (k, got)
+    assert r.executor.exchange_escalations == 0
+
+
+def test_skewed_semi_join(skewed_runner):
+    """Semi joins broadcast the filter side — a hot probe key never
+    exchanges at all; verify exactness + no escalation."""
+    r = skewed_runner
+    r.executor.exchange_escalations = 0
+    (cnt,) = r.execute(
+        "select count(*) from skewed where v in "
+        "(select v from skewed where g = 7 and v < 100)"
+    ).rows[0]
+    conn = r.metadata.connector("memory")
+    cols = conn.scan("default", "skewed", ["g", "v"])
+    g = cols["g"][0] if isinstance(cols["g"], tuple) else cols["g"]
+    v = cols["v"][0] if isinstance(cols["v"], tuple) else cols["v"]
+    member = {int(vi) for gi, vi in zip(g, v) if gi == 7 and vi < 100}
+    assert cnt == sum(1 for vi in v if int(vi) in member)
+    assert r.executor.exchange_escalations == 0
+
+
+def test_two_level_distinct_plan_shape(skewed_runner):
+    """The distinct plan must exchange on (group key + distinct col)
+    first, then on the group key — never raw rows on the hot key."""
+    from trino_tpu.plan import nodes as P
+
+    plan = skewed_runner.plan_sql(
+        "select g, count(distinct v) from skewed group by g"
+    )
+    exchanges = []
+
+    def walk(n):
+        if isinstance(n, P.Exchange) and n.partitioning == "hash":
+            exchanges.append(tuple(n.hash_symbols))
+        for s in n.sources:
+            walk(s)
+
+    walk(plan)
+    assert len(exchanges) == 2, exchanges
+    assert len(exchanges[1]) == 2 or len(exchanges[0]) == 2, exchanges

@@ -283,6 +283,7 @@ class Analyzer:
 
     def _order_keys(self, order_by, node, scope: Scope, alias_syms: dict):
         keys = []
+        extras: dict[str, RowExpression] = {}
         for item in order_by:
             e = item.expr
             sym = None
@@ -296,15 +297,27 @@ class Analyzer:
             elif _ast_key(e) in alias_syms:
                 sym = alias_syms[_ast_key(e)]
             else:
+                # general expression over the output columns (the
+                # reference's OrderingScheme allows any expression over
+                # the query's output scope): compute it in a pre-sort
+                # Project; the Output node above prunes it afterwards
                 ea = ExprAnalyzer(self, scope)
                 ir = ea.analyze(e)
                 if isinstance(ir, InputRef) and ir.name in node.outputs:
                     sym = ir.name
                 else:
-                    raise AnalysisError(
-                        f"ORDER BY expression must be a select item: {e!r}"
-                    )
+                    sym = self.symbols.new("orderkey", ir.type)
+                    extras[sym] = ir
             keys.append(P.SortKey(sym, item.ascending, item.nulls_first))
+        if extras:
+            assignments: dict[str, RowExpression] = {
+                s: InputRef(t, s) for s, t in node.outputs.items()
+            }
+            assignments.update(extras)
+            node = P.Project(
+                {s: x.type for s, x in assignments.items()},
+                source=node, assignments=assignments,
+            )
         return keys, node
 
     # ---- select ----------------------------------------------------------
@@ -835,18 +848,26 @@ class Analyzer:
         return node, new_syms
 
     def _plan_aggregation(self, node, scope, sel, agg_items, ctes, outer_refs):
-        # group keys
+        # group keys. Each GROUP BY element contributes a list of
+        # candidate sets; their cross product is the effective grouping
+        # sets (PARSER grammar semantics: GROUP BY a, ROLLUP(b,c) =
+        # sets {a,b,c},{a,b},{a}).
         group_syms: list[str] = []
         key_replacements: dict[str, InputRef] = {}
         pre_assignments: dict[str, RowExpression] = {
             s: InputRef(t, s) for s, t in node.outputs.items()
         }
         need_pre_project = False
-        for g in sel.group_by:
+
+        def key_sym(g):
+            nonlocal need_pre_project
             if isinstance(g, ast.IntLit):  # ordinal
                 if not (1 <= g.value <= len(sel.items)):
                     raise AnalysisError(f"GROUP BY position {g.value} out of range")
                 g = sel.items[g.value - 1].expr
+            k = _ast_key(g)
+            if k in key_replacements:
+                return key_replacements[k].name
             ea = ExprAnalyzer(self, scope, outer_refs=outer_refs)
             ir = ea.analyze(g)
             if isinstance(ir, InputRef):
@@ -855,13 +876,72 @@ class Analyzer:
                 sym = self.symbols.new("group", ir.type)
                 pre_assignments[sym] = ir
                 need_pre_project = True
-            group_syms.append(sym)
-            key_replacements[_ast_key(g)] = InputRef(ir.type, sym)
+            key_replacements[k] = InputRef(ir.type, sym)
+            return sym
+
+        #: per element: list of symbol-lists (the element's sets)
+        element_sets: list[list[list[str]]] = []
+        for g in sel.group_by:
+            if isinstance(g, ast.GroupingElement):
+                if g.kind == "rollup":
+                    syms = [key_sym(e) for e in g.exprs]
+                    element_sets.append(
+                        [syms[:i] for i in range(len(syms), -1, -1)]
+                    )
+                elif g.kind == "cube":
+                    syms = [key_sym(e) for e in g.exprs]
+                    sets = [
+                        [s for j, s in enumerate(syms) if mask & (1 << j)]
+                        for mask in range((1 << len(syms)) - 1, -1, -1)
+                    ]
+                    element_sets.append(sets)
+                else:  # explicit GROUPING SETS
+                    element_sets.append(
+                        [[key_sym(e) for e in st] for st in g.sets]
+                    )
+            else:
+                element_sets.append([[key_sym(g)]])
+        # cross product across elements
+        grouping_sets: list[list[str]] = [[]]
+        for sets in element_sets:
+            grouping_sets = [
+                prefix + s for prefix in grouping_sets for s in sets
+            ]
+        # all distinct keys, in first-appearance order
+        group_syms = list(dict.fromkeys(
+            s for st in grouping_sets for s in st
+        ))
+        multi_sets = len(grouping_sets) > 1
         if need_pre_project:
             node = P.Project(
                 {s: e.type for s, e in pre_assignments.items()},
                 source=node, assignments=pre_assignments,
             )
+        groupid_sym = None
+        pre_node = node
+        n_nonempty = len(grouping_sets)
+        if multi_sets:
+            # dedupe keys WITHIN each set (GROUP BY a, ROLLUP(a,b)),
+            # and order non-empty sets first: empty (global) sets plan
+            # as separate global-aggregate branches below so they emit
+            # exactly one row even over EMPTY input (the reference's
+            # AggregationNode.globalGroupingSets semantics)
+            grouping_sets = [
+                list(dict.fromkeys(st)) for st in grouping_sets
+            ]
+            grouping_sets = (
+                [st for st in grouping_sets if st]
+                + [st for st in grouping_sets if not st]
+            )
+            n_nonempty = sum(1 for st in grouping_sets if st)
+            groupid_sym = self.symbols.new("groupid", T.BIGINT)
+            if n_nonempty:
+                node = P.GroupId(
+                    {**dict(node.outputs), groupid_sym: T.BIGINT},
+                    source=node,
+                    grouping_sets=grouping_sets[:n_nonempty],
+                    id_symbol=groupid_sym,
+                )
         # aggregate calls
         aggs: dict[str, AggCall] = {}
         replacements = dict(key_replacements)
@@ -875,12 +955,14 @@ class Analyzer:
                 if name == "count":
                     call = AggCall("count", args, T.BIGINT, distinct=fc.distinct)
                 elif name == "approx_distinct":
-                    # exact distinct count satisfies approx semantics
-                    # (reference: ApproximateCountDistinctAggregation);
-                    # the optional max-standard-error argument is
-                    # irrelevant for an exact count
+                    # HLL sketch aggregation (reference:
+                    # ApproximateCountDistinctAggregations.java):
+                    # O(registers) state instead of O(NDV) — the
+                    # optional max-standard-error argument is accepted
+                    # and ignored (register count is fixed per plan
+                    # shape, exec.aggregates HLL_*_BUCKETS)
                     call = AggCall(
-                        "count", args[:1], T.BIGINT, distinct=True
+                        "approx_distinct", args[:1], T.BIGINT
                     )
                 elif name == "approx_percentile":
                     if len(args) != 2:
@@ -937,15 +1019,121 @@ class Analyzer:
             sym = self.symbols.new(name, call.type)
             aggs[sym] = call
             replacements[_ast_key(fc)] = InputRef(call.type, sym)
-        outputs = {s: self.symbols.types[s] for s in group_syms}
-        outputs.update({s: a.type for s, a in aggs.items()})
-        node = P.Aggregate(
-            outputs, source=node, group_keys=group_syms, aggregates=aggs
+        agg_keys = (
+            [groupid_sym] + group_syms if multi_sets else group_syms
         )
+        outputs = {s: self.symbols.types[s] for s in agg_keys}
+        outputs.update({s: a.type for s, a in aggs.items()})
+        if multi_sets and n_nonempty == 0:
+            node = None
+        else:
+            node = P.Aggregate(
+                outputs, source=node, group_keys=agg_keys, aggregates=aggs
+            )
+        if multi_sets and n_nonempty < len(grouping_sets):
+            # one global-aggregate branch per empty set, projected into
+            # the main output layout with its constant set id and NULL
+            # keys, then UNION ALLed with the GroupId aggregation
+            branches = [] if node is None else [node]
+            for j in range(n_nonempty, len(grouping_sets)):
+                fresh = {
+                    self.symbols.new("gagg", call.type): (sym, call)
+                    for sym, call in aggs.items()
+                }
+                glob = P.Aggregate(
+                    {fs: call.type for fs, (_s, call) in fresh.items()},
+                    source=pre_node, group_keys=[],
+                    aggregates={fs: call for fs, (_s, call) in fresh.items()},
+                )
+                assignments: dict[str, RowExpression] = {
+                    groupid_sym: Literal(T.BIGINT, j)
+                }
+                for s in group_syms:
+                    assignments[s] = Literal(self.symbols.types[s], None)
+                for fs, (sym, _call) in fresh.items():
+                    assignments[sym] = InputRef(self.symbols.types[fs], fs)
+                branches.append(P.Project(
+                    dict(outputs), source=glob,
+                    assignments={s: assignments[s] for s in outputs},
+                ))
+            if len(branches) == 1:
+                node = branches[0]
+            else:
+                node = P.Union(
+                    dict(outputs), all_sources=branches,
+                    symbol_map={s: [s] * len(branches) for s in outputs},
+                )
+        if multi_sets:
+            # grouping(c1..cn) -> bitmask from the set id (bit i set
+            # when ci is NOT in the row's grouping set — reference
+            # semantics, MAIN/sql/analyzer/GroupingOperationRewriter):
+            # a constant per set, compiled as nested ifs over $groupid
+            for fc in self._collect_grouping_calls(sel):
+                arg_syms = []
+                for a in fc.args:
+                    k = _ast_key(a)
+                    if k not in key_replacements:
+                        raise AnalysisError(
+                            "grouping() arguments must be grouping "
+                            "columns"
+                        )
+                    arg_syms.append(key_replacements[k].name)
+                gid = InputRef(T.BIGINT, groupid_sym)
+                expr: RowExpression = Literal(T.BIGINT, 0)
+                n_args = len(arg_syms)
+                for i, st in enumerate(grouping_sets):
+                    bits = 0
+                    for j, s in enumerate(arg_syms):
+                        if s not in st:
+                            bits |= 1 << (n_args - 1 - j)
+                    expr = Call(
+                        T.BIGINT, "if",
+                        (
+                            Call(T.BOOLEAN, "eq", (gid, Literal(T.BIGINT, i))),
+                            Literal(T.BIGINT, bits),
+                            expr,
+                        ),
+                    )
+                replacements[_ast_key(fc)] = expr
         # scope keeps all fields so that references to ungrouped columns
         # produce a "must appear in GROUP BY" error (via restrict_to)
         # instead of a resolution failure
-        return node, scope, replacements, group_syms
+        return node, scope, replacements, (
+            agg_keys if multi_sets else group_syms
+        )
+
+    def _collect_grouping_calls(self, sel: ast.Select) -> list[ast.FnCall]:
+        found: list[ast.FnCall] = []
+        seen: set[str] = set()
+
+        def walk(e):
+            if isinstance(e, ast.FnCall) and e.name.lower() == "grouping" \
+                    and e.over is None and not e.star:
+                k = _ast_key(e)
+                if k not in seen:
+                    seen.add(k)
+                    found.append(e)
+                return
+            if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+                return
+            for v in vars(e).values() if hasattr(e, "__dict__") else []:
+                if isinstance(v, ast.Expr):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if isinstance(x, ast.Expr):
+                            walk(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, ast.Expr):
+                                    walk(y)
+
+        for item in sel.items:
+            if not isinstance(item.expr, ast.Star):
+                walk(item.expr)
+        if sel.having is not None:
+            walk(sel.having)
+        return found
 
 
 class SubqueryPlanner:

@@ -407,7 +407,10 @@ class TpcdsData:
         # structural defaults by column-name convention
         if name.endswith("_sk") and name == _sk_name(table):
             return np.arange(1, n + 1, dtype=np.int64)
-        if name.endswith("_id"):
+        if name.endswith("_id") and isinstance(typ, T.VarcharType):
+            # business-key strings only for VARCHAR ids; numeric *_id
+            # columns (market_id, brand_id, manager_id...) fall through
+            # to the integer generator
             return np.array(
                 [f"{prefix.upper()}{i:012d}" for i in range(1, n + 1)],
                 dtype=object,

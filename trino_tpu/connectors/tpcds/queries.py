@@ -320,3 +320,693 @@ group by w_warehouse_name, sm_type, cc_name
 order by 1, 2, 3
 limit 100
 """
+
+
+# ---- round-4 additions: rollup family + broad coverage (restated spec
+# queries, parameters aligned to the generator calendar/domains) ----
+QUERIES["q12"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) itemrevenue,
+       sum(ws_ext_sales_price) * 100 / sum(sum(ws_ext_sales_price))
+           over (partition by i_class) revenueratio
+from web_sales, item, date_dim
+where ws_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ws_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-02-22' + interval '30' day
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""
+QUERIES["q15"] = """
+select ca_zip, sum(cs_sales_price)
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and (substr(ca_zip, 1, 5) in ('85669','86197','88274','83405','86475',
+                                '85392','85460','80348','81792')
+       or ca_state in ('CA','WA','GA')
+       or cs_sales_price > 500)
+  and cs_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+group by ca_zip
+order by ca_zip
+limit 100
+"""
+QUERIES["q18"] = """
+select i_item_id, ca_country, ca_state, ca_county,
+       avg(cast(cs_quantity as double)) agg1,
+       avg(cast(cs_list_price as double)) agg2,
+       avg(cast(cs_coupon_amt as double)) agg3,
+       avg(cast(cs_sales_price as double)) agg4,
+       avg(cast(cs_net_profit as double)) agg5,
+       avg(cast(c_birth_year as double)) agg6,
+       avg(cast(cd1.cd_dep_count as double)) agg7
+from catalog_sales, customer_demographics cd1,
+     customer_demographics cd2, customer, customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk
+  and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd1.cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd1.cd_gender = 'F'
+  and cd1.cd_education_status = 'Unknown'
+  and c_current_cdemo_sk = cd2.cd_demo_sk
+  and c_current_addr_sk = ca_address_sk
+  and c_birth_month in (1, 6, 8, 9, 12, 2)
+  and d_year = 1998
+  and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA')
+group by rollup(i_item_id, ca_country, ca_state, ca_county)
+order by ca_country, ca_state, ca_county, i_item_id
+limit 100
+"""
+QUERIES["q20"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(cs_ext_sales_price) itemrevenue,
+       sum(cs_ext_sales_price) * 100 / sum(sum(cs_ext_sales_price))
+           over (partition by i_class) revenueratio
+from catalog_sales, item, date_dim
+where cs_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-02-22' + interval '30' day
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""
+QUERIES["q22"] = """
+select i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk
+  and inv_item_sk = i_item_sk
+  and d_month_seq between 108 and 119
+group by rollup(i_product_name, i_brand, i_class, i_category)
+order by qoh, i_product_name, i_brand, i_class, i_category
+limit 100
+"""
+QUERIES["q26"] = """
+select i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+from catalog_sales, customer_demographics, date_dim, item, promotion
+where cs_sold_date_sk = d_date_sk
+  and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+QUERIES["q27"] = """
+select i_item_id, s_state, grouping(s_state) g_state,
+       avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002
+  and s_state in ('TN', 'TX', 'NE', 'MS')
+group by rollup(i_item_id, s_state)
+order by i_item_id, s_state
+limit 100
+"""
+QUERIES["q34"] = """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and (date_dim.d_dom between 1 and 3 or date_dim.d_dom between 25 and 28)
+        and (household_demographics.hd_buy_potential = '>10000'
+             or household_demographics.hd_buy_potential = 'Unknown')
+        and household_demographics.hd_vehicle_count > 0
+        and (case when household_demographics.hd_vehicle_count > 0
+             then cast(household_demographics.hd_dep_count as double)
+                  / household_demographics.hd_vehicle_count
+             else null end) > 1.2
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_county in ('Williamson County', 'Barrow County')
+      group by ss_ticket_number, ss_customer_sk) dn, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 2 and 20
+order by c_last_name, c_first_name, c_salutation,
+         c_preferred_cust_flag desc, ss_ticket_number
+"""
+QUERIES["q36"] = """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ss_net_profit) / sum(ss_ext_sales_price))
+           rank_within_parent
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and s_state in ('TN', 'TX', 'NE', 'MS')
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent, i_category, i_class
+limit 100
+"""
+QUERIES["q43"] = """
+select s_store_name, s_store_id,
+       sum(case when d_day_name = 'Sunday' then ss_sales_price else null end) sun_sales,
+       sum(case when d_day_name = 'Monday' then ss_sales_price else null end) mon_sales,
+       sum(case when d_day_name = 'Tuesday' then ss_sales_price else null end) tue_sales,
+       sum(case when d_day_name = 'Wednesday' then ss_sales_price else null end) wed_sales,
+       sum(case when d_day_name = 'Thursday' then ss_sales_price else null end) thu_sales,
+       sum(case when d_day_name = 'Friday' then ss_sales_price else null end) fri_sales,
+       sum(case when d_day_name = 'Saturday' then ss_sales_price else null end) sat_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and s_gmt_offset > 0
+  and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id, sun_sales, mon_sales, tue_sales,
+         wed_sales, thu_sales, fri_sales, sat_sales
+limit 100
+"""
+QUERIES["q46"] = """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       amt, profit
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and store_sales.ss_addr_sk = customer_address.ca_address_sk
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and date_dim.d_dow in (6, 0)
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_city in ('Georgetown', 'Greenville', 'Union')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number
+limit 100
+"""
+QUERIES["q53"] = """
+select * from (
+  select i_manufact_id, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_manufact_id)
+             avg_quarterly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_month_seq between 108 and 119
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('fiction', 'kids', 'computers'))
+         or (i_category in ('Women', 'Music', 'Men')
+             and i_class in ('accessories', 'classical', 'pants')))
+  group by i_manufact_id, d_qoy) tmp1
+where case when avg_quarterly_sales > 0
+      then abs(sum_sales - avg_quarterly_sales) / avg_quarterly_sales
+      else null end > 0.1
+order by avg_quarterly_sales, sum_sales, i_manufact_id
+limit 100
+"""
+QUERIES["q63"] = """
+select * from (
+  select i_manager_id, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_manager_id)
+             avg_monthly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_month_seq between 108 and 119
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('fiction', 'kids', 'computers'))
+         or (i_category in ('Women', 'Music', 'Men')
+             and i_class in ('accessories', 'classical', 'pants')))
+  group by i_manager_id, d_moy) tmp1
+where case when avg_monthly_sales > 0
+      then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+      else null end > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales
+limit 100
+"""
+QUERIES["q65"] = """
+select s_store_name, i_item_desc, sc.revenue, i_current_price,
+       i_wholesale_cost, i_brand
+from store, item,
+     (select ss_store_sk, avg(revenue) ave
+      from (select ss_store_sk, ss_item_sk, sum(ss_sales_price) revenue
+            from store_sales, date_dim
+            where ss_sold_date_sk = d_date_sk
+              and d_month_seq between 108 and 119
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) revenue
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk
+        and d_month_seq between 108 and 119
+      group by ss_store_sk, ss_item_sk) sc
+where sb.ss_store_sk = sc.ss_store_sk
+  and sc.revenue <= 0.1 * sb.ave
+  and s_store_sk = sc.ss_store_sk
+  and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_desc, i_brand, sc.revenue
+limit 100
+"""
+QUERIES["q73"] = """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and date_dim.d_dom between 1 and 2
+        and (household_demographics.hd_buy_potential = '>10000'
+             or household_demographics.hd_buy_potential = 'Unknown')
+        and household_demographics.hd_vehicle_count > 0
+        and (case when household_demographics.hd_vehicle_count > 0
+             then cast(household_demographics.hd_dep_count as double)
+                  / household_demographics.hd_vehicle_count
+             else null end) > 1
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_county in ('Williamson County', 'Furnas County')
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 1 and 5
+order by cnt desc, c_last_name, ss_ticket_number
+"""
+QUERIES["q79"] = """
+select c_last_name, c_first_name, substr(s_city, 1, 30), ss_ticket_number,
+       amt, profit
+from (select ss_ticket_number, ss_customer_sk, store.s_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and (household_demographics.hd_dep_count = 6
+             or household_demographics.hd_vehicle_count > 2)
+        and date_dim.d_dow = 1
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_number_employees between 40 and 400
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, store.s_city) ms,
+     customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, substr(s_city, 1, 30), profit
+limit 100
+"""
+QUERIES["q86"] = """
+select sum(ws_net_paid) total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ws_net_paid) desc) rank_within_parent
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 108 and 119
+  and d1.d_date_sk = ws_sold_date_sk
+  and i_item_sk = ws_item_sk
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent, i_category, i_class
+limit 100
+"""
+QUERIES["q88"] = """
+select * from
+ (select count(*) h8_30_to_9 from store_sales, household_demographics,
+         time_dim, store
+  where ss_sold_time_sk = time_dim.t_time_sk
+    and ss_hdemo_sk = household_demographics.hd_demo_sk
+    and ss_store_sk = s_store_sk
+    and time_dim.t_hour = 8 and time_dim.t_minute >= 30
+    and ((household_demographics.hd_dep_count = 4
+          and household_demographics.hd_vehicle_count <= 6)
+         or (household_demographics.hd_dep_count = 2
+             and household_demographics.hd_vehicle_count <= 4)
+         or (household_demographics.hd_dep_count = 0
+             and household_demographics.hd_vehicle_count <= 2))
+    and store.s_store_name = 'ese') s1,
+ (select count(*) h9_to_9_30 from store_sales, household_demographics,
+         time_dim, store
+  where ss_sold_time_sk = time_dim.t_time_sk
+    and ss_hdemo_sk = household_demographics.hd_demo_sk
+    and ss_store_sk = s_store_sk
+    and time_dim.t_hour = 9 and time_dim.t_minute < 30
+    and ((household_demographics.hd_dep_count = 4
+          and household_demographics.hd_vehicle_count <= 6)
+         or (household_demographics.hd_dep_count = 2
+             and household_demographics.hd_vehicle_count <= 4)
+         or (household_demographics.hd_dep_count = 0
+             and household_demographics.hd_vehicle_count <= 2))
+    and store.s_store_name = 'ese') s2,
+ (select count(*) h9_30_to_10 from store_sales, household_demographics,
+         time_dim, store
+  where ss_sold_time_sk = time_dim.t_time_sk
+    and ss_hdemo_sk = household_demographics.hd_demo_sk
+    and ss_store_sk = s_store_sk
+    and time_dim.t_hour = 9 and time_dim.t_minute >= 30
+    and ((household_demographics.hd_dep_count = 4
+          and household_demographics.hd_vehicle_count <= 6)
+         or (household_demographics.hd_dep_count = 2
+             and household_demographics.hd_vehicle_count <= 4)
+         or (household_demographics.hd_dep_count = 0
+             and household_demographics.hd_vehicle_count <= 2))
+    and store.s_store_name = 'ese') s3,
+ (select count(*) h10_to_10_30 from store_sales, household_demographics,
+         time_dim, store
+  where ss_sold_time_sk = time_dim.t_time_sk
+    and ss_hdemo_sk = household_demographics.hd_demo_sk
+    and ss_store_sk = s_store_sk
+    and time_dim.t_hour = 10 and time_dim.t_minute < 30
+    and ((household_demographics.hd_dep_count = 4
+          and household_demographics.hd_vehicle_count <= 6)
+         or (household_demographics.hd_dep_count = 2
+             and household_demographics.hd_vehicle_count <= 4)
+         or (household_demographics.hd_dep_count = 0
+             and household_demographics.hd_vehicle_count <= 2))
+    and store.s_store_name = 'ese') s4
+"""
+QUERIES["q89"] = """
+select * from (
+  select i_category, i_class, i_brand, s_store_name, s_company_name,
+         d_moy, sum(ss_sales_price) sum_sales,
+         avg(cast(sum(ss_sales_price) as double)) over (partition by
+             i_category, i_brand, s_store_name, s_company_name)
+             avg_monthly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_year in (1999)
+    and ((i_category in ('Books', 'Electronics', 'Sports')
+          and i_class in ('computers', 'shirts', 'baseball'))
+         or (i_category in ('Men', 'Jewelry', 'Women')
+             and i_class in ('accessories', 'dresses', 'pants')))
+  group by i_category, i_class, i_brand, s_store_name, s_company_name,
+           d_moy) tmp1
+where case when avg_monthly_sales <> 0
+      then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+      else null end > 0.1
+order by sum_sales - avg_monthly_sales, s_store_name, i_category,
+         i_class, i_brand, d_moy
+limit 100
+"""
+QUERIES["q93"] = """
+select ss_customer_sk, sum(act_sales) sumsales
+from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity) * ss_sales_price
+                  else ss_quantity * ss_sales_price end act_sales
+      from store_sales left join store_returns
+           on sr_item_sk = ss_item_sk and sr_ticket_number = ss_ticket_number,
+           reason
+      where sr_reason_sk = r_reason_sk
+        and r_reason_desc = 'Package was damaged') t
+group by ss_customer_sk
+order by sumsales, ss_customer_sk
+limit 100
+"""
+QUERIES["q97"] = """
+with ssci as (
+  select ss_customer_sk customer_sk, ss_item_sk item_sk
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk
+    and d_month_seq between 108 and 119
+  group by ss_customer_sk, ss_item_sk),
+csci as (
+  select cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  from catalog_sales, date_dim
+  where cs_sold_date_sk = d_date_sk
+    and d_month_seq between 108 and 119
+  group by cs_bill_customer_sk, cs_item_sk)
+select sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is null then 1 else 0 end) store_only,
+       sum(case when ssci.customer_sk is null
+                 and csci.customer_sk is not null then 1 else 0 end) catalog_only,
+       sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is not null then 1 else 0 end) store_and_catalog
+from ssci full outer join csci
+     on ssci.customer_sk = csci.customer_sk and ssci.item_sk = csci.item_sk
+limit 100
+"""
+
+#: sqlite-oracle equivalents for queries sqlite cannot run
+#: directly (ROLLUP/GROUPING spelled as explicit UNION ALLs;
+#: ordering adds NULLS LAST to match engine null ordering)
+SQLITE_ORACLE: dict[str, str] = {}
+SQLITE_ORACLE["q18"] = """
+select i_item_id, ca_country, ca_state, ca_county, avg(1.0*cs_quantity),
+       avg(1.0*cs_list_price), avg(1.0*cs_coupon_amt),
+       avg(1.0*cs_sales_price), avg(1.0*cs_net_profit),
+       avg(1.0*c_birth_year), avg(1.0*cd_dep_count)
+from (select cs_quantity, cs_list_price, cs_coupon_amt, cs_sales_price,
+             cs_net_profit, c_birth_year, cd1.cd_dep_count, i_item_id,
+             ca_country, ca_state, ca_county
+      from catalog_sales, customer_demographics cd1,
+           customer_demographics cd2, customer, customer_address,
+           date_dim, item
+      where cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk
+        and cs_bill_cdemo_sk = cd1.cd_demo_sk
+        and cs_bill_customer_sk = c_customer_sk
+        and cd1.cd_gender = 'F'
+        and cd1.cd_education_status = 'Unknown'
+        and c_current_cdemo_sk = cd2.cd_demo_sk
+        and c_current_addr_sk = ca_address_sk
+        and c_birth_month in (1, 6, 8, 9, 12, 2)
+        and d_year = 1998
+        and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA'))
+group by i_item_id, ca_country, ca_state, ca_county
+union all
+select i_item_id, ca_country, ca_state, null, avg(1.0*cs_quantity),
+       avg(1.0*cs_list_price), avg(1.0*cs_coupon_amt),
+       avg(1.0*cs_sales_price), avg(1.0*cs_net_profit),
+       avg(1.0*c_birth_year), avg(1.0*cd_dep_count)
+from (select cs_quantity, cs_list_price, cs_coupon_amt, cs_sales_price,
+             cs_net_profit, c_birth_year, cd1.cd_dep_count, i_item_id,
+             ca_country, ca_state
+      from catalog_sales, customer_demographics cd1,
+           customer_demographics cd2, customer, customer_address,
+           date_dim, item
+      where cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk
+        and cs_bill_cdemo_sk = cd1.cd_demo_sk
+        and cs_bill_customer_sk = c_customer_sk
+        and cd1.cd_gender = 'F'
+        and cd1.cd_education_status = 'Unknown'
+        and c_current_cdemo_sk = cd2.cd_demo_sk
+        and c_current_addr_sk = ca_address_sk
+        and c_birth_month in (1, 6, 8, 9, 12, 2)
+        and d_year = 1998
+        and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA'))
+group by i_item_id, ca_country, ca_state
+union all
+select i_item_id, ca_country, null, null, avg(1.0*cs_quantity),
+       avg(1.0*cs_list_price), avg(1.0*cs_coupon_amt),
+       avg(1.0*cs_sales_price), avg(1.0*cs_net_profit),
+       avg(1.0*c_birth_year), avg(1.0*cd_dep_count)
+from (select cs_quantity, cs_list_price, cs_coupon_amt, cs_sales_price,
+             cs_net_profit, c_birth_year, cd1.cd_dep_count, i_item_id,
+             ca_country
+      from catalog_sales, customer_demographics cd1,
+           customer_demographics cd2, customer, customer_address,
+           date_dim, item
+      where cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk
+        and cs_bill_cdemo_sk = cd1.cd_demo_sk
+        and cs_bill_customer_sk = c_customer_sk
+        and cd1.cd_gender = 'F'
+        and cd1.cd_education_status = 'Unknown'
+        and c_current_cdemo_sk = cd2.cd_demo_sk
+        and c_current_addr_sk = ca_address_sk
+        and c_birth_month in (1, 6, 8, 9, 12, 2)
+        and d_year = 1998
+        and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA'))
+group by i_item_id, ca_country
+union all
+select i_item_id, null, null, null, avg(1.0*cs_quantity),
+       avg(1.0*cs_list_price), avg(1.0*cs_coupon_amt),
+       avg(1.0*cs_sales_price), avg(1.0*cs_net_profit),
+       avg(1.0*c_birth_year), avg(1.0*cd_dep_count)
+from (select cs_quantity, cs_list_price, cs_coupon_amt, cs_sales_price,
+             cs_net_profit, c_birth_year, cd1.cd_dep_count, i_item_id
+      from catalog_sales, customer_demographics cd1,
+           customer_demographics cd2, customer, customer_address,
+           date_dim, item
+      where cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk
+        and cs_bill_cdemo_sk = cd1.cd_demo_sk
+        and cs_bill_customer_sk = c_customer_sk
+        and cd1.cd_gender = 'F'
+        and cd1.cd_education_status = 'Unknown'
+        and c_current_cdemo_sk = cd2.cd_demo_sk
+        and c_current_addr_sk = ca_address_sk
+        and c_birth_month in (1, 6, 8, 9, 12, 2)
+        and d_year = 1998
+        and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA'))
+group by i_item_id
+union all
+select null, null, null, null, avg(1.0*cs_quantity),
+       avg(1.0*cs_list_price), avg(1.0*cs_coupon_amt),
+       avg(1.0*cs_sales_price), avg(1.0*cs_net_profit),
+       avg(1.0*c_birth_year), avg(1.0*cd_dep_count)
+from (select cs_quantity, cs_list_price, cs_coupon_amt, cs_sales_price,
+             cs_net_profit, c_birth_year, cd1.cd_dep_count
+      from catalog_sales, customer_demographics cd1,
+           customer_demographics cd2, customer, customer_address,
+           date_dim, item
+      where cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk
+        and cs_bill_cdemo_sk = cd1.cd_demo_sk
+        and cs_bill_customer_sk = c_customer_sk
+        and cd1.cd_gender = 'F'
+        and cd1.cd_education_status = 'Unknown'
+        and c_current_cdemo_sk = cd2.cd_demo_sk
+        and c_current_addr_sk = ca_address_sk
+        and c_birth_month in (1, 6, 8, 9, 12, 2)
+        and d_year = 1998
+        and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA'))
+order by 2, 3, 4, 1
+limit 100
+"""
+SQLITE_ORACLE["q22"] = """
+select i_product_name, i_brand, i_class, i_category,
+       avg(1.0*inv_quantity_on_hand) qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 108 and 119
+group by i_product_name, i_brand, i_class, i_category
+union all
+select i_product_name, i_brand, i_class, null, avg(1.0*inv_quantity_on_hand)
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 108 and 119
+group by i_product_name, i_brand, i_class
+union all
+select i_product_name, i_brand, null, null, avg(1.0*inv_quantity_on_hand)
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 108 and 119
+group by i_product_name, i_brand
+union all
+select i_product_name, null, null, null, avg(1.0*inv_quantity_on_hand)
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 108 and 119
+group by i_product_name
+union all
+select null, null, null, null, avg(1.0*inv_quantity_on_hand)
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 108 and 119
+order by 5, 1 nulls last, 2 nulls last, 3 nulls last, 4 nulls last
+limit 100
+"""
+SQLITE_ORACLE["q27"] = """
+select i_item_id, s_state, 0, avg(1.0*ss_quantity), avg(1.0*ss_list_price),
+       avg(1.0*ss_coupon_amt), avg(1.0*ss_sales_price)
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College' and d_year = 2002
+  and s_state in ('TN', 'TX', 'NE', 'MS')
+group by i_item_id, s_state
+union all
+select i_item_id, null, 1, avg(1.0*ss_quantity), avg(1.0*ss_list_price),
+       avg(1.0*ss_coupon_amt), avg(1.0*ss_sales_price)
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College' and d_year = 2002
+  and s_state in ('TN', 'TX', 'NE', 'MS')
+group by i_item_id
+union all
+select null, null, 1, avg(1.0*ss_quantity), avg(1.0*ss_list_price),
+       avg(1.0*ss_coupon_amt), avg(1.0*ss_sales_price)
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College' and d_year = 2002
+  and s_state in ('TN', 'TX', 'NE', 'MS')
+order by 1 nulls last, 2 nulls last
+limit 100
+"""
+SQLITE_ORACLE["q36"] = """
+select gross_margin, i_category, i_class, lochierarchy,
+       rank() over (partition by lochierarchy,
+                    case when lochierarchy = 0 then i_category end
+                    order by gross_margin) rank_within_parent
+from (
+  select 1.0*sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin,
+         i_category, i_class, 0 lochierarchy
+  from store_sales, date_dim d1, item, store
+  where d1.d_year = 2001 and d1.d_date_sk = ss_sold_date_sk
+    and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+    and s_state in ('TN', 'TX', 'NE', 'MS')
+  group by i_category, i_class
+  union all
+  select 1.0*sum(ss_net_profit) / sum(ss_ext_sales_price), i_category,
+         null, 1
+  from store_sales, date_dim d1, item, store
+  where d1.d_year = 2001 and d1.d_date_sk = ss_sold_date_sk
+    and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+    and s_state in ('TN', 'TX', 'NE', 'MS')
+  group by i_category
+  union all
+  select 1.0*sum(ss_net_profit) / sum(ss_ext_sales_price), null, null, 2
+  from store_sales, date_dim d1, item, store
+  where d1.d_year = 2001 and d1.d_date_sk = ss_sold_date_sk
+    and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+    and s_state in ('TN', 'TX', 'NE', 'MS'))
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent, i_category, i_class
+limit 100
+"""
+SQLITE_ORACLE["q86"] = """
+select total_sum, i_category, i_class, lochierarchy,
+       rank() over (partition by lochierarchy,
+                    case when lochierarchy = 0 then i_category end
+                    order by total_sum desc) rank_within_parent
+from (
+  select sum(ws_net_paid) total_sum, i_category, i_class, 0 lochierarchy
+  from web_sales, date_dim d1, item
+  where d1.d_month_seq between 108 and 119
+    and d1.d_date_sk = ws_sold_date_sk and i_item_sk = ws_item_sk
+  group by i_category, i_class
+  union all
+  select sum(ws_net_paid), i_category, null, 1
+  from web_sales, date_dim d1, item
+  where d1.d_month_seq between 108 and 119
+    and d1.d_date_sk = ws_sold_date_sk and i_item_sk = ws_item_sk
+  group by i_category
+  union all
+  select sum(ws_net_paid), null, null, 2
+  from web_sales, date_dim d1, item
+  where d1.d_month_seq between 108 and 119
+    and d1.d_date_sk = ws_sold_date_sk and i_item_sk = ws_item_sk)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent, i_category, i_class
+limit 100
+"""

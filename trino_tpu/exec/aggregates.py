@@ -38,6 +38,17 @@ VARIANCE_FNS = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
 }
 
+#: HLL register counts (relative standard error = 1.04/sqrt(m)):
+#: global approx_distinct gets 4096 registers (~1.6%); grouped gets 512
+#: (~4.6%) to bound per-group state at 512 bytes. The reference's
+#: default HLL standard error is 2.3% (ApproximateCountDistinctAggregations).
+HLL_GLOBAL_BUCKETS = 4096
+HLL_GROUPED_BUCKETS = 512
+
+#: quantile-summary sizes (rank error per shard <= count/points)
+QUANT_GLOBAL_POINTS = 1024
+QUANT_GROUPED_POINTS = 256
+
 
 class _Reducer:
     """Segment reductions for one GROUP BY (``info`` set) or one global
@@ -196,6 +207,46 @@ def compute_aggregate(
             eff = eff & valid
         return _Reducer(info, capacity, eff, share).count(), None
 
+    if name in ("approx_distinct", "approx_distinct_partial"):
+        # HLL over prepared 64-bit hash lanes (exec.stage builds the
+        # lane: content hashes for varchar, splitmix64 for numerics).
+        # Constant-size register state -> splittable partial/final with
+        # bounded bytes through every exchange (reference:
+        # MAIN/operator/aggregation/ApproximateCountDistinctAggregations.java).
+        data, valid = arg
+        eff = contrib if valid is None else (contrib & valid)
+        if isinstance(out_type, T.SketchType):
+            m = out_type.lanes
+        else:
+            m = HLL_GLOBAL_BUCKETS if info is None else HLL_GROUPED_BUCKETS
+        reg = _hll_registers(data, eff, m, info, capacity)
+        if name == "approx_distinct_partial":
+            return reg, None
+        return _hll_estimate(reg), None
+
+    if name == "approx_distinct_final":
+        data, valid = arg if not isinstance(arg, list) else arg[0]
+        eff = contrib if valid is None else (contrib & valid)
+        reg = _hll_merge(data, eff, info, capacity)
+        return _hll_estimate(reg), None
+
+    if name == "approx_percentile_partial":
+        (vd, vv), _q = arg
+        if jnp.ndim(vd) == 2:
+            raise NotImplementedError(
+                "approx_percentile over decimal(38) values"
+            )
+        eff = contrib if vv is None else (contrib & vv)
+        k = out_type.lanes - 1
+        state, _nonempty = _quant_summary(vd, eff, info, capacity, k, red)
+        return state, None
+
+    if name == "approx_percentile_final":
+        (sd, sv), (qd, _qv) = arg
+        return _quant_merge(
+            sd, qd, contrib, sv, info, capacity, out_type
+        )
+
     if name == "approx_percentile":
         # EXACT sorted-rank percentile (the reference's qdigest sketch
         # approximates, MAIN/operator/aggregation/ApproximateLongPercentileAggregations;
@@ -289,9 +340,12 @@ def compute_aggregate(
             # exact limb sum, then exact 96/64 long division with
             # round-half-away (reference: DecimalAverageAggregation);
             # the quotient always fits int64 (an average is bounded by
-            # the inputs)
+            # the inputs). Long-decimal outputs re-encode as limbs.
             hi, lo = red.sum_limbs(data)
-            return _limb_div_round(hi, lo, jnp.maximum(cnt, 1)), nonempty
+            q = _limb_div_round(hi, lo, jnp.maximum(cnt, 1))
+            if out_type.is_long:
+                q = _limb_encode(q)
+            return q, nonempty
         s = red.sum(data, dtype=jnp.float64)
         return s / jnp.maximum(cnt, 1), nonempty
 
@@ -358,6 +412,196 @@ def compute_aggregate(
     raise NotImplementedError(f"aggregate {name}")
 
 
+# ---- sketches (HLL / quantile summaries) -----------------------------------
+
+def _clz64(x: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized count-of-leading-zeros over uint64 (binary descent —
+    XLA has no clz primitive)."""
+    zero = x == 0
+    n = jnp.zeros(x.shape, dtype=jnp.int32)
+    for s in (32, 16, 8, 4, 2, 1):
+        no_high = (x >> np.uint64(64 - s)) == 0
+        n = n + jnp.where(no_high, s, 0)
+        x = jnp.where(no_high, x << np.uint64(s), x)
+    return jnp.where(zero, 64, n)
+
+
+def dev_hash64(data: jnp.ndarray) -> jnp.ndarray:
+    """Device-side 64-bit value hash (splitmix64 finalizer) for HLL
+    lanes over numeric/date/decimal columns. Two-limb decimals combine
+    limbs into the unscaled value first; floats canonicalize -0.0."""
+    import jax
+
+    if data.ndim == 2:
+        with np.errstate(over="ignore"):
+            v = (
+                data[:, 0].astype(jnp.uint64) << np.uint64(32)
+            ) + data[:, 1].astype(jnp.uint64)
+    elif jnp.issubdtype(data.dtype, jnp.floating):
+        f = jnp.where(data == 0.0, 0.0, data).astype(jnp.float64)
+        v = jax.lax.bitcast_convert_type(f, jnp.uint64)
+    else:
+        v = data.astype(jnp.int64).astype(jnp.uint64)
+    v ^= v >> np.uint64(30)
+    v *= np.uint64(0xBF58476D1CE4E5B9)
+    v ^= v >> np.uint64(27)
+    v *= np.uint64(0x94D049BB133111EB)
+    v ^= v >> np.uint64(31)
+    return v
+
+
+def _hll_registers(h, contrib, m, info, capacity):
+    """HLL register arrays from 64-bit hash lanes, scatter-free: rows
+    sort by (group, bucket, -rho) and each register reads the first
+    element of its run via searchsorted (one sort + one dense gather —
+    the engine's sort-based analog of the reference's per-row register
+    update loop, MAIN/.../aggregation/state/AbstractHyperLogLogState).
+    Returns int8[capacity, m] (capacity 1 for global)."""
+    b = int(m).bit_length() - 1
+    bucket = (h >> np.uint64(64 - b)).astype(jnp.int64)
+    rho = jnp.clip(_clz64(h << np.uint64(b)) + 1, 1, 64 - b + 1)
+    caps = 1 if info is None else capacity
+    if info is None:
+        key = bucket
+    else:
+        key = info.group.astype(jnp.int64) * m + bucket
+    dead = jnp.int64(caps * m)
+    key = jnp.where(contrib, key, dead)
+    combined = key * 64 + (63 - rho.astype(jnp.int64))
+    sc = jnp.sort(combined)
+    targets = jnp.arange(caps * m, dtype=jnp.int64) * 64
+    pos = jnp.searchsorted(sc, targets)
+    n = sc.shape[0]
+    at = jnp.clip(pos, 0, n - 1)
+    found = sc[at]
+    hit = (pos < n) & ((found >> 6) == (targets >> 6))
+    reg = jnp.where(hit, 63 - (found & 63), 0).astype(jnp.int8)
+    return reg.reshape(caps, m)
+
+
+def _hll_estimate(reg: jnp.ndarray) -> jnp.ndarray:
+    """Registers -> cardinality estimate (standard HLL with the
+    small-range linear-counting correction; 64-bit hashes make the
+    large-range correction unnecessary)."""
+    m = reg.shape[-1]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv = jnp.sum(2.0 ** (-reg.astype(jnp.float64)), axis=-1)
+    raw = alpha * m * m / inv
+    v = jnp.sum((reg == 0), axis=-1).astype(jnp.float64)
+    lin = m * jnp.log(jnp.where(v > 0, m / jnp.maximum(v, 1.0), 1.0))
+    est = jnp.where((raw <= 2.5 * m) & (v > 0), lin, raw)
+    return jnp.round(est).astype(jnp.int64)
+
+
+def _hll_merge(states, contrib, info, capacity):
+    """Element-wise max of member rows' register arrays (the FINAL
+    combine; register max is the HLL merge). Inputs are partial-state
+    rows — few per group — so a scatter-max is fine here."""
+    live = jnp.where(contrib[:, None], states, jnp.zeros((), jnp.int8))
+    if info is None:
+        return jnp.max(live, axis=0, keepdims=True)
+    gid = jnp.clip(info.group, 0, capacity - 1)
+    out = jnp.zeros((capacity, states.shape[1]), dtype=jnp.int8)
+    return out.at[gid].max(live)
+
+
+def _quant_sorted_perm(vd, eff, info):
+    """Permutation ordering rows (group asc, contributing-first,
+    value asc) — shared by the exact percentile and the summary
+    builder."""
+    vbits = K.order_bits(vd)
+    p = jnp.argsort(vbits, stable=True).astype(jnp.int32)
+    p = p[jnp.argsort((~eff)[p], stable=True)]
+    if info is not None:
+        p = p[jnp.argsort(info.group[p], stable=True)]
+    return p
+
+
+def _quant_summary(vd, eff, info, capacity, k, red):
+    """Mergeable quantile summary: k evenly-spaced order statistics
+    per group + a count lane, as float64[cap, k+1] (the qdigest-state
+    analog, MAIN/.../aggregation/ApproximateLongPercentileAggregations;
+    rank error after merging S shards is <= total/k per shard).
+    """
+    p = _quant_sorted_perm(vd, eff, info)
+    er = _Reducer(red.info, capacity, eff, red.share)
+    cnt = er.count()
+    caps = 1 if info is None else capacity
+    if info is None:
+        starts = jnp.zeros((1,), dtype=jnp.int64)
+    else:
+        starts = info.starts.astype(jnp.int64)
+    i = jnp.arange(k, dtype=jnp.float64)
+    offs = jnp.clip(
+        jnp.round((i[None, :] + 0.5) / k * cnt[:, None].astype(jnp.float64) - 0.5),
+        0, jnp.maximum(cnt[:, None] - 1, 0).astype(jnp.float64),
+    ).astype(jnp.int64)
+    n = vd.shape[0]
+    at = jnp.clip(starts[:, None] + offs, 0, max(n - 1, 0))
+    pts = vd[p[at.astype(jnp.int32)]].astype(jnp.float64)
+    state = jnp.concatenate(
+        [pts, cnt[:caps, None].astype(jnp.float64)], axis=1
+    )
+    return state, cnt > 0
+
+
+def _quant_merge(states, q, contrib, valid, info, capacity, out_type):
+    """FINAL combine of quantile summaries: member rows' points merge
+    as a weighted quantile (each point carries weight count/k). Sort-
+    and-searchsorted based; input rows are partial states (few per
+    group)."""
+    k = states.shape[1] - 1
+    pts = states[:, :k]
+    cnt = states[:, k]
+    eff = contrib if valid is None else (contrib & valid)
+    w = jnp.where(eff & (cnt > 0), cnt / k, 0.0)
+    n = states.shape[0]
+    caps = 1 if info is None else capacity
+    # flatten to n*k weighted points
+    vals = pts.reshape(-1)
+    wts = jnp.repeat(w, k)
+    if info is None:
+        gid_f = jnp.zeros(n * k, dtype=jnp.int64)
+    else:
+        gid_f = jnp.repeat(
+            jnp.clip(info.group, 0, capacity - 1).astype(jnp.int64), k
+        )
+    vbits = K.order_bits(vals)
+    p = jnp.argsort(vbits, stable=True).astype(jnp.int32)
+    p = p[jnp.argsort(gid_f[p], stable=True)]
+    gs = gid_f[p]
+    ws = wts[p]
+    cum = jnp.cumsum(ws)
+    starts = jnp.searchsorted(gs, jnp.arange(caps, dtype=jnp.int64))
+    base = jnp.where(
+        starts > 0, cum[jnp.clip(starts - 1, 0, n * k - 1)], 0.0
+    )
+    total = jnp.zeros((caps,), dtype=jnp.float64).at[gs].add(ws)
+    # within-group cumulative weight; pick the first point reaching
+    # q * total (approximate global rank selection)
+    adj = cum - base[gs]
+    target = q.reshape(-1)[0].astype(jnp.float64) * total
+    reached = adj >= target[gs] - 1e-9
+    idx = jnp.arange(n * k, dtype=jnp.int64)
+    cand = jnp.where(reached & (ws > 0), idx, n * k)
+    first = jnp.full((caps,), n * k, dtype=jnp.int64).at[gs].min(cand)
+    # groups with no weight fall back to any index (masked by valid)
+    at = jnp.clip(first, 0, max(n * k - 1, 0))
+    out = vals[p[at.astype(jnp.int32)]]
+    has = total > 0
+    if isinstance(out_type, (T.DoubleType, T.RealType)):
+        return out.astype(out_type.np_dtype.type), has
+    return jnp.round(out).astype(jnp.int64).astype(out_type.np_dtype.type), has
+
+
+def _limb_encode(q: jnp.ndarray) -> jnp.ndarray:
+    """Re-encode an int64 value as canonical two limbs (hi signed,
+    lo in [0, 2^32)) — the storage form of long-decimal columns."""
+    return jnp.stack(
+        [q >> jnp.int64(32), q & jnp.int64(0xFFFFFFFF)], axis=-1
+    )
+
+
 def _limb_norm(s_hi, s_lo):
     """Canonicalize limb sums: lo into [0, 2^32), carry into hi."""
     carry = s_lo >> jnp.int64(32)
@@ -416,7 +660,10 @@ def _avg_final(out_type, args, red: _Reducer):
     c = _state_sum(args[1], red)
     nonempty = c > 0
     if isinstance(out_type, T.DecimalType):
-        return _div_round_half_up(s, jnp.maximum(c, 1)), nonempty
+        q = _div_round_half_up(s, jnp.maximum(c, 1))
+        if out_type.is_long:
+            q = _limb_encode(q)
+        return q, nonempty
     return s.astype(jnp.float64) / jnp.maximum(c, 1), nonempty
 
 
@@ -453,7 +700,10 @@ def _decimal_avg_final(out_type, args, red: _Reducer):
     cnt = _state_sum(args[2], red)
     hi, lo = _limb_norm(s_hi, s_lo)
     nonempty = cnt > 0
-    return _limb_div_round(hi, lo, jnp.maximum(cnt, 1)), nonempty
+    q = _limb_div_round(hi, lo, jnp.maximum(cnt, 1))
+    if isinstance(out_type, T.DecimalType) and out_type.is_long:
+        q = _limb_encode(q)
+    return q, nonempty
 
 
 def _limb_partial_sum(which: str):

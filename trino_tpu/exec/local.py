@@ -1065,6 +1065,66 @@ class LocalExecutor:
 
     # ---- window / set operations -----------------------------------------
 
+    def _GroupId(self, node: P.GroupId) -> Page:
+        """Replicate the input once per grouping set with NULLed
+        non-member keys + a set-id column (GroupIdOperator analog,
+        MAIN/operator/GroupIdOperator.java) — one device concat of k
+        masked copies; the aggregation above fuses over the result."""
+        src = self.execute(node.source)
+        k = len(node.grouping_sets)
+        in_cap = src.capacity
+        out_cap = pad_capacity(k * in_cap)
+        keyed = set(s for st in node.grouping_sets for s in st)
+        pad = out_cap - k * in_cap
+
+        def tile(pieces, fill):
+            if pad:
+                pieces = pieces + [
+                    jnp.full((pad,) + pieces[0].shape[1:], fill,
+                             dtype=pieces[0].dtype)
+                ]
+            return jnp.concatenate(pieces)
+
+        names, cols = [], []
+        for name, col in zip(src.names, src.columns):
+            if name in keyed:
+                valid_full = (
+                    col.valid if col.valid is not None
+                    else jnp.ones((in_cap,), dtype=jnp.bool_)
+                )
+                none = jnp.zeros((in_cap,), dtype=jnp.bool_)
+                valid = tile(
+                    [
+                        valid_full if name in st else none
+                        for st in node.grouping_sets
+                    ],
+                    False,
+                )
+            else:
+                valid = (
+                    None if col.valid is None
+                    else tile([col.valid] * k, False)
+                )
+            data = tile([col.data] * k, 0)
+            names.append(name)
+            cols.append(
+                Column(col.type, data, valid, col.dictionary, col.hash_pool)
+            )
+        names.append(node.id_symbol)
+        cols.append(Column(
+            T.BIGINT,
+            tile(
+                [
+                    jnp.full((in_cap,), i, dtype=jnp.int64)
+                    for i in range(k)
+                ],
+                0,
+            ),
+        ))
+        mask = tile([src.mask] * k, False)
+        rows = src.num_rows()
+        return Page(names, cols, mask, known_rows=rows * k, packed=False)
+
     def _Unnest(self, node: P.Unnest) -> Page:
         """Static-fanout UNNEST (UnnestOperator analog,
         MAIN/operator/unnest/UnnestOperator.java): output position
@@ -1230,13 +1290,30 @@ class LocalExecutor:
         # merged sorted dictionary, each branch remapped by gather
         for sym, src_syms in node.symbol_map.items():
             cols = [p.column(s) for p, s in zip(pages, src_syms)]
+            if any(c.hash_pool is not None for c in cols):
+                raise NotImplementedError(
+                    "hash-coded varchar columns cannot merge across "
+                    "UNION branches (no shared dictionary)"
+                )
             if any(c.dictionary is not None for c in cols):
+                # a branch may carry a dictionary-less varchar column
+                # (typed NULL literals, e.g. a global grouping-set
+                # branch's NULLed keys): treat it as an empty dictionary
+                empty = np.asarray([], dtype=object)
                 merged = StringDictionary(np.unique(np.concatenate(
-                    [c.dictionary.values for c in cols]
+                    [
+                        c.dictionary.values if c.dictionary is not None
+                        else empty
+                        for c in cols
+                    ]
                 )))
                 for p, s, c in zip(pages, src_syms, cols):
+                    vals = (
+                        c.dictionary.values if c.dictionary is not None
+                        else empty
+                    )
                     remap = np.searchsorted(
-                        merged.values, c.dictionary.values
+                        merged.values, vals
                     ).astype(np.int32)
                     p.columns[p.names.index(s)] = _remap(c, remap, merged)
         names, cols = [], []

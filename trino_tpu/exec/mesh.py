@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
+from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec import stage
 from trino_tpu.exec.failure import FailureInjector, InjectedFailure
@@ -184,6 +185,9 @@ class MeshExecutor(LocalExecutor):
         self.failure_injector = FailureInjector()
         #: count of joins that took the skew-split path (tests/metrics)
         self.skew_joins = 0
+        #: count of exchange bucket-capacity escalations (tests assert
+        #: skew-proof plans never escalate)
+        self.exchange_escalations = 0
 
     def _attempt(self, tag: str, call):
         """Run one stage-shard program with injected-failure retry.
@@ -228,6 +232,9 @@ class MeshExecutor(LocalExecutor):
             if node.partitioning == "hash":
                 sp = self.execute_dist(node.source)
                 return self.hash_exchange(sp, node.hash_symbols)
+            if node.partitioning == "range":
+                sp = self.execute_dist(node.source)
+                return self.range_exchange(sp, node.sort_keys)
             raise AssertionError(
                 f"exchange {node.partitioning} cannot produce a sharded page"
             )
@@ -235,6 +242,8 @@ class MeshExecutor(LocalExecutor):
             return self._dist_join(node)
         if isinstance(node, P.SemiJoin):
             return self._dist_semi(node)
+        if isinstance(node, P.GroupId):
+            return self._dist_groupid(node)
         raise NotImplementedError(
             f"no distributed executor for {type(node).__name__}"
         )
@@ -508,6 +517,66 @@ class MeshExecutor(LocalExecutor):
         dest = (h % jnp.uint64(self.n_shards)).astype(jnp.int32)
         return self.exchange_by_dest(sp, dest)
 
+    def range_exchange(
+        self, sp: ShardedPage, sort_keys
+    ) -> ShardedPage:
+        """Distributed-sort shuffle: rows route to shards by sampled
+        splitters of the first sort key, so shard-local sorts
+        concatenate into global order (the range-partitioned analog of
+        the reference's merge exchange, MAIN/operator/MergeOperator.java
+        — instead of merging sorted streams on one node, the engine
+        makes shard ranges disjoint up front; equal keys colocate, so
+        ties never straddle a shard boundary)."""
+        k = sort_keys[0]
+        col = sp.column(k.symbol)
+        if col.hash_pool is not None:
+            raise AssertionError(
+                "range exchange over a hash-coded varchar key (the "
+                "stats gate must keep ORDER BY columns dictionary-coded)"
+            )
+        nulls_first = (
+            k.nulls_first if k.nulls_first is not None else not k.ascending
+        )
+        key = ("mesh-range-bits", self._sharded_sig(sp), k.symbol,
+               k.ascending, nulls_first)
+        prog = self._mesh_jit_cache.get(key)
+        if prog is None:
+            def fb(data, valid):
+                d = data[:, 0] if data.ndim == 2 else data
+                bits = K.order_bits(d)
+                if not k.ascending:
+                    bits = ~bits
+                if valid is not None:
+                    sentinel = (
+                        jnp.uint64(0) if nulls_first
+                        else jnp.uint64(0xFFFFFFFFFFFFFFFF)
+                    )
+                    bits = jnp.where(valid, bits, sentinel)
+                return bits
+
+            prog = jax.jit(fb, static_argnames=())
+            self._mesh_jit_cache[key] = prog
+        bits = prog(col.data, col.valid)
+        # splitters from a strided sample (the runtime analog of the
+        # reference's DeterminePartitionCount + writer rebalancing:
+        # quantiles of the observed key distribution)
+        stride = max(int(bits.shape[0]) // 4096, 1)
+        sample = np.asarray(bits[::stride])
+        live = np.asarray(sp.mask[::stride])
+        sample = sample[live]
+        if len(sample) == 0:
+            dest = jnp.zeros(bits.shape, dtype=jnp.int32)
+        else:
+            qs = np.quantile(
+                np.sort(sample),
+                [i / self.n_shards for i in range(1, self.n_shards)],
+                method="nearest",
+            ).astype(np.uint64)
+            dest = jnp.searchsorted(
+                jnp.asarray(qs), bits, side="right"
+            ).astype(jnp.int32)
+        return self.exchange_by_dest(sp, dest)
+
     def exchange_by_dest(
         self, sp: ShardedPage, dest: jnp.ndarray
     ) -> ShardedPage:
@@ -558,6 +627,7 @@ class MeshExecutor(LocalExecutor):
                 "exchange", lambda: prog(dest, *leaves)
             )
             if bool(jax.device_get(ovf)) and bucket_cap < shard_cap:
+                self.exchange_escalations += 1
                 bucket_cap = min(bucket_cap * 4, shard_cap)
                 continue
             if bool(jax.device_get(ovf)):
@@ -885,6 +955,77 @@ class MeshExecutor(LocalExecutor):
             node, probe, build_hot, True, "inner", criteria, out_syms
         )
         return self._concat_sharded(part1, part2)
+
+    def _dist_groupid(self, node: P.GroupId) -> ShardedPage:
+        """Shard-local GroupId replication: each shard concatenates k
+        masked copies of its rows (no exchange — the aggregation above
+        hash-exchanges on (id, keys)). GroupIdOperator analog
+        (MAIN/operator/GroupIdOperator.java) in SPMD form."""
+        src = self.execute_dist(node.source)
+        k = len(node.grouping_sets)
+        sets = [tuple(st) for st in node.grouping_sets]
+        keyed = set(s for st in sets for s in st)
+        leaves, meta = _page_leaves(src)
+        axis = self.axis
+        key = ("mesh-groupid", self._sharded_sig(src), tuple(sets))
+        prog = self._mesh_jit_cache.get(key)
+        if prog is None:
+            names = list(src.names)
+
+            def fg(*ls):
+                env, mask = _env_from_leaves(ls, meta)
+                outs = []
+                for name in names:
+                    data, valid = env[name]
+                    outs.append(jnp.concatenate([data] * k))
+                    n = data.shape[0]
+                    if name in keyed:
+                        vf = (
+                            valid if valid is not None
+                            else jnp.ones((n,), dtype=jnp.bool_)
+                        )
+                        none = jnp.zeros((n,), dtype=jnp.bool_)
+                        outs.append(jnp.concatenate([
+                            vf if name in st else none for st in sets
+                        ]))
+                    elif valid is not None:
+                        outs.append(jnp.concatenate([valid] * k))
+                n = mask.shape[0]
+                outs.append(jnp.concatenate([
+                    jnp.full((n,), i, dtype=jnp.int64) for i in range(k)
+                ]))
+                outs.append(jnp.concatenate([mask] * k))
+                return outs
+
+            n_out = sum(
+                2 if (nm in keyed or hv) else 1 for nm, hv in meta
+            ) + 2
+            prog = jax.jit(
+                jax.shard_map(
+                    fg, mesh=self.mesh,
+                    in_specs=(PS(axis),) * len(leaves),
+                    out_specs=[PS(axis)] * n_out,
+                    check_vma=False,
+                )
+            )
+            self._mesh_jit_cache[key] = prog
+        out = prog(*leaves)
+        cols, i = [], 0
+        names = []
+        for (name, has_valid), c in zip(meta, src.columns):
+            data = out[i]
+            i += 1
+            valid = None
+            if name in keyed or has_valid:
+                valid = out[i]
+                i += 1
+            names.append(name)
+            cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
+        names.append(node.id_symbol)
+        cols.append(Column(T.BIGINT, out[i]))
+        i += 1
+        mask = out[i]
+        return ShardedPage(names, cols, mask, src.n_shards)
 
     def _concat_sharded(self, a: ShardedPage, b: ShardedPage) -> ShardedPage:
         """Per-shard concatenation of two same-layout sharded pages."""

@@ -223,10 +223,15 @@ def _empty_run(outputs: dict[str, T.DataType]) -> HostRun:
 # ---- streamed chains -------------------------------------------------------
 
 def _split_chain(chain: list[P.PlanNode]):
-    """(per_chunk_nodes, final_nodes): the per-chunk part is row-local
-    (or a PARTIAL aggregate / partial TopN/Limit); the final part runs
-    once over the concatenated chunk outputs — the same decomposition
-    the distributed planner applies per shard."""
+    """(per_chunk_nodes, final_nodes, merge_keys): the per-chunk part
+    is row-local (or a PARTIAL aggregate / partial TopN/Limit/Sort);
+    the final part runs once over the combined chunk outputs — the
+    same decomposition the distributed planner applies per shard.
+    ``merge_keys`` is set for a full Sort: each chunk sorts
+    device-side, runs spill sorted, and the combine step MERGES runs
+    on host instead of concatenating (the spilled OrderByOperator's
+    sorted-run merge, MAIN/operator/OrderByOperator.java +
+    MergeHashSort analog)."""
     from trino_tpu.exec.local import _splittable
     from trino_tpu.plan.distribute import _split_aggregate
 
@@ -242,22 +247,159 @@ def _split_chain(chain: list[P.PlanNode]):
                 partial.key_ranges = nd.key_ranges
                 final.est_groups = nd.est_groups
                 final.key_ranges = nd.key_ranges
-                return chain[:i] + [partial], [final] + chain[i + 1:]
-            return chain[:i], chain[i:]
+                return chain[:i] + [partial], [final] + chain[i + 1:], None
+            return chain[:i], chain[i:], None
         if isinstance(nd, P.TopN):
             # a chunk-local TopN bounds each chunk's contribution; the
             # final TopN re-ranks the concatenation
-            return chain[: i + 1], chain[i:]
+            return chain[: i + 1], chain[i:], None
         if isinstance(nd, P.Sort):
-            return chain[:i], chain[i:]
+            # chunk-local device sorts -> host-merged sorted runs; the
+            # Sort itself never sees the whole input on device
+            return chain[: i + 1], chain[i + 1:], list(nd.keys)
         if isinstance(nd, P.Limit):
             per = P.Limit(
                 dict(nd.outputs), source=None,
                 count=nd.count + nd.offset if nd.count >= 0 else -1,
                 offset=0,
             )
-            return chain[:i] + [per], chain[i:]
-    return list(chain), []
+            return chain[:i] + [per], chain[i:], None
+    return list(chain), [], None
+
+
+def _order_bits_np(values: np.ndarray) -> np.ndarray | None:
+    """Monotone u64 encoding on host (kernels.order_bits analog);
+    None for types without a single-lane encoding."""
+    if values.dtype == object or values.ndim != 1:
+        return None
+    if values.dtype.kind == "f":
+        b = values.astype(np.float64).view(np.uint64)
+        sign = (b >> np.uint64(63)).astype(bool)
+        with np.errstate(over="ignore"):
+            return np.where(
+                sign, ~b, b | np.uint64(0x8000000000000000)
+            )
+    if values.dtype == np.bool_:
+        return values.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        return values.astype(np.int64).view(np.uint64) ^ np.uint64(
+            0x8000000000000000
+        )
+
+
+def merge_sorted_runs(runs: list, keys) -> "HostRun":
+    """Merge device-sorted host runs into one globally-ordered run.
+
+    Single non-nullable numeric key: true k-way merge — iterated
+    pairwise vectorized merges on u64 rank arrays (log2(k) passes of
+    searchsorted + gather), the host analog of the reference's
+    MergeSortedPages. Everything else (multi-key, nullable, varchar,
+    two-limb): one host lexsort over the concatenation — the device
+    budget is unaffected either way; only host CPU differs."""
+    runs = [r for r in runs if r.n_rows] or runs[:1]
+    if len(runs) == 1:
+        return runs[0]
+    first = runs[0]
+    idx_of = {n: i for i, n in enumerate(first.names)}
+
+    def take(run, order):
+        return HostRun(
+            list(run.names), list(run.types),
+            [
+                (v[order], None if valid is None else valid[order])
+                for v, valid in run.columns
+            ],
+            len(order),
+        )
+
+    def concat(a, b):
+        cols = []
+        for (va, xa), (vb, xb) in zip(a.columns, b.columns):
+            if va.dtype == object or vb.dtype == object:
+                v = np.concatenate([va.astype(object), vb.astype(object)])
+            else:
+                v = np.concatenate([va, vb])
+            if xa is not None or xb is not None:
+                x = np.concatenate([
+                    xa if xa is not None else np.ones(len(va), dtype=bool),
+                    xb if xb is not None else np.ones(len(vb), dtype=bool),
+                ])
+            else:
+                x = None
+            cols.append((v, x))
+        return HostRun(
+            list(a.names), list(a.types), cols, a.n_rows + b.n_rows
+        )
+
+    k0 = keys[0]
+    ci = idx_of[k0.symbol]
+    single = (
+        len(keys) == 1
+        and first.columns[ci][1] is None
+        and _order_bits_np(first.columns[ci][0]) is not None
+    )
+    if single:
+        items = []
+        for r in runs:
+            bits = _order_bits_np(r.columns[ci][0])
+            if not k0.ascending:
+                bits = ~bits
+            items.append((bits, r))
+        while len(items) > 1:
+            nxt = []
+            for j in range(0, len(items) - 1, 2):
+                ba, ra = items[j]
+                bb, rb = items[j + 1]
+                # stable pairwise merge: run a's rows precede equal
+                # rows of run b
+                pos_a = np.arange(len(ba)) + np.searchsorted(bb, ba, "left")
+                pos_b = np.arange(len(bb)) + np.searchsorted(ba, bb, "right")
+                m = len(ba) + len(bb)
+                order = np.empty(m, dtype=np.int64)
+                order[pos_a] = np.arange(len(ba))
+                order[pos_b] = len(ba) + np.arange(len(bb))
+                merged = take(concat(ra, rb), order)
+                bits = np.empty(m, dtype=np.uint64)
+                bits[pos_a] = ba
+                bits[pos_b] = bb
+                nxt.append((bits, merged))
+            if len(items) % 2:
+                nxt.append(items[-1])
+            items = nxt
+        return items[0][1]
+
+    # general path: host lexsort over the concatenation
+    combined = runs[0]
+    for r in runs[1:]:
+        combined = concat(combined, r)
+    lanes = []
+    for k in reversed(keys):
+        i = idx_of[k.symbol]
+        values, valid = combined.columns[i]
+        if values.dtype == object:
+            _u, codes = np.unique(values.astype(str), return_inverse=True)
+            lane_list = [codes if k.ascending else -codes]
+        elif values.ndim == 2:
+            hi, lo = values[:, 0], values[:, 1]
+            if k.ascending:
+                lane_list = [lo, hi]
+            else:
+                with np.errstate(over="ignore"):
+                    lane_list = [-lo, -hi]
+        else:
+            bits = _order_bits_np(values)
+            lane_list = [bits if k.ascending else ~bits]
+        for lane in lane_list:
+            lanes.append(lane)
+        if valid is not None:
+            nf = (
+                k.nulls_first if k.nulls_first is not None
+                else not k.ascending
+            )
+            nulls = (~valid).astype(np.int8)
+            lanes.append(-nulls if nf else nulls)
+    order = np.lexsort(lanes)
+    return take(combined, order)
 
 
 def run_chain_streamed(ex, chain: list[P.PlanNode], scan: P.TableScan) -> Page:
@@ -266,7 +408,7 @@ def run_chain_streamed(ex, chain: list[P.PlanNode], scan: P.TableScan) -> Page:
     run the final part over the merged result."""
     budget = ex.hbm_budget()
     chunk_rows = chunk_rows_for(budget, row_bytes(scan.outputs))
-    per_chunk, final = _split_chain(chain)
+    per_chunk, final, merge_keys = _split_chain(chain)
     limit_needed = None
     if per_chunk and isinstance(per_chunk[-1], P.Limit):
         c = per_chunk[-1].count
@@ -287,6 +429,8 @@ def run_chain_streamed(ex, chain: list[P.PlanNode], scan: P.TableScan) -> Page:
     if not runs:
         out_node = (per_chunk or [scan])[-1]
         runs = [_empty_run(out_node.outputs)]
+    if merge_keys is not None and len(runs) > 1:
+        runs = [merge_sorted_runs(runs, merge_keys)]
     combined = host_concat_to_page(ex, runs)
     if final:
         return ex._run_chain(list(final), combined)

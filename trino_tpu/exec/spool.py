@@ -85,7 +85,10 @@ def _key_lanes(values: np.ndarray, valid: np.ndarray | None) -> np.ndarray:
                 values[:, 0].astype(np.uint64) << np.uint64(32)
             ) + values[:, 1].astype(np.uint64)
     elif values.dtype.kind == "f":
-        out = np.where(values == 0.0, 0.0, values).view(np.uint64).copy()
+        # widen to float64 BEFORE the bit view (a float32 view as
+        # uint64 is a shape error; equal values must hash equally)
+        f = np.where(values == 0.0, 0.0, values).astype(np.float64)
+        out = f.view(np.uint64).copy()
     else:
         out = values.astype(np.int64).view(np.uint64).copy()
     out = _splitmix64(out)

@@ -28,9 +28,14 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
-from trino_tpu.exec.aggregates import VARIANCE_FNS, compute_aggregate
+from trino_tpu.exec.aggregates import (
+    VARIANCE_FNS,
+    compute_aggregate,
+    dev_hash64,
+)
+from trino_tpu.expr.ir import InputRef
 from trino_tpu.expr.compiler import ColumnLayout, compile_expr
-from trino_tpu.page import StringDictionary, pad_capacity
+from trino_tpu.page import StringDictionary, content_hash64, pad_capacity
 from trino_tpu.plan import nodes as P
 
 __all__ = ["FUSABLE", "ChainLayout", "plan_capacities", "build_chain"]
@@ -231,6 +236,37 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
     in_cap = layout.capacity
     out_cap = 8 if is_global else capacity
 
+    # approx_distinct hashes VALUES, not codes: dictionary/pool columns
+    # get a compile-time content-hash table (deterministic across
+    # processes — fleet partial states must merge consistently), gather
+    # replaces codes with hashes inside the traced step.
+    hll_tables = {}
+    for sym, call, arg_c, _f in agg_meta:
+        if call.name not in ("approx_distinct", "approx_distinct_partial"):
+            continue
+        if not arg_c:
+            continue
+        d = arg_c[0].dictionary
+        if d is not None:
+            vals = d.values
+            tbl = (
+                content_hash64(vals) if len(vals)
+                else np.zeros(1, dtype=np.uint64)
+            )
+            hll_tables[sym] = ("code", jnp.asarray(tbl))
+        else:
+            a0 = call.args[0]
+            pool = (
+                layout.pools.get(a0.name)
+                if isinstance(a0, InputRef) else None
+            )
+            if pool is not None:
+                tbl = (
+                    content_hash64(pool.values) if len(pool.values)
+                    else np.zeros(1, dtype=np.uint64)
+                )
+                hll_tables[sym] = ("id", jnp.asarray(tbl))
+
     out_layout = ChainLayout(
         names=group_keys + [sym for sym, *_ in agg_meta],
         types={
@@ -320,6 +356,26 @@ def _aggregate_step(nd: P.Aggregate, layout: ChainLayout, capacity: int, pos: in
                     / (10.0 ** call.args[0].type.scale),
                     v,
                 )
+            if call.name in ("approx_distinct", "approx_distinct_partial"):
+                d, v = arg
+                tb = hll_tables.get(sym)
+                if tb is not None:
+                    kind, table = tb
+                    idx = d[:, 1] if kind == "id" else d
+                    lane = table[
+                        jnp.clip(
+                            idx.astype(jnp.int32), 0, table.shape[0] - 1
+                        )
+                    ]
+                elif jnp.ndim(d) == 2 and isinstance(
+                    call.args[0].type, T.VarcharType
+                ):
+                    # pool column without a reachable pool object:
+                    # process-local hash lane (correct in-process)
+                    lane = d[:, 0].astype(jnp.uint64)
+                else:
+                    lane = dev_hash64(d)
+                arg = (lane, v)
             if filter_c is not None:
                 fd, fv = filter_c.fn(env)
                 contrib = contrib & (fd if fv is None else (fd & fv))
@@ -398,9 +454,11 @@ def _presort_shared(prepared, info, share):
                 eff = hit[2]
         want(eff)
 
-    by_dtype: dict[str, list] = {}
+    by_dtype: dict[tuple, list] = {}
     for x in items.values():
-        by_dtype.setdefault(str(x.dtype), []).append(x)
+        # key on trailing dims too: multi-lane states ([n, k] sketches,
+        # [n, 2] decimal limbs) cannot stack with 1-D columns
+        by_dtype.setdefault((str(x.dtype), x.shape[1:]), []).append(x)
     for xs in by_dtype.values():
         if len(xs) == 1:
             x = xs[0]

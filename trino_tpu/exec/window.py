@@ -66,7 +66,13 @@ def build_window_program(node: P.Window, layout_types, layout_dicts, capacity):
                 if nf is None:
                     nf = not asc  # reference default: nulls largest
                 data, valid = env[s]
-                sk.append((data, valid, asc, nf))
+                if jnp.ndim(data) == 2:
+                    # two-limb decimal key: (hi, lo) lexicographic ==
+                    # numeric order (hi signed, lo canonical)
+                    sk.append((data[:, 0], valid, asc, nf))
+                    sk.append((data[:, 1], None, asc, False))
+                else:
+                    sk.append((data, valid, asc, nf))
             pre = K.sort_perm(sk, mask)
         else:
             pre = None
@@ -90,12 +96,18 @@ def build_window_program(node: P.Window, layout_types, layout_dicts, capacity):
         )
         same_order = jnp.ones((n,), dtype=jnp.bool_)
         for s, _asc, _nf in order_keys:
-            bits, flag = K.normalize_key(*env[s])
-            bs = bits[perm]
-            same_order = same_order & (bs == jnp.roll(bs, 1))
-            if env[s][1] is not None:
-                fl = flag[perm]
-                same_order = same_order & (fl == jnp.roll(fl, 1))
+            data, valid = env[s]
+            parts = (
+                [data[:, 0], data[:, 1]] if jnp.ndim(data) == 2
+                else [data]
+            )
+            for i, p in enumerate(parts):
+                bits, flag = K.normalize_key(p, valid if i == 0 else None)
+                bs = bits[perm]
+                same_order = same_order & (bs == jnp.roll(bs, 1))
+                if valid is not None and i == 0:
+                    fl = flag[perm]
+                    same_order = same_order & (fl == jnp.roll(fl, 1))
         peer_b = pboundary | ~same_order
         # peer start: running max of boundary positions
         peer_start = jax.lax.associative_scan(
@@ -231,6 +243,30 @@ def _eval_call(
         )
         return s.astype(data.dtype), cnt > 0
     if name == "avg":
+        if isinstance(call.type, T.DecimalType) and call.type.is_long:
+            # limb window sums + exact 96/64 divide (mirrors the sum
+            # branch; the argument may be a two-limb column when
+            # averaging an exact decimal(38) aggregate in a window)
+            from trino_tpu.exec.aggregates import (
+                _limb_div_round,
+                _limb_encode,
+                _limb_norm,
+            )
+
+            if jnp.ndim(data) == 2:
+                hi_in = jnp.where(contrib, data[:, 0], 0)
+                lo_in = jnp.where(contrib, data[:, 1], 0)
+            else:
+                masked = jnp.where(
+                    contrib, data, jnp.zeros((), dtype=data.dtype)
+                )
+                hi_in = masked >> jnp.int64(32)
+                lo_in = masked & jnp.int64(0xFFFFFFFF)
+            s_hi = _range_sum(hi_in, lo, hi, n)
+            s_lo = _range_sum(lo_in, lo, hi, n)
+            h2, l2 = _limb_norm(s_hi, s_lo)
+            q = _limb_div_round(h2, l2, jnp.maximum(cnt, 1))
+            return _limb_encode(q), cnt > 0
         if isinstance(call.type, T.DecimalType):
             s = _range_sum(jnp.where(contrib, data, 0), lo, hi, n)
             return _div_round_half_up(s, jnp.maximum(cnt, 1)), cnt > 0

@@ -812,9 +812,18 @@ def _code_bound(d: StringDictionary, s: str) -> tuple[int, bool]:
 def _merge_result_dicts(out_type, parts):
     if not isinstance(out_type, T.VarcharType):
         return None
-    dicts = [p.dictionary for p in parts]
-    if any(d is None for d in dicts):
-        raise NotImplementedError("varchar branches must be dictionary-backed")
+    # a dictionary-less varchar branch is acceptable only as a typed
+    # NULL literal (validity always False — e.g. CASE WHEN ... THEN col
+    # END with an implicit NULL else): it contributes an empty
+    # dictionary. Hash-pool-coded columns also carry no dictionary but
+    # are [n,2] code lanes — merging them silently would corrupt, so
+    # they keep the loud error.
+    if any(p.dictionary is None and not p.is_literal for p in parts):
+        raise NotImplementedError(
+            "varchar branches must be dictionary-backed"
+        )
+    empty = StringDictionary(np.asarray([], dtype=object))
+    dicts = [p.dictionary if p.dictionary is not None else empty for p in parts]
     merged = dicts[0]
     for d in dicts[1:]:
         if d is not merged:
@@ -824,7 +833,9 @@ def _merge_result_dicts(out_type, parts):
 
 def _redict_fn(part: CompiledExpr, merged: StringDictionary | None):
     """Compile-time code remap onto a merged dictionary (device gather)."""
-    if merged is None or part.dictionary is merged:
+    if merged is None or part.dictionary is merged or part.dictionary is None:
+        # dictionary-less parts are typed NULL literals: their codes
+        # are never valid, no remap needed
         return lambda data: data
     remap = np.searchsorted(merged.values, part.dictionary.values).astype(np.int32)
     return _remap_gather(remap)

@@ -20,6 +20,7 @@ __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "Join",
     "SemiJoin", "Sort", "TopN", "Limit", "Output", "Values", "Exchange",
     "SortKey", "Window", "WindowCall", "Union", "Unnest", "RemoteSource",
+    "GroupId",
 ]
 
 
@@ -209,6 +210,26 @@ class Unnest(PlanNode):
 
 
 @dataclass
+class GroupId(PlanNode):
+    """Replicates the input once per grouping set with a set-id column;
+    key columns not in a copy's set are NULLed (the
+    MAIN/sql/planner/plan/GroupIdNode.java /
+    MAIN/operator/GroupIdOperator.java analog). In the batch model the
+    replication is one device concat of k masked copies — the
+    aggregation above groups on (id, all keys), so rows of different
+    sets can never collide even when a NULLed key meets a real NULL."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    #: one list of key symbols per grouping set
+    grouping_sets: list[list[str]] = field(default_factory=list)
+    id_symbol: str = "$groupid"
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
 class Union(PlanNode):
     """UNION ALL: concatenation of sources
     (MAIN/sql/planner/plan/UnionNode.java analog). Distinct set
@@ -269,12 +290,20 @@ class Exchange(PlanNode):
     reshard."""
 
     source: PlanNode = None  # type: ignore[assignment]
-    partitioning: str = "single"  # single | hash | broadcast | source
+    partitioning: str = "single"  # single | hash | broadcast | range | source
     hash_symbols: list[str] = field(default_factory=list)
     scope: str = "REMOTE"
     #: whether the source subtree executes distributed ("dist") or as a
     #: single local page ("single") — set by plan.distribute
     input_dist: str = "dist"
+    #: range partitioning (distributed ORDER BY): rows route to shards
+    #: by sampled splitters of the FIRST sort key, so per-shard sorts
+    #: concatenate into global order (the merge-exchange analog,
+    #: MAIN/operator/MergeOperator.java / MergeSortedPages.java)
+    sort_keys: list["SortKey"] | None = None
+    #: single-gather of range-sorted shards: concatenation preserves
+    #: the global order (no coordinator re-sort)
+    ordered: bool = False
 
     @property
     def sources(self):
@@ -330,6 +359,8 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         )
     elif isinstance(node, Union):
         detail = f"[{len(node.all_sources)} branches]"
+    elif isinstance(node, GroupId):
+        detail = f"[{node.grouping_sets} -> {node.id_symbol}]"
     elif isinstance(node, Exchange):
         detail = f"[{node.scope} {node.partitioning} {node.hash_symbols}]"
     elif isinstance(node, Output):

@@ -72,7 +72,7 @@ def _merge_adjacent_filters(node: P.PlanNode) -> P.PlanNode:
 def _replace_sources(node: P.PlanNode, new_sources: list[P.PlanNode]) -> P.PlanNode:
     if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
                          P.Limit, P.Output, P.Exchange, P.Window,
-                         P.Unnest)):
+                         P.Unnest, P.GroupId)):
         return dc_replace(node, source=new_sources[0])
     if isinstance(node, P.Union):
         return dc_replace(node, all_sources=list(new_sources))

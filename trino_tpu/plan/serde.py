@@ -184,6 +184,13 @@ def plan_to_json(node: P.PlanNode) -> dict:
             symbol_map=[[s, list(v)] for s, v in node.symbol_map.items()],
         )
         return d
+    if isinstance(node, P.GroupId):
+        d.update(
+            source=plan_to_json(node.source),
+            grouping_sets=[list(st) for st in node.grouping_sets],
+            id_symbol=node.id_symbol,
+        )
+        return d
     if isinstance(node, P.Unnest):
         d.update(
             source=plan_to_json(node.source),
@@ -207,7 +214,11 @@ def plan_to_json(node: P.PlanNode) -> dict:
             source=plan_to_json(node.source),
             partitioning=node.partitioning,
             hash_symbols=list(node.hash_symbols), scope=node.scope,
-            input_dist=node.input_dist,
+            input_dist=node.input_dist, ordered=node.ordered,
+            sort_keys=(
+                None if node.sort_keys is None
+                else _sort_keys(node.sort_keys)
+            ),
         )
         return d
     if isinstance(node, P.Output):
@@ -296,6 +307,12 @@ def plan_from_json(d: dict) -> P.PlanNode:
             all_sources=[plan_from_json(s) for s in d["all_sources"]],
             symbol_map={s: list(v) for s, v in d["symbol_map"]},
         )
+    if kind == "GroupId":
+        return P.GroupId(
+            outputs, source=plan_from_json(d["source"]),
+            grouping_sets=[list(st) for st in d["grouping_sets"]],
+            id_symbol=d["id_symbol"],
+        )
     if kind == "Unnest":
         return P.Unnest(
             outputs, source=plan_from_json(d["source"]),
@@ -324,7 +341,11 @@ def plan_from_json(d: dict) -> P.PlanNode:
             outputs, source=plan_from_json(d["source"]),
             partitioning=d["partitioning"],
             hash_symbols=list(d["hash_symbols"]), scope=d["scope"],
-            input_dist=d["input_dist"],
+            input_dist=d["input_dist"], ordered=d.get("ordered", False),
+            sort_keys=(
+                None if d.get("sort_keys") is None
+                else _sort_keys_back(d["sort_keys"])
+            ),
         )
     if kind == "Output":
         return P.Output(

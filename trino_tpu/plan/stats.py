@@ -136,6 +136,10 @@ def _estimate(node, md, cache) -> PlanStats:
     if isinstance(node, (P.Sort, P.Output, P.Exchange)):
         src = estimate(node.sources[0], md, cache)
         return PlanStats(src.rows, dict(src.symbols))
+    if isinstance(node, P.GroupId):
+        src = estimate(node.source, md, cache)
+        k = max(len(node.grouping_sets), 1)
+        return PlanStats(src.rows * k, dict(src.symbols))
     if node.sources:
         src = estimate(node.sources[0], md, cache)
         return PlanStats(src.rows, {})

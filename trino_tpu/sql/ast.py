@@ -19,7 +19,7 @@ __all__ = [
     "InSubquery", "Exists", "ScalarSubquery", "LikeExpr", "IsNullExpr",
     "ExtractExpr",
     "TableRef", "SubqueryRel", "JoinRel",
-    "SelectItem", "Select", "OrderItem", "Query", "SetOp",
+    "GroupingElement", "SelectItem", "Select", "OrderItem", "Query", "SetOp",
     "Explain", "ShowTables", "ShowSchemas", "ShowCatalogs", "DescribeTable",
     "SessionSet", "Use",
 ]
@@ -246,6 +246,20 @@ class UnnestRel(Relation):
 
 
 # ---- query structure -----------------------------------------------------
+
+@dataclass
+class GroupingElement(Node):
+    """One GROUP BY element beyond a plain expression: ROLLUP, CUBE or
+    explicit GROUPING SETS (PARSER/tree/GroupingElement.java analog —
+    the reference parses GroupBy into a list of GroupingElements whose
+    cross product yields the effective grouping sets)."""
+
+    kind: str  # "rollup" | "cube" | "sets"
+    #: for rollup/cube: the element's expressions; for "sets": unused
+    exprs: list[Expr] = field(default_factory=list)
+    #: for "sets": the explicit list of expression lists
+    sets: list[list[Expr]] = field(default_factory=list)
+
 
 @dataclass
 class SelectItem(Node):

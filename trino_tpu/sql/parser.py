@@ -287,12 +287,54 @@ class _Parser:
             where = self.expr()
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.expr())
+            group_by.append(self.grouping_element())
             while self.accept_op(","):
-                group_by.append(self.expr())
+                group_by.append(self.grouping_element())
         if self.accept_kw("having"):
             having = self.expr()
         return ast.Select(items, relations, where, group_by, having, distinct)
+
+    def _at_ident(self, word: str, ahead: int = 0) -> bool:
+        t = self.peek(ahead)
+        return t.kind == "IDENT" and t.text.lower() == word
+
+    def grouping_element(self):
+        """One GROUP BY element: expr | ROLLUP(...) | CUBE(...) |
+        GROUPING SETS ((..), ..) (SqlBase.g4 groupingElement analog).
+        ROLLUP/CUBE/GROUPING lex as plain identifiers, so a following
+        '('/SETS token disambiguates from column references."""
+        if (
+            (self._at_ident("rollup") or self._at_ident("cube"))
+            and self.peek(1).kind == "OP" and self.peek(1).text == "("
+        ):
+            kind = self.next().text.lower()
+            self.expect_op("(")
+            exprs = [self.expr()]
+            while self.accept_op(","):
+                exprs.append(self.expr())
+            self.expect_op(")")
+            return ast.GroupingElement(kind, exprs=exprs)
+        if self._at_ident("grouping") and self._at_ident("sets", 1):
+            self.next()
+            self.next()
+            self.expect_op("(")
+            sets = [self._grouping_set()]
+            while self.accept_op(","):
+                sets.append(self._grouping_set())
+            self.expect_op(")")
+            return ast.GroupingElement("sets", sets=sets)
+        return self.expr()
+
+    def _grouping_set(self) -> list:
+        if self.accept_op("("):
+            if self.accept_op(")"):
+                return []  # the grand-total set
+            exprs = [self.expr()]
+            while self.accept_op(","):
+                exprs.append(self.expr())
+            self.expect_op(")")
+            return exprs
+        return [self.expr()]
 
     def select_item(self) -> ast.SelectItem:
         if self.at_op("*"):

@@ -265,6 +265,33 @@ class UnknownType(DataType):
     np_dtype = np.dtype(np.int8)
 
 
+@dataclass(frozen=True, eq=False, repr=False)
+class SketchType(DataType):
+    """Internal multi-lane aggregation state: HLL registers or quantile
+    summaries (the analog of the reference's HyperLogLog / QDigest
+    state types, SPI/type/ — HLL registers serialized as intermediate
+    aggregation state). Column data is [capacity, lanes]; never
+    user-visible — it only rides PARTIAL->FINAL exchanges and the
+    spooled page serde."""
+
+    kind: str = "hll"  # "hll" (int8 registers) | "quant" (f64 summary)
+    lanes: int = 4096
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "np_dtype",
+            np.dtype(np.int8 if self.kind == "hll" else np.float64),
+        )
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"sketch({self.kind},{self.lanes})"
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+
 BOOLEAN = BooleanType()
 TINYINT = IntegerKind("tinyint", 8)
 SMALLINT = IntegerKind("smallint", 16)
@@ -304,6 +331,9 @@ def type_from_name(name: str) -> DataType:
         return DecimalType(p, s)
     if base.startswith("varchar(") :
         return VarcharType(int(base[8:-1]))
+    if base.startswith("sketch("):
+        kind, lanes = base[7:-1].split(",")
+        return SketchType(kind.strip(), int(lanes))
     if base.startswith("char("):
         return CharType(int(base[5:-1]))
     if base in _BY_NAME:
