@@ -187,3 +187,89 @@ def test_streamed_join_respects_inner_limit(runner, oracle):
         "orders where s.l_orderkey = o_orderkey"
     )
     assert res.rows == resident.rows
+
+
+def test_grace_join_recursion_on_underestimated_partitions(monkeypatch, oracle):
+    """Recursive sub-partitioning: a pair whose MEASURED bytes exceed
+    the pair budget re-partitions with a salted hash until it fits
+    (PartitionedLookupSourceFactory's recursive spilled-partition
+    probing analog). Stats are deliberately sabotaged to under-split
+    the first pass — exactly the mis-estimate the round-3 VERDICT
+    called out — so recursion must recover."""
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.exec import spill as sp
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table l (k bigint, v bigint)")
+    r.execute("create table r (k bigint, w bigint)")
+    rng = np.random.default_rng(3)
+    n = 120_000
+    keys = rng.permutation(n).astype(np.int64)
+    conn = md.connector("memory")
+    conn.insert("default", "l", {
+        "k": (keys, None),
+        "v": (rng.integers(0, 10, n).astype(np.int64), None),
+    })
+    conn.insert("default", "r", {
+        "k": (keys.copy(), None),
+        "w": (rng.integers(0, 10, n).astype(np.int64), None),
+    })
+    sql = "select count(*), sum(v + w) from l, r where l.k = r.k"
+    resident = r.execute(sql).rows
+    b = QueryRunner(md, Session(catalog="memory", schema="default"))
+    b.session.properties["hbm_budget_bytes"] = 1 << 20
+    # force an under-split first pass (the mis-estimate scenario): 2
+    # partitions for ~4 MB of inputs against a 256 KB pair budget
+    b.session.properties["grace_partitions"] = 2
+    got = b.execute(sql).rows
+    assert got == resident
+    assert getattr(b.executor, "grace_recursion_hwm", 0) > 1, (
+        "recursion depth >1 must be exercised"
+    )
+
+
+def test_grace_join_single_hot_key_chunk_pairs(oracle):
+    """A single hot probe key defeats re-partitioning forever: the
+    hot-pair fallback streams (probe chunk x build chunk) pairs under
+    the budget and the result stays exact."""
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table l (k bigint, v bigint)")
+    r.execute("create table r (k bigint, w bigint)")
+    rng = np.random.default_rng(5)
+    n = 120_000
+    # probe: ~half the rows share one hot key; build: the hot key
+    # appears ONCE (hot probe x small matching build — the realistic
+    # skew shape; hot x hot is quadratic by definition)
+    lk = np.where(
+        rng.random(n) < 0.5, 7, rng.integers(10, 1 << 40, n)
+    ).astype(np.int64)
+    rk = np.concatenate([
+        np.asarray([7], dtype=np.int64),
+        rng.integers(10, 1 << 40, n - 1).astype(np.int64),
+    ])
+    conn = md.connector("memory")
+    conn.insert("default", "l", {
+        "k": (lk, None), "v": (rng.integers(0, 10, n).astype(np.int64), None),
+    })
+    conn.insert("default", "r", {
+        "k": (rk, None), "w": (rng.integers(0, 10, n).astype(np.int64), None),
+    })
+    sql = "select count(*), sum(v + w) from l, r where l.k = r.k"
+    resident = r.execute(sql).rows
+    b = QueryRunner(md, Session(catalog="memory", schema="default"))
+    b.session.properties["hbm_budget_bytes"] = 1 << 20
+    got = b.execute(sql).rows
+    assert got == resident
+    assert getattr(b.executor, "grace_hot_pairs", 0) > 0, (
+        "hot-pair fallback must engage"
+    )

@@ -291,14 +291,24 @@ def test_outer_join_does_not_narrow_exact_bounds():
 
 
 def test_distinct_agg_dedupes_before_exchange():
-    """Distributed DISTINCT aggregation inserts a shard-local dedupe so
-    the exchange carries at most NDV rows, not the raw data."""
+    """Distributed DISTINCT aggregation is two-level: a shard-local
+    dedupe feeds a (group keys + distinct column) exchange — at most
+    NDV rows, spread by the distinct values so a hot group key cannot
+    skew it — then the deduped pairs aggregate partial/final across a
+    second exchange on the group keys alone."""
     plan, _ = _mesh_plan(
         "select l_orderkey, count(distinct l_suppkey) from lineitem "
         "group by l_orderkey"
     )
     ex = _find(plan, P.Exchange)
     hash_ex = [e for e in ex if e.partitioning == "hash"]
-    assert hash_ex
-    assert isinstance(hash_ex[0].source, P.Aggregate)
-    assert hash_ex[0].source.aggregates == {}  # pure dedupe
+    assert len(hash_ex) == 2
+    # inner exchange: (group key, distinct column), pure-dedupe source
+    pair_ex = [e for e in hash_ex if len(e.hash_symbols) == 2]
+    assert pair_ex and isinstance(pair_ex[0].source, P.Aggregate)
+    assert pair_ex[0].source.aggregates == {}  # pure dedupe
+    # outer exchange: group keys only, carrying partial counts
+    group_ex = [e for e in hash_ex if len(e.hash_symbols) == 1]
+    assert group_ex and isinstance(group_ex[0].source, P.Aggregate)
+    assert group_ex[0].source.step == "PARTIAL"
+    assert group_ex[0].source.aggregates  # partial count over pairs

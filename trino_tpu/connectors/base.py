@@ -17,7 +17,7 @@ import numpy as np
 from trino_tpu import types as T
 
 __all__ = [
-    "TableSchema", "Connector", "Catalog", "Split",
+    "TableSchema", "Connector", "Catalog", "Split", "ColumnDomain",
     "ColumnStats", "TableStats", "compute_column_stats",
 ]
 
@@ -46,6 +46,42 @@ class Split:
     table: str
     start: int
     count: int
+
+
+@dataclass(frozen=True)
+class ColumnDomain:
+    """Per-column value interval in STORAGE domain (ints for dates and
+    short decimals, floats, python strings for varchar) — the
+    TupleDomain-lite predicate model (SPI/predicate/TupleDomain.java,
+    Domain.java collapsed to one range per column). ``None`` bounds are
+    unbounded; strict flags mark open ends. Pruning-safe semantics
+    only: a connector may skip storage units whose [min, max] cannot
+    intersect the domain (NULLs never satisfy a comparison, so
+    stats-disjoint units cannot contribute rows); the engine always
+    re-applies the full filter."""
+
+    lo: object = None
+    hi: object = None
+    lo_strict: bool = False
+    hi_strict: bool = False
+
+    def disjoint(self, stat_min, stat_max) -> bool:
+        """True when no value in [stat_min, stat_max] can satisfy the
+        domain (the rowgroup-skip test)."""
+        try:
+            if self.lo is not None and stat_max is not None:
+                if stat_max < self.lo or (
+                    self.lo_strict and stat_max == self.lo
+                ):
+                    return True
+            if self.hi is not None and stat_min is not None:
+                if stat_min > self.hi or (
+                    self.hi_strict and stat_min == self.hi
+                ):
+                    return True
+        except TypeError:
+            return False  # incomparable stat types: never skip
+        return False
 
 
 @dataclass(frozen=True)
@@ -126,6 +162,11 @@ class Connector:
     #: False for live views (system tables): the executor must not
     #: device-cache their scans between queries
     cacheable = True
+
+    #: True when scan() accepts ``domains`` and can prune storage units
+    #: by footer statistics (the applyFilter capability flag,
+    #: SPI/connector/ConnectorMetadata.java applyFilter)
+    supports_domains = False
 
     def list_schemas(self) -> list[str]:
         return []

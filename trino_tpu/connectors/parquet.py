@@ -68,9 +68,17 @@ def _type_from_arrow(t) -> T.DataType:
 
 
 class ParquetConnector(Connector):
+    #: scan() accepts ColumnDomains and prunes rowgroups by footer
+    #: min/max statistics (ParquetReader's predicate pushdown,
+    #: lib/trino-parquet/.../reader/ParquetReader.java:85)
+    supports_domains = True
+
     def __init__(self, root: str):
         self.root = root
         self._schema_cache: dict[tuple[str, str], TableSchema] = {}
+        #: metrics of the LAST pruned scan (tests + EXPLAIN ANALYZE —
+        #: the connector Metrics SPI analog, SPI/metrics/Metrics.java)
+        self.scan_metrics: dict = {}
 
     def _path(self, schema: str, table: str) -> str:
         return os.path.join(self.root, schema, f"{table}.parquet")
@@ -113,18 +121,87 @@ class ParquetConnector(Connector):
 
     def scan(
         self, schema: str, table: str, columns: list[str],
-        split: Split | None = None,
+        split: Split | None = None, domains=None,
     ):
         _, pq = _arrow()
         ts = self.table_schema(schema, table)
-        tbl = pq.read_table(self._path(schema, table), columns=list(columns))
-        if split is not None:
-            tbl = tbl.slice(split.start, split.count)
+        if domains and split is None:
+            tbl = self._read_pruned(schema, table, columns, domains)
+        else:
+            tbl = pq.read_table(
+                self._path(schema, table), columns=list(columns)
+            )
+            if split is not None:
+                tbl = tbl.slice(split.start, split.count)
         out = {}
         for c in columns:
             arr = tbl.column(c).combine_chunks()
             out[c] = _to_host(arr, ts.column_type(c))
         return out
+
+    def _read_pruned(self, schema: str, table: str, columns, domains):
+        """Read only the rowgroups whose footer min/max stats can
+        intersect every column domain (stripe/rowgroup pruning,
+        lib/trino-parquet predicate pushdown: a disjoint rowgroup
+        cannot contribute rows — NULLs never satisfy a comparison)."""
+        _, pq = _arrow()
+        ts = self.table_schema(schema, table)
+        pf = pq.ParquetFile(self._path(schema, table))
+        md = pf.metadata
+        name_to_idx = {
+            md.row_group(0).column(j).path_in_schema: j
+            for j in range(md.row_group(0).num_columns)
+        } if md.num_row_groups else {}
+        keep = []
+        for i in range(md.num_row_groups):
+            rg = md.row_group(i)
+            skip = False
+            for cname, dom in domains.items():
+                j = name_to_idx.get(cname)
+                if j is None:
+                    continue
+                st = rg.column(j).statistics
+                if st is None or not st.has_min_max:
+                    continue
+                t = ts.column_type(cname)
+                lo = _stat_to_storage(st.min, t)
+                hi = _stat_to_storage(st.max, t)
+                if dom.disjoint(lo, hi):
+                    skip = True
+                    break
+            if not skip:
+                keep.append(i)
+        self.scan_metrics = {
+            "rowgroups_total": md.num_row_groups,
+            "rowgroups_read": len(keep),
+        }
+        import pyarrow as pa
+
+        if not keep:
+            return pa.schema(
+                [(c, pf.schema_arrow.field(c).type) for c in columns]
+            ).empty_table()
+        return pf.read_row_groups(keep, columns=list(columns))
+
+
+def _stat_to_storage(v, t: T.DataType):
+    """Parquet footer statistic -> the engine's storage domain (days
+    for dates, unscaled ints for decimals, micros for timestamps)."""
+    import datetime
+    import decimal
+
+    if v is None:
+        return None
+    if isinstance(t, T.DateType) and isinstance(v, datetime.date):
+        return (v - datetime.date(1970, 1, 1)).days
+    if isinstance(t, T.TimestampType) and isinstance(v, datetime.datetime):
+        epoch = datetime.datetime(1970, 1, 1)
+        return int((v - epoch).total_seconds() * 1_000_000)
+    if isinstance(t, T.DecimalType):
+        if isinstance(v, decimal.Decimal):
+            return int(v.scaleb(t.scale))
+        return int(decimal.Decimal(str(v)).scaleb(t.scale))
+    return v
 
 
 def _to_host(arr, t: T.DataType):
@@ -164,7 +241,8 @@ def _to_host(arr, t: T.DataType):
 
 
 def write_parquet_table(
-    root: str, schema: str, table: str, table_schema: TableSchema, columns: dict
+    root: str, schema: str, table: str, table_schema: TableSchema,
+    columns: dict, row_group_size: int | None = None,
 ):
     """Write host columns as one parquet file (the export half of the
     ingest path; the reference writes via ParquetWriter)."""
@@ -201,7 +279,9 @@ def write_parquet_table(
             arr = pa.array(np.asarray(vals), mask=mask)
         arrays.append(arr)
         names.append(c)
+    kw = {} if row_group_size is None else {"row_group_size": row_group_size}
     pq.write_table(
         pa.Table.from_arrays(arrays, names=names),
         os.path.join(root, schema, f"{table}.parquet"),
+        **kw,
     )

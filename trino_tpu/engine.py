@@ -190,11 +190,36 @@ class QueryRunner:
             self.executor.invalidate_scan(cat, sch, tab)
             return QueryResult(["result"], [("DROP TABLE",)])
         plan = self.plan_stmt(stmt)
-        page = self.executor.execute(plan)
+        self.executor._defer_ok = True
+        try:
+            done = False
+            for _attempt in range(8):
+                page = self.executor.execute(plan)
+                pend = getattr(page, "pending_flags", None)
+                if pend is None:
+                    rows = page.to_pylist()
+                    done = True
+                    break
+                # deferred final-chain sync: the result transfer
+                # carries the overflow flags; a tripped capacity
+                # re-runs the query with the bumped (persisted) size
+                rows, flags = page.to_pylist(extra=pend[0])
+                if not self.executor.note_deferred_overflow(
+                    (flags, pend[1], pend[2])
+                ):
+                    done = True
+                    break
+            if not done:
+                # never return rows from an overflowed execution
+                raise RuntimeError(
+                    "aggregation table overflow persisted through retries"
+                )
+        finally:
+            self.executor._defer_ok = False
         ordered = _has_order(plan)
         return QueryResult(
             names=list(page.names),
-            rows=page.to_pylist(),
+            rows=rows,
             ordered=ordered,
             plan=plan,
         )

@@ -101,6 +101,7 @@ class _Compiler:
                     jnp.zeros((), dtype=jnp.bool_),
                 ),
                 expr.type,
+                is_literal=True,
             )
         if isinstance(expr.type, T.VarcharType):
             d = StringDictionary(np.asarray([str(expr.value)]))
@@ -130,7 +131,9 @@ class _Compiler:
                 data, valid = src.fn(env)
                 return f(data), valid
 
-            return CompiledExpr(ev, d_t)
+            # a cast of a literal is still a literal (NULL literals in
+            # CASE branches arrive here wrapped in a coercion Cast)
+            return CompiledExpr(ev, d_t, is_literal=src.is_literal)
 
         if isinstance(d_t, T.DoubleType) or isinstance(d_t, T.RealType):
             dtype = d_t.np_dtype

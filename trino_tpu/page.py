@@ -343,14 +343,18 @@ class Page:
         mask[:n] = True
         return Page(names, cols, jnp.asarray(mask))
 
-    def to_pylist(self) -> list[tuple]:
+    def to_pylist(self, extra=None) -> list[tuple]:
         """Materialize live rows on host as python tuples (result fetch).
 
         One batched device->host transfer for the whole page (the
         serialized-results fetch of the client protocol; batching
         matters when the device link has per-call latency). Packed
         pages with a host-known row count transfer only the live
-        prefix — the capacity padding never crosses the link."""
+        prefix — the capacity padding never crosses the link.
+
+        ``extra``: optional device pytree fetched IN THE SAME transfer
+        (deferred overflow flags ride along with the result data);
+        when given, returns (rows, extra_host)."""
         import jax
 
         k = self.known_rows if self.packed else None
@@ -360,7 +364,7 @@ class Page:
                 device_arrays.append(c.data[:k])
                 if c.valid is not None:
                     device_arrays.append(c.valid[:k])
-            host = jax.device_get(device_arrays)
+            host, extra_host = jax.device_get((device_arrays, extra))
             sel = np.arange(k)
             i = 0
         else:
@@ -369,7 +373,7 @@ class Page:
                 device_arrays.append(c.data)
                 if c.valid is not None:
                     device_arrays.append(c.valid)
-            host = jax.device_get(device_arrays)
+            host, extra_host = jax.device_get((device_arrays, extra))
             mask = host[0]
             sel = np.nonzero(mask)[0]
             i = 1
@@ -391,7 +395,10 @@ class Page:
                 for j in range(len(sel))
             ]
             cols.append(vals)
-        return [tuple(col[i] for col in cols) for i in range(len(sel))]
+        rows = [tuple(col[i] for col in cols) for i in range(len(sel))]
+        if extra is not None:
+            return rows, extra_host
+        return rows
 
 
 def _pyvalue(type_: T.DataType, v):

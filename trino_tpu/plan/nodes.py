@@ -49,6 +49,12 @@ class TableScan(PlanNode):
     #: the unit of source parallelism in fleet mode (the analog of a
     #: ConnectorSplit riding a task RPC, SPI/connector/ConnectorSplit.java)
     split: tuple[int, int] | None = None
+    #: TupleDomain-lite pushdown: connector column name ->
+    #: (lo, hi, lo_strict, hi_strict) storage-domain interval derived
+    #: from the filter above the scan (plan.optimizer); connectors with
+    #: ``supports_domains`` prune storage units by footer stats — the
+    #: filter always re-applies, so pruning is advisory-safe
+    domains: dict | None = None
 
 
 @dataclass

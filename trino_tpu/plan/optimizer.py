@@ -49,7 +49,62 @@ def optimize(plan: P.PlanNode, metadata: Metadata, session: Session) -> P.PlanNo
     plan = _rewrite_bottom_up(plan, _push_semijoin_filters)
     plan = _choose_build_sides(plan, metadata)
     plan = _prune_columns(plan)
+    plan = _rewrite_bottom_up(plan, _annotate_scan_domains)
     return plan
+
+
+def _annotate_scan_domains(node: P.PlanNode) -> P.PlanNode:
+    """Derive TupleDomain-lite intervals from Filter-over-scan
+    conjuncts and annotate the TableScan (the applyFilter pushdown,
+    SPI/connector/ConnectorMetadata.java applyFilter +
+    SPI/predicate/TupleDomain.java): comparisons of a scanned column
+    against a literal become per-column [lo, hi] bounds the connector
+    may prune storage units with. The Filter stays in place — pruning
+    is advisory, never subsuming."""
+    from trino_tpu.expr.compiler import _literal_device_value
+
+    if not isinstance(node, P.Filter) or not isinstance(
+        node.source, P.TableScan
+    ):
+        return node
+    scan = node.source
+    domains: dict[str, list] = {}
+    _MIRROR = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+    for conj in _conjuncts(node.predicate):
+        if not (isinstance(conj, Call) and conj.name in _MIRROR):
+            continue
+        a, b = conj.args
+        op = conj.name
+        if isinstance(a, Literal) and isinstance(b, InputRef):
+            a, b = b, a
+            op = _MIRROR[op]
+        if not (isinstance(a, InputRef) and isinstance(b, Literal)):
+            continue
+        if b.value is None or a.name not in scan.assignments:
+            continue
+        try:
+            v = _literal_device_value(b)
+        except Exception:
+            continue
+        cname = scan.assignments[a.name]
+        dom = domains.setdefault(cname, [None, None, False, False])
+        if op in ("gt", "ge"):
+            if dom[0] is None or v >= dom[0]:
+                dom[0], dom[2] = v, op == "gt"
+        elif op in ("lt", "le"):
+            if dom[1] is None or v <= dom[1]:
+                dom[1], dom[3] = v, op == "lt"
+        else:  # eq
+            dom[0], dom[2] = v, False
+            dom[1], dom[3] = v, False
+    if not domains:
+        return node
+    return dc_replace(
+        node,
+        source=dc_replace(
+            scan, domains={c: tuple(d) for c, d in domains.items()}
+        ),
+    )
 
 
 def _merge_adjacent_filters(node: P.PlanNode) -> P.PlanNode:

@@ -114,6 +114,10 @@ def plan_to_json(node: P.PlanNode) -> dict:
         )
         if node.split is not None:
             d.update(split=list(node.split))
+        if node.domains is not None:
+            d.update(domains=[
+                [c, list(dom)] for c, dom in node.domains.items()
+            ])
         return d
     if isinstance(node, P.RemoteSource):
         d.update(source_id=node.source_id)
@@ -239,6 +243,10 @@ def plan_from_json(d: dict) -> P.PlanNode:
             table=d["table"], assignments=dict(d["assignments"]),
             hash_varchar=d.get("hash_varchar"),
             split=(tuple(d["split"]) if d.get("split") else None),
+            domains=(
+                {c: tuple(dom) for c, dom in d["domains"]}
+                if d.get("domains") else None
+            ),
         )
     if kind == "RemoteSource":
         return P.RemoteSource(outputs, source_id=d["source_id"])

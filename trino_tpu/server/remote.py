@@ -45,7 +45,7 @@ class RemoteRunner:
         self._planner = QueryRunner(metadata, session)
         self._planner.mesh = _FakeMesh(n_shards)
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str, cancel_event=None) -> QueryResult:
         plan = self._planner.plan_sql(sql)
         req = {
             "plan": plan_to_json(plan),
@@ -59,28 +59,55 @@ class RemoteRunner:
         with urllib.request.urlopen(r) as resp:
             task_id = json.loads(resp.read())["taskId"]
         deadline = time.monotonic() + self.timeout_s
+        types = [plan.outputs[s] for s in plan.symbols]
+        rows: list[tuple] = []
+        names: list[str] = []
+        token = 0
         while True:
+            if cancel_event is not None and cancel_event.is_set():
+                self.cancel(task_id)
+                raise RuntimeError("Query was canceled")
             with urllib.request.urlopen(
-                f"{self.uri}/v1/task/{task_id}/results"
+                f"{self.uri}/v1/task/{task_id}/results/{token}"
             ) as resp:
                 payload = json.loads(resp.read())
             if payload["state"] == "FINISHED":
-                types = [plan.outputs[s] for s in plan.symbols]
-                rows = [
-                    tuple(
-                        _decode(v, t) for v, t in zip(row, types)
+                # token-paged columnar batches: decode and accumulate
+                # until nextToken drains (StatementClientV1's nextUri
+                # loop, client/trino-client/.../StatementClientV1.java:68)
+                names = list(payload["columns"])
+                cols = payload["cols"]
+                nulls = payload["nulls"]
+                n = len(cols[0]) if cols else 0
+                for i in range(n):
+                    rows.append(tuple(
+                        None
+                        if (nulls[j] is not None and nulls[j][i])
+                        else _decode(cols[j][i], t)
+                        for j, t in enumerate(types)
+                    ))
+                if payload["nextToken"] is None:
+                    return QueryResult(
+                        names=names, rows=rows,
+                        ordered=_has_order(plan), plan=plan,
                     )
-                    for row in payload["data"]
-                ]
-                return QueryResult(
-                    names=list(payload["columns"]), rows=rows,
-                    ordered=_has_order(plan), plan=plan,
-                )
-            if payload["state"] == "FAILED":
+                token = payload["nextToken"]
+                continue
+            if payload["state"] in ("FAILED", "CANCELED"):
                 raise RuntimeError(payload.get("error", "task failed"))
             if time.monotonic() > deadline:
                 raise TimeoutError(f"task {task_id} timed out")
             time.sleep(self.poll_s)
+
+    def cancel(self, task_id: str) -> None:
+        """DELETE the worker task (cooperative cancel + result free)."""
+        r = urllib.request.Request(
+            f"{self.uri}/v1/task/{task_id}", method="DELETE"
+        )
+        try:
+            urllib.request.urlopen(r, timeout=10).read()
+        except Exception:
+            pass
 
 
 class _FakeMesh:
