@@ -1,0 +1,224 @@
+"""ARRAY type end-to-end: offsets+values pools, memory-connector
+round trips, subscript/cardinality/contains, UNNEST over real array
+columns (vs sqlite's json_each oracle), array_agg.
+
+The analog of the reference's ArrayBlock + array functions + unnest
+operator (SPI/block/ArrayBlock.java, MAIN/operator/scalar/,
+MAIN/operator/unnest/UnnestOperator.java:44), lowered to the engine's
+pool+handle design: the offsets+values columnar layout lives host-side
+(like VARCHAR dictionaries), device columns carry int32 handles, and
+array functions compile to host LUT + device gather.
+"""
+
+import json
+import sqlite3
+
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.metadata import Metadata, Session
+
+
+@pytest.fixture()
+def runner():
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table t (id bigint, arr array(bigint), name varchar)")
+    r.execute(
+        "insert into t values "
+        "(1, array[10, 20, 30], 'a'), "
+        "(2, array[], 'b'), "
+        "(3, array[7], 'c'), "
+        "(4, null, 'd'), "
+        "(5, array[5, 5, 1000000000000], 'e')"
+    )
+    return r
+
+
+def _json_each_oracle(rows):
+    """sqlite json_each as the UNNEST oracle."""
+    conn = sqlite3.connect(":memory:")
+    conn.execute("create table t (id integer, arr text, name text)")
+    conn.executemany(
+        "insert into t values (?, ?, ?)",
+        [
+            (i, None if a is None else json.dumps(a), n)
+            for i, a, n in rows
+        ],
+    )
+    return conn
+
+
+ROWS = [
+    (1, [10, 20, 30], "a"),
+    (2, [], "b"),
+    (3, [7], "c"),
+    (4, None, "d"),
+    (5, [5, 5, 1000000000000], "e"),
+]
+
+
+def test_array_round_trip(runner):
+    rows = runner.execute("select id, arr, name from t order by id").rows
+    assert rows == ROWS
+
+
+def test_cardinality_and_subscript(runner):
+    rows = runner.execute(
+        "select id, cardinality(arr), arr[1], arr[3] from t order by id"
+    ).rows
+    assert rows == [
+        (1, 3, 10, 30),
+        (2, 0, None, None),
+        (3, 1, 7, None),
+        (4, None, None, None),
+        (5, 3, 5, 1000000000000),
+    ]
+
+
+def test_contains(runner):
+    rows = runner.execute(
+        "select id from t where contains(arr, 5) order by id"
+    ).rows
+    assert rows == [(5,)]
+    rows = runner.execute(
+        "select id, contains(arr, 7) from t order by id"
+    ).rows
+    assert rows == [(1, False), (2, False), (3, True), (4, None), (5, False)]
+
+
+def test_unnest_array_column_vs_json_each(runner):
+    """UNNEST(t.arr) must match sqlite's json_each over identical
+    data (the VERDICT's oracle for real array-column unnest)."""
+    got = runner.execute(
+        "select id, e from t, unnest(arr) as u(e) order by id, e"
+    ).rows
+    oracle = _json_each_oracle(ROWS)
+    expected = oracle.execute(
+        "select t.id, j.value from t, json_each(t.arr) j "
+        "order by t.id, j.value"
+    ).fetchall()
+    assert [(i, int(e)) for i, e in got] == [
+        (i, int(e)) for i, e in expected
+    ]
+
+
+def test_unnest_keeps_source_columns(runner):
+    got = runner.execute(
+        "select name, e from t, unnest(arr) as u(e) "
+        "where e >= 20 order by name, e"
+    ).rows
+    assert got == [("a", 20), ("a", 30), ("e", 1000000000000)]
+
+
+def test_unnest_aggregate_over_elements(runner):
+    got = runner.execute(
+        "select id, count(*) c, sum(e) s from t, unnest(arr) as u(e) "
+        "group by id order by id"
+    ).rows
+    assert got == [(1, 3, 60), (3, 1, 7), (5, 3, 1000000000010)]
+
+
+def test_array_agg_grouped():
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table s (g varchar, v bigint)")
+    r.execute(
+        "insert into s values ('x', 3), ('y', 1), ('x', 2), "
+        "('y', 4), ('x', null)"
+    )
+    rows = dict(r.execute(
+        "select g, array_agg(v) from s group by g"
+    ).rows)
+    # NULL inputs are skipped; within-group order is not guaranteed
+    assert sorted(rows["x"]) == [2, 3]
+    assert sorted(rows["y"]) == [1, 4]
+
+
+def test_array_agg_global_and_varchar_elements():
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table s (v varchar)")
+    r.execute("insert into s values ('b'), ('a'), ('c')")
+    (arr,) = r.execute("select array_agg(v) from s").rows[0]
+    assert sorted(arr) == ["a", "b", "c"]
+
+
+def test_array_roundtrip_through_worker_seam(runner):
+    """Array results serialize as JSON lists through the paged result
+    protocol (page_to_host decode + columnar batches)."""
+    from trino_tpu.exec.spool import page_to_host
+
+    plan, page = runner.execute_page("select id, arr from t")
+    payload = page_to_host(page)
+    i = payload["names"].index(payload["names"][1])
+    lists = payload["cols"][1][0]
+    assert list(lists[0]) == [10, 20, 30]
+
+
+def test_unnest_varchar_array():
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table s (id bigint, tags array(varchar))")
+    r.execute(
+        "insert into s values (1, array['red', 'blue']), "
+        "(2, array['green'])"
+    )
+    rows = r.execute(
+        "select id, tag from s, unnest(tags) as u(tag) order by id, tag"
+    ).rows
+    assert rows == [(1, "blue"), (1, "red"), (2, "green")]
+    rows = r.execute(
+        "select id, tags[1], cardinality(tags) from s order by id"
+    ).rows
+    assert rows == [(1, "red", 2), (2, "green", 1)]
+
+
+def test_array_decimal_and_date_elements_storage():
+    """Array ELEMENTS convert to storage form on insert (unscaled
+    decimals, day-number dates) — review finding: raw Decimals/strings
+    were landing in int64 pools."""
+    from decimal import Decimal
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute(
+        "create table s (id bigint, ds array(decimal(5,1)), "
+        "dd array(date))"
+    )
+    r.execute(
+        "insert into s values (1, array[1.5, 2.0], "
+        "array[date '2020-01-01', date '2020-01-03'])"
+    )
+    rows = r.execute("select id, ds, dd, ds[2], dd[1] from s").rows
+    assert rows == [(
+        1,
+        [Decimal("1.5"), Decimal("2.0")],
+        ["2020-01-01", "2020-01-03"],
+        Decimal("2.0"),
+        "2020-01-01",
+    )]
+
+
+def test_unnest_empty_input_and_guards(runner):
+    # empty source after a filter: zero expanded rows, no crash
+    rows = runner.execute(
+        "select id, e from t, unnest(arr) as u(e) where id > 100"
+    ).rows
+    assert rows == []
+    from trino_tpu.analyzer.scope import AnalysisError
+
+    with pytest.raises(AnalysisError, match="GROUP BY over ARRAY"):
+        runner.execute("select arr, count(*) from t group by arr")
+    with pytest.raises(AnalysisError, match="DISTINCT over ARRAY"):
+        runner.execute("select distinct arr from t")
+    with pytest.raises(AnalysisError, match="ORDER BY over ARRAY"):
+        runner.execute("select id, arr from t order by arr")
+    with pytest.raises(AnalysisError, match="empty ARRAY"):
+        runner.execute("select e from t, unnest(array[]) as u(e)")

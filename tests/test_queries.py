@@ -404,3 +404,17 @@ def test_unnest_aggregate_over_lateral(runner, oracle):
         "(select sum(n_regionkey) * 100 from nation)"
     ).fetchall()
     assert got[0][0] == expect[0][0]
+
+
+def test_order_by_non_selected_source_column(runner, oracle):
+    """ORDER BY may reach the FROM scope when no aggregation or
+    DISTINCT intervenes (reference scoping rules): the select Project
+    widens to carry the sort column, pruned above the Sort."""
+    sql = (
+        "select l_quantity from lineitem "
+        "order by l_orderkey, l_linenumber limit 5"
+    )
+    result = runner.execute(sql)
+    expected = oracle.execute(to_sqlite(sql)).fetchall()
+    assert_rows_match(result.rows, expected, ordered=True)
+    assert [len(r) for r in result.rows] == [1] * 5  # pruned output

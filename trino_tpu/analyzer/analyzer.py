@@ -130,13 +130,17 @@ class Analyzer:
                 n.lower(): f.symbol
                 for n, f in zip(names, rp.scope.fields)
             }
+            pre_scope = None
         else:
             rp, names, alias_syms, pre_scope = self.plan_select(
                 q.select, outer, ctes
             )
         node = rp.node
         if q.order_by:
-            keys, node = self._order_keys(q.order_by, node, rp.scope, alias_syms)
+            keys, node = self._order_keys(
+                q.order_by, node, rp.scope, alias_syms,
+                src_scope=pre_scope,
+            )
             if q.limit is not None and q.offset is None:
                 node = P.TopN(dict(node.outputs), source=node, count=q.limit, keys=keys)
             else:
@@ -281,9 +285,13 @@ class Analyzer:
         )
         return node, out_syms
 
-    def _order_keys(self, order_by, node, scope: Scope, alias_syms: dict):
+    def _order_keys(
+        self, order_by, node, scope: Scope, alias_syms: dict,
+        src_scope: Scope | None = None,
+    ):
         keys = []
         extras: dict[str, RowExpression] = {}
+        src_extras: dict[str, RowExpression] = {}
         for item in order_by:
             e = item.expr
             sym = None
@@ -300,15 +308,43 @@ class Analyzer:
                 # general expression over the output columns (the
                 # reference's OrderingScheme allows any expression over
                 # the query's output scope): compute it in a pre-sort
-                # Project; the Output node above prunes it afterwards
-                ea = ExprAnalyzer(self, scope)
-                ir = ea.analyze(e)
-                if isinstance(ir, InputRef) and ir.name in node.outputs:
-                    sym = ir.name
-                else:
+                # Project; the Output node above prunes it afterwards.
+                # Falls back to the FROM scope for non-selected source
+                # columns (SQL: ORDER BY may reach the source relation
+                # when no aggregation/DISTINCT intervenes).
+                try:
+                    ea = ExprAnalyzer(self, scope)
+                    ir = ea.analyze(e)
+                except AnalysisError:
+                    if src_scope is None or not isinstance(node, P.Project):
+                        raise
+                    ea = ExprAnalyzer(self, src_scope)
+                    ir = ea.analyze(e)
                     sym = self.symbols.new("orderkey", ir.type)
-                    extras[sym] = ir
+                    src_extras[sym] = ir
+                if sym is None:
+                    if isinstance(ir, InputRef) and ir.name in node.outputs:
+                        sym = ir.name
+                    else:
+                        sym = self.symbols.new("orderkey", ir.type)
+                        extras[sym] = ir
+            if isinstance(node.outputs.get(sym), T.ArrayType) or (
+                sym in extras and isinstance(extras[sym].type, T.ArrayType)
+            ) or (
+                sym in src_extras
+                and isinstance(src_extras[sym].type, T.ArrayType)
+            ):
+                raise AnalysisError("ORDER BY over ARRAY is not supported")
             keys.append(P.SortKey(sym, item.ascending, item.nulls_first))
+        if src_extras:
+            # widen the select Project to carry the source-scope sort
+            # columns through (pruned above the Sort by Output)
+            assignments = dict(node.assignments)
+            assignments.update(src_extras)
+            node = P.Project(
+                {s: x.type for s, x in assignments.items()},
+                source=node.source, assignments=assignments,
+            )
         if extras:
             assignments: dict[str, RowExpression] = {
                 s: InputRef(t, s) for s, t in node.outputs.items()
@@ -405,6 +441,7 @@ class Analyzer:
             if item.alias:
                 alias_syms[item.alias.lower()] = sym
             alias_syms[_ast_key(item.expr)] = sym
+        src_scope = scope  # the FROM scope (ORDER BY may reach it)
         node = P.Project(
             {s: e.type for s, e in assignments.items()},
             source=node,
@@ -413,11 +450,23 @@ class Analyzer:
         scope = Scope(fields, parent=outer)
 
         if sel.distinct:
+            if any(
+                isinstance(t, T.ArrayType) for t in node.outputs.values()
+            ):
+                raise AnalysisError(
+                    "SELECT DISTINCT over ARRAY columns is not supported"
+                )
             node = P.Aggregate(
                 dict(node.outputs), source=node,
                 group_keys=list(node.outputs), aggregates={},
             )
-        return RelationPlan(node, scope), names, alias_syms, scope
+        # the source scope is usable for ORDER BY only when the select
+        # neither aggregates nor deduplicates (SQL scoping rules)
+        order_src = (
+            None if (sel.distinct or sel.group_by or agg_items)
+            else src_scope
+        )
+        return RelationPlan(node, scope), names, alias_syms, order_src
 
     # ---- FROM relations --------------------------------------------------
     def plan_relation(self, rel: ast.Relation, outer: Scope | None, ctes: dict) -> RelationPlan:
@@ -472,7 +521,22 @@ class Analyzer:
         arrays = []
         elem_types = []
         for items in rel.args:
+            if not isinstance(items, list):
+                # UNNEST over an ARRAY-typed expression (a real array
+                # COLUMN): the executor expands it from its pool
+                ir = ea.analyze(items)
+                if not isinstance(ir.type, T.ArrayType):
+                    raise AnalysisError(
+                        f"UNNEST argument must be an ARRAY, got {ir.type}"
+                    )
+                arrays.append(ir)
+                elem_types.append(ir.type.element)
+                continue
             irs = [ea.analyze(e) for e in items]
+            if not irs:
+                raise AnalysisError(
+                    "UNNEST over an empty ARRAY[] has no element type"
+                )
             t = irs[0].type
             for ir in irs[1:]:
                 t = T.common_super_type(t, ir.type)
@@ -870,6 +934,11 @@ class Analyzer:
                 return key_replacements[k].name
             ea = ExprAnalyzer(self, scope, outer_refs=outer_refs)
             ir = ea.analyze(g)
+            if isinstance(ir.type, T.ArrayType):
+                raise AnalysisError(
+                    "GROUP BY over ARRAY is not supported (array "
+                    "handles carry no value equality)"
+                )
             if isinstance(ir, InputRef):
                 sym = ir.name
             else:
@@ -1629,6 +1698,47 @@ class ExprAnalyzer:
         ir_name, rt_fn = SCALAR_FNS[name]
         args = tuple(self.analyze(a) for a in e.args)
         return Call(rt_fn([a.type for a in args]), ir_name, args)
+
+    def _ArrayLit(self, e: "ast.ArrayLit"):
+        """ARRAY[...] of constants -> a typed array Literal whose value
+        is a python tuple in STORAGE form (days/unscaled ints/strings).
+        Non-constant elements would need a per-row pool build; only
+        constants are supported (the dominant SQL shape: IN-style
+        arrays, INSERT values, UNNEST literals)."""
+        from trino_tpu.expr.compiler import _literal_device_value
+
+        irs = [self.analyze(a) for a in e.items]
+        if not irs:
+            return Literal(T.ArrayType(T.UNKNOWN), ())
+        t = irs[0].type
+        for ir in irs[1:]:
+            t = T.common_super_type(t, ir.type)
+        vals = []
+        for ir in irs:
+            base = ir
+            if isinstance(base, Cast):
+                base = base.arg
+            if not isinstance(base, Literal):
+                raise AnalysisError(
+                    "ARRAY elements must be constants in this context"
+                )
+            if base.value is None:
+                raise AnalysisError(
+                    "NULL array elements are not supported yet"
+                )
+            vals.append(_literal_device_value(
+                base if base.type == t else Literal(t, base.value)
+            ))
+        return Literal(T.ArrayType(t), tuple(vals))
+
+    def _Subscript(self, e: "ast.Subscript"):
+        base = self.analyze(e.base)
+        idx = self.analyze(e.index)
+        if not isinstance(base.type, T.ArrayType):
+            raise AnalysisError(
+                f"cannot subscript {base.type} (ARRAY expected)"
+            )
+        return Call(base.type.element, "subscript", (base, idx))
 
     def _ScalarSubquery(self, e):
         raise AnalysisError(

@@ -155,6 +155,8 @@ def agg_result_type(name: str, arg_type: T.DataType | None) -> T.DataType:
         return arg_type  # value argument's type
     if name in ("max_by", "min_by"):
         return arg_type  # first argument's type
+    if name == "array_agg":
+        return T.ArrayType(arg_type)
     raise AnalysisError(f"unknown aggregate function {name}")
 
 
@@ -163,12 +165,23 @@ AGG_FNS = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "count_if", "approx_distinct",
     "approx_percentile",
-    "max_by", "min_by",
+    "max_by", "min_by", "array_agg",
 }
 
 #: scalar fn name -> (ir_name, result_type fn(arg_types))
+def _array_elem(ts):
+    if not ts or not isinstance(ts[0], T.ArrayType):
+        raise AnalysisError("argument must be an ARRAY")
+    return ts[0].element
+
+
 SCALAR_FNS = {
     "abs": ("abs", lambda ts: ts[0]),
+    # arrays (reference: MAIN/operator/scalar/ArrayFunctions +
+    # CardinalityFunction, ArrayContains, ElementAt)
+    "cardinality": ("cardinality", lambda ts: T.BIGINT),
+    "contains": ("contains", lambda ts: T.BOOLEAN),
+    "element_at": ("subscript", lambda ts: _array_elem(ts)),
     "sqrt": ("sqrt", lambda ts: T.DOUBLE),
     "floor": ("floor", lambda ts: ts[0]),
     "ceil": ("ceil", lambda ts: ts[0]),

@@ -40,8 +40,8 @@ class _Table:
 
 
 def _storage_dtype(t: T.DataType):
-    if isinstance(t, T.VarcharType):
-        return object
+    if isinstance(t, (T.VarcharType, T.ArrayType)):
+        return object  # arrays store python lists (None = NULL)
     return t.np_dtype
 
 

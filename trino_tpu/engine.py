@@ -96,7 +96,10 @@ class QueryRunner:
         plan = analyzer.analyze(stmt)
         if optimized:
             plan = optimize(plan, self.metadata, self.session)
-        if self.mesh is not None:
+        if self.mesh is not None and not _has_arrays(plan):
+            # ARRAY columns live in host pools whose handles cannot
+            # shard over the mesh yet: array-bearing plans execute on
+            # the local paths even with a mesh attached
             from trino_tpu.plan.distribute import add_exchanges
 
             plan = add_exchanges(
@@ -405,6 +408,14 @@ def _fmt_bytes(n: int) -> str:
     return f"{n}B"
 
 
+def _has_arrays(plan: P.PlanNode) -> bool:
+    from trino_tpu import types as T
+
+    if any(isinstance(t, T.ArrayType) for t in plan.outputs.values()):
+        return True
+    return any(_has_arrays(s) for s in plan.sources)
+
+
 def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:
     """Python result rows -> host storage columns (values, valid)."""
     import numpy as np
@@ -415,7 +426,13 @@ def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:
     for i, (c, t) in enumerate(zip(names, [ts.column_type(n) for n in names])):
         raw = [r[i] for r in rows]
         valid = np.array([v is not None for v in raw], dtype=bool)
-        if isinstance(t, T.VarcharType):
+        if isinstance(t, T.ArrayType):
+            vals = np.empty(len(raw), dtype=object)
+            for j, v in enumerate(raw):
+                vals[j] = None if v is None else [
+                    _elem_storage(x, t.element) for x in v
+                ]
+        elif isinstance(t, T.VarcharType):
             vals = np.array(
                 ["" if v is None else str(v) for v in raw], dtype=object
             )
@@ -453,6 +470,23 @@ def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:
             )
         out[c] = (vals, None if valid.all() else valid)
     return out
+
+
+def _elem_storage(v, t):
+    """One array ELEMENT -> the element type's storage form (mirrors
+    the scalar branches of _rows_to_columns: days for dates, unscaled
+    ints for decimals, micros for timestamps)."""
+    from trino_tpu import types as T
+
+    if isinstance(t, T.DecimalType):
+        return _to_unscaled(v, t.scale)
+    if isinstance(t, T.DateType):
+        return T.parse_date(v) if isinstance(v, str) else int(v)
+    if isinstance(t, T.TimestampType):
+        return T.parse_timestamp(v) if isinstance(v, str) else int(v)
+    if isinstance(t, T.VarcharType):
+        return str(v)
+    return v
 
 
 def _to_unscaled(v, scale: int) -> int:
@@ -493,6 +527,11 @@ def _literal_value(e: ast.Expr, t):
         from decimal import Decimal
 
         return -Decimal(e.arg.text)
+    if isinstance(e, ast.ArrayLit):
+        from trino_tpu import types as T
+
+        elem = t.element if isinstance(t, T.ArrayType) else None
+        return [_literal_value(x, elem) for x in e.items]
     raise NotImplementedError(
         f"INSERT VALUES supports literals only, got {type(e).__name__}"
     )

@@ -214,6 +214,20 @@ class LocalExecutor:
         the working-set analog of the reference's spillable aggregation
         (MAIN/operator/aggregation/builder/SpillableHashAggregationBuilder.java:46);
         the chunk partials play the role of spilled sorted runs."""
+        agg_arr = next(
+            (
+                i for i, n in enumerate(chain)
+                if isinstance(n, P.Aggregate)
+                and any(
+                    c.name == "array_agg" for c in n.aggregates.values()
+                )
+            ),
+            None,
+        )
+        if agg_arr is not None:
+            # array construction is host-resident by design (pools);
+            # the aggregation runs as a host group-by
+            return self._host_array_agg(chain, agg_arr, page)
         # adaptive filter split: a selective leading Filter shrinks the
         # working capacity for the whole rest of the chain (dead-row
         # sorts/gathers dominate otherwise). Selectivity is learned per
@@ -297,6 +311,7 @@ class LocalExecutor:
                             out_layout.types[s], env[s][0], env[s][1],
                             out_layout.dicts.get(s),
                             out_layout.pools.get(s),
+                            out_layout.arrays.get(s),
                         )
                         for s in out_layout.names
                     ],
@@ -326,6 +341,98 @@ class LocalExecutor:
                 chain, env, mask, int(n_live), out_layout
             )
 
+    def _host_array_agg(self, chain, agg_i: int, page: Page) -> Page:
+        """array_agg as a host group-by building ArrayPools (the
+        reference's ArrayAggregationFunction materializes per-group
+        BlockBuilders the same way; pools are host-side here by
+        design). NULL inputs are skipped. Other aggregates cannot mix
+        with array_agg in one GROUP BY yet."""
+        from trino_tpu.exec.spool import page_to_host
+
+        nd = chain[agg_i]
+        for call in nd.aggregates.values():
+            if call.name != "array_agg":
+                raise NotImplementedError(
+                    "array_agg cannot combine with other aggregates "
+                    "in one GROUP BY yet"
+                )
+            if not (len(call.args) == 1 and isinstance(call.args[0], InputRef)):
+                raise NotImplementedError(
+                    "array_agg argument must be a plain column"
+                )
+        if chain[:agg_i]:
+            page = self._run_chain(chain[:agg_i], page)
+        payload = page_to_host(self._compact(page))
+        col_of = dict(zip(payload["names"], payload["cols"]))
+        type_of = dict(zip(payload["names"], payload["types"]))
+        n = len(payload["cols"][0][0]) if payload["cols"] else 0
+        keys = list(nd.group_keys)
+        if keys:
+            lanes = []
+            for k in reversed(keys):
+                v, valid = col_of[k]
+                if v.dtype == object or v.dtype.kind == "U":
+                    _u, codes = np.unique(v.astype(str), return_inverse=True)
+                    lanes.append(codes)
+                else:
+                    lanes.append(v)
+                if valid is not None:
+                    lanes.append((~valid).astype(np.int8))
+            order = np.lexsort(lanes)
+        else:
+            order = np.arange(n)
+        # group boundaries over the sorted rows
+        def key_tuple(i):
+            out = []
+            for k in keys:
+                v, valid = col_of[k]
+                out.append(
+                    None if (valid is not None and not valid[i]) else v[i]
+                )
+            return tuple(out)
+
+        groups: list[list[int]] = []
+        last = object()
+        for i in order:
+            kt = key_tuple(i) if keys else ()
+            if kt != last:
+                groups.append([])
+                last = kt
+            groups[-1].append(i)
+        if not keys and not groups:
+            groups = [[]]
+        out_named: dict[str, tuple] = {}
+        for k in keys:
+            v, valid = col_of[k]
+            firsts = [g[0] for g in groups]
+            kv = v[firsts] if len(firsts) else v[:0]
+            kval = None if valid is None else valid[firsts]
+            out_named[k] = (type_of[k], kv, kval)
+        for sym, call in nd.aggregates.items():
+            src = call.args[0].name
+            v, valid = col_of[src]
+            lists = np.empty(len(groups), dtype=object)
+            for gi, g in enumerate(groups):
+                lists[gi] = [
+                    v[i] for i in g
+                    if valid is None or valid[i]
+                ]
+            out_named[sym] = (nd.outputs[sym], lists, None)
+        cap = pad_capacity(max(len(groups), 1))
+        names, cols = [], []
+        for s, (t, vals, valid) in out_named.items():
+            names.append(s)
+            cols.append(Column.from_numpy(t, vals, valid=valid, capacity=cap))
+        m = np.zeros(cap, dtype=np.bool_)
+        m[: len(groups)] = True
+        out = Page(
+            names, cols, jnp.asarray(m),
+            known_rows=len(groups), packed=True,
+        )
+        if chain[agg_i + 1:]:
+            return self._run_chain(chain[agg_i + 1:], out)
+        return out
+
     def _dispatch_chain(self, chain, page: Page, caps):
         """Compile (cached) + dispatch one fused chain program without
         waiting for the result — callers sync when they need the flags
@@ -354,6 +461,11 @@ class LocalExecutor:
                     for n, c in zip(page.names, page.columns)
                     if c.hash_pool is not None
                 },
+                arrays={
+                    n: c.array_pool
+                    for n, c in zip(page.names, page.columns)
+                    if c.array_pool is not None
+                },
             )
             fn, out_layout = stage.build_chain(chain, in_layout, caps)
 
@@ -375,6 +487,7 @@ class LocalExecutor:
                 env[s][1],
                 out_layout.dicts.get(s),
                 out_layout.pools.get(s),
+                out_layout.arrays.get(s),
             )
             for s in out_layout.names
         ]
@@ -414,6 +527,10 @@ class LocalExecutor:
             dictionaries={
                 n: c.dictionary for n, c in zip(page.names, page.columns)
             },
+            array_pools={
+                n: c.array_pool for n, c in zip(page.names, page.columns)
+                if c.array_pool is not None
+            },
         )
 
     def _layout_sig(self, page: Page) -> tuple:
@@ -421,6 +538,7 @@ class LocalExecutor:
             (
                 n, repr(c.type), id(c.dictionary),
                 None if c.hash_pool is None else c.hash_pool.token,
+                None if c.array_pool is None else c.array_pool.token,
                 c.valid is not None,
             )
             for n, c in zip(page.names, page.columns)
@@ -658,7 +776,7 @@ class LocalExecutor:
             self._jit_cache[key] = fn
         env2, mask2 = fn(self._env(page), page.mask)
         cols = [
-            Column(c.type, *env2[s], c.dictionary, c.hash_pool)
+            Column(c.type, *env2[s], c.dictionary, c.hash_pool, c.array_pool)
             for s, c in zip(page.names, page.columns)
         ]
         out = Page(list(page.names), cols, mask2)
@@ -882,7 +1000,7 @@ class LocalExecutor:
         for page in (left, right):
             for nm, c in zip(page.names, page.columns):
                 names.append(nm)
-                cols.append(Column(c.type, *env2[nm], c.dictionary, c.hash_pool))
+                cols.append(Column(c.type, *env2[nm], c.dictionary, c.hash_pool, c.array_pool))
         out = Page(names, cols, mask)
         out.known_rows = n_l * n_r
         out.packed = True
@@ -1128,7 +1246,7 @@ class LocalExecutor:
             order, lo, cnt,
         )
         cols = [
-            Column(t, *env2[s], d, hp) for s, _fp, t, d, hp in out_meta
+            Column(t, *env2[s], d, hp, ap) for s, _fp, t, d, hp, ap in out_meta
         ]
         out = Page([s for s, *_ in out_meta], cols, mask2)
         if (
@@ -1151,12 +1269,13 @@ class LocalExecutor:
         criteria = list(node.criteria)
         kind = node.kind
         p_cap, b_cap = probe.capacity, build.capacity
-        out_meta = []  # (sym, from_probe, type, dictionary, hash_pool)
+        out_meta = []  # (sym, from_probe, type, dict, hash_pool, array_pool)
         for sym in node.outputs:
             from_probe = sym in probe.names
             c = (probe if from_probe else build).column(sym)
             out_meta.append(
-                (sym, from_probe, c.type, c.dictionary, c.hash_pool)
+                (sym, from_probe, c.type, c.dictionary, c.hash_pool,
+                 c.array_pool)
             )
         filter_c = None
         fsyms: list[str] = []
@@ -1180,7 +1299,7 @@ class LocalExecutor:
                     bb, _ = K.normalize_key(bd, None)
                     out_live = out_live & (pb[probe_idx] == bb[build_idx])
             inner = {}
-            for sym, from_probe, _t, _d, _hp in out_meta:
+            for sym, from_probe, _t, _d, _hp, _ap in out_meta:
                 d, v = (penv if from_probe else benv)[sym]
                 idx = probe_idx if from_probe else build_idx
                 inner[sym] = (d[idx], None if v is None else v[idx])
@@ -1196,7 +1315,7 @@ class LocalExecutor:
             if kind in ("left", "full"):
                 matched = K.range_any(cnt, out_live)
                 unmatched = pmask & ~matched
-                for sym, from_probe, _t, _d, _hp in out_meta:
+                for sym, from_probe, _t, _d, _hp, _ap in out_meta:
                     if from_probe:
                         sections[sym].append(penv[sym])
                     else:
@@ -1209,7 +1328,7 @@ class LocalExecutor:
             if kind == "full":
                 bmatched = K.scatter_any(build_idx, out_live, b_cap)
                 bunmatched = bmask & ~bmatched
-                for sym, from_probe, _t, _d, _hp in out_meta:
+                for sym, from_probe, _t, _d, _hp, _ap in out_meta:
                     if from_probe:
                         d0, _ = penv[sym]
                         sections[sym].append((
@@ -1328,8 +1447,13 @@ class LocalExecutor:
         """Static-fanout UNNEST (UnnestOperator analog,
         MAIN/operator/unnest/UnnestOperator.java): output position
         t = i * k + j holds element j of source row i — one reshape,
-        no data-dependent shapes. Shorter zipped arrays NULL-pad."""
+        no data-dependent shapes. Shorter zipped arrays NULL-pad.
+
+        UNNEST over real ARRAY columns takes the pool-expansion path
+        (_unnest_columns) — lengths are data-dependent there."""
         page = self.execute(node.source)
+        if any(not isinstance(a, tuple) for a in node.arrays):
+            return self._unnest_columns(node, page)
         k = max(len(a) for a in node.arrays)
         cap = page.capacity
         out_cap = cap * k
@@ -1436,11 +1560,122 @@ class LocalExecutor:
         names, cols = [], []
         for nm, c in zip(page.names, page.columns):
             names.append(nm)
-            cols.append(Column(c.type, *env2[nm], c.dictionary, c.hash_pool))
+            cols.append(Column(c.type, *env2[nm], c.dictionary, c.hash_pool, c.array_pool))
         for sym, d in zip(node.element_symbols, elem_dicts):
             names.append(sym)
             cols.append(Column(node.outputs[sym], *env2[sym], d))
         return Page(names, cols, mask2)
+
+    def _unnest_columns(self, node: P.Unnest, page: Page) -> Page:
+        """UNNEST over ARRAY-typed columns: row lengths come from the
+        host pool (offsets+values layout), the expansion index builds
+        host-side (np.repeat over live rows), source columns gather
+        device-side by the uploaded index, and element columns build
+        from pool slices (UnnestOperator over ArrayBlock,
+        MAIN/operator/unnest/UnnestOperator.java:44). Multiple arrays
+        zip; shorter ones NULL-pad (Trino semantics)."""
+        mask = np.asarray(page.mask)
+        sel = np.nonzero(mask)[0]
+        args = []
+        for a in node.arrays:
+            if isinstance(a, tuple):
+                raise NotImplementedError(
+                    "mixing ARRAY literals and ARRAY columns in one "
+                    "UNNEST is not supported"
+                )
+            if not isinstance(a, InputRef):
+                raise NotImplementedError(
+                    "UNNEST argument must be an ARRAY column reference"
+                )
+            c = page.column(a.name)
+            if c.array_pool is None:
+                raise NotImplementedError(
+                    f"UNNEST: {a.name} carries no array pool"
+                )
+            handles = np.asarray(c.data)[sel]
+            valid = (
+                None if c.valid is None else np.asarray(c.valid)[sel]
+            )
+            lens = c.array_pool.lengths()[handles]
+            if valid is not None:
+                lens = np.where(valid, lens, 0)
+            args.append((c.array_pool, handles, lens))
+        row_len = args[0][2]
+        for _, _, ln in args[1:]:
+            row_len = np.maximum(row_len, ln)
+        total = int(row_len.sum())
+        if total == 0:
+            # empty expansion (no live rows / all arrays empty-or-NULL)
+            cap0 = pad_capacity(1)
+            names0 = list(page.names) + list(node.element_symbols)
+            cols0 = [
+                Column(c.type, c.data[:cap0],
+                       None if c.valid is None else c.valid[:cap0],
+                       c.dictionary, c.hash_pool, c.array_pool)
+                for c in page.columns
+            ] + [
+                Column.from_numpy(
+                    node.outputs[s],
+                    np.zeros(
+                        0,
+                        dtype=object if isinstance(
+                            node.outputs[s], T.VarcharType
+                        ) else node.outputs[s].np_dtype,
+                    ),
+                    capacity=cap0,
+                )
+                for s in node.element_symbols
+            ]
+            return Page(
+                names0, cols0,
+                jnp.zeros((cap0,), dtype=jnp.bool_),
+                known_rows=0, packed=True,
+            )
+        out_cap = pad_capacity(max(total, 1))
+        # source-row index per output row + within-array position
+        src = np.repeat(sel, row_len)
+        starts = np.concatenate([[0], np.cumsum(row_len)[:-1]])
+        within = np.arange(total, dtype=np.int64) - np.repeat(starts, row_len)
+        # gather the source columns device-side by the uploaded index
+        idx_pad = np.zeros(out_cap, dtype=np.int32)
+        idx_pad[:total] = src
+        idx_dev = jnp.asarray(idx_pad)
+        names, cols = [], []
+        for n2, c in zip(page.names, page.columns):
+            cols.append(Column(
+                c.type, c.data[idx_dev],
+                None if c.valid is None else c.valid[idx_dev],
+                c.dictionary, c.hash_pool, c.array_pool,
+            ))
+            names.append(n2)
+        # element columns from pool slices (host gather — the values
+        # buffer is host-resident by design)
+        for sym, (pool, handles, lens) in zip(node.element_symbols, args):
+            ln_rep = np.repeat(lens, row_len)
+            offs = np.repeat(pool.offsets[:-1][handles], row_len)
+            ok = within < ln_rep
+            at = np.where(ok, offs + within, 0)
+            if len(pool.values):
+                vals = pool.values[np.clip(at, 0, len(pool.values) - 1)]
+            else:
+                vals = np.zeros(
+                    total,
+                    dtype=object if isinstance(
+                        node.outputs[sym], T.VarcharType
+                    ) else node.outputs[sym].np_dtype,
+                )
+            if isinstance(node.outputs[sym], T.VarcharType):
+                vals = np.where(ok, vals, "")
+            cols.append(Column.from_numpy(
+                node.outputs[sym], vals, valid=ok, capacity=out_cap,
+            ))
+            names.append(sym)
+        out_mask = np.zeros(out_cap, dtype=np.bool_)
+        out_mask[:total] = True
+        return Page(
+            names, cols, jnp.asarray(out_mask),
+            known_rows=total, packed=True,
+        )
 
     def _Window(self, node: P.Window) -> Page:
         from trino_tpu.exec.window import build_window_program
@@ -1735,6 +1970,7 @@ def _slice_page(page: Page, lo: int, hi: int) -> Page:
             None if c.valid is None else c.valid[lo:hi],
             c.dictionary,
             c.hash_pool,
+            c.array_pool,
         )
         for c in page.columns
     ]
@@ -1760,7 +1996,7 @@ def _concat_pages(pages: list[Page]) -> Page:
             ])
         else:
             valid = None
-        cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
+        cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool, c.array_pool))
     mask = jnp.concatenate([p.mask for p in pages])
     return Page(list(first.names), cols, mask)
 

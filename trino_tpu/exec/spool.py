@@ -140,6 +140,8 @@ def page_to_host(page: Page) -> dict:
             data = c.dictionary.decode(data).astype(str)
         elif c.hash_pool is not None:
             data = c.hash_pool.values[data[:, 1]].astype(str)
+        elif c.array_pool is not None:
+            data = c.array_pool.decode(data)
         if valid is not None and data.dtype.kind == "U":
             data = np.where(valid, data, "")
         cols.append((data, valid))
@@ -203,6 +205,10 @@ def _save_npz(path: str, payload: dict, sel: np.ndarray) -> None:
     for i, (t, (values, valid)) in enumerate(
         zip(payload["types"], payload["cols"])
     ):
+        if isinstance(t, T.ArrayType):
+            raise NotImplementedError(
+                "ARRAY columns cannot cross the spooled exchange yet"
+            )
         v = values[sel]
         if v.dtype == object:
             v = v.astype(str)

@@ -55,9 +55,14 @@ class ChainLayout:
     capacity: int
     #: hash-coded varchar pools by symbol (data = [cap,2] hash+id)
     pools: dict = field(default_factory=dict)
+    #: ARRAY-column pools by symbol (page.ArrayPool)
+    arrays: dict = field(default_factory=dict)
 
     def expr_layout(self) -> ColumnLayout:
-        return ColumnLayout(types=dict(self.types), dictionaries=dict(self.dicts))
+        return ColumnLayout(
+            types=dict(self.types), dictionaries=dict(self.dicts),
+            array_pools=dict(self.arrays),
+        )
 
 
 def _norm_opt(data, valid):
@@ -209,6 +214,11 @@ def _project_step(nd: P.Project, layout: ChainLayout):
             s: layout.pools.get(e.name)
             for s, e in nd.assignments.items()
             if isinstance(e, _Ref) and layout.pools.get(e.name) is not None
+        },
+        arrays={
+            s: layout.arrays.get(e.name)
+            for s, e in nd.assignments.items()
+            if isinstance(e, _Ref) and layout.arrays.get(e.name) is not None
         },
     )
 

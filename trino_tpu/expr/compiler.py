@@ -49,6 +49,10 @@ class ColumnLayout:
 
     types: dict[str, T.DataType] = field(default_factory=dict)
     dictionaries: dict[str, StringDictionary | None] = field(default_factory=dict)
+    #: host ArrayPools of ARRAY-typed input columns (page.ArrayPool);
+    #: array functions compile host LUTs over the pool and gather by
+    #: the device handle lanes
+    array_pools: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -91,6 +95,90 @@ class _Compiler:
             return self._call(expr)
         raise NotImplementedError(f"cannot compile {expr!r}")
 
+    def _array_fn(self, expr: Call) -> CompiledExpr:
+        """Array functions over pool-backed columns: a host LUT sized
+        by the pool (lengths / element-at-k / contains-constant) plus
+        one device gather by the handle lane — the same compile-time
+        shape as dictionary string predicates (the ArrayBlock ops of
+        the reference lowered to the pool+handle design)."""
+        name = expr.name
+        arr = expr.args[0]
+        if not isinstance(arr, InputRef):
+            raise NotImplementedError(
+                f"{name} over a computed array expression"
+            )
+        pool = self.layout.array_pools.get(arr.name)
+        if pool is None:
+            raise NotImplementedError(
+                f"{name}: column {arr.name!r} has no array pool"
+            )
+        a = self.compile(arr)
+        n = max(len(pool), 1)
+        lens = pool.lengths()
+        if name == "cardinality":
+            table = jnp.asarray(
+                np.pad(lens, (0, n - len(lens))).astype(np.int64)
+            )
+
+            def ev_card(env):
+                h, v = a.fn(env)
+                return table[jnp.clip(h, 0, n - 1)], v
+
+            return CompiledExpr(ev_card, T.BIGINT)
+        if name == "subscript":
+            idx = expr.args[1]
+            if not isinstance(idx, Literal) or idx.value is None:
+                raise NotImplementedError(
+                    "array subscript index must be a constant"
+                )
+            k = int(idx.value)
+            ok_h = (lens >= k) & (k >= 1)
+            at = np.where(ok_h, pool.offsets[:-1] + (k - 1), 0)
+            vals = pool.values[np.clip(at, 0, max(len(pool.values) - 1, 0))] \
+                if len(pool.values) else np.zeros(len(lens), dtype=np.int64)
+            et = expr.type
+            out_dict = None
+            if isinstance(et, T.VarcharType):
+                out_dict, codes = StringDictionary.from_strings(
+                    vals.astype(str) if len(vals) else np.asarray([], str)
+                )
+                vals = codes
+            tbl = jnp.asarray(np.pad(
+                np.asarray(vals, dtype=et.np_dtype), (0, n - len(lens))
+            ))
+            okt = jnp.asarray(np.pad(ok_h, (0, n - len(lens))))
+
+            def ev_sub(env):
+                h, v = a.fn(env)
+                hc = jnp.clip(h, 0, n - 1)
+                ok = okt[hc] if v is None else (okt[hc] & v)
+                return tbl[hc], ok
+
+            return CompiledExpr(ev_sub, et, out_dict)
+        # contains(arr, constant)
+        needle = expr.args[1]
+        if not isinstance(needle, Literal) or needle.value is None:
+            raise NotImplementedError(
+                "contains() needle must be a constant"
+            )
+        want = _literal_device_value(needle)
+        if len(pool.values) and len(lens):
+            # vectorized segmented any: one equality pass + reduceat
+            # over the offsets (no per-row python loop)
+            eq = pool.values == want
+            starts = np.minimum(pool.offsets[:-1], len(eq) - 1)
+            hit = np.logical_or.reduceat(eq, starts)
+            hit = np.where(lens > 0, hit, False)
+        else:
+            hit = np.zeros(len(lens), dtype=np.bool_)
+        ht = jnp.asarray(np.pad(hit, (0, n - len(lens))))
+
+        def ev_contains(env):
+            h, v = a.fn(env)
+            return ht[jnp.clip(h, 0, n - 1)], v
+
+        return CompiledExpr(ev_contains, T.BOOLEAN)
+
     # ---- literals --------------------------------------------------------
     def _literal(self, expr: Literal) -> CompiledExpr:
         if expr.value is None:
@@ -102,6 +190,11 @@ class _Compiler:
                 ),
                 expr.type,
                 is_literal=True,
+            )
+        if isinstance(expr.type, T.ArrayType):
+            raise NotImplementedError(
+                "ARRAY literals evaluate in INSERT VALUES and UNNEST "
+                "only (pool-backed columns come from tables)"
             )
         if isinstance(expr.type, T.VarcharType):
             d = StringDictionary(np.asarray([str(expr.value)]))
@@ -223,6 +316,8 @@ class _Compiler:
             return self._coalesce(expr)
         if name == "in":
             return self._in(expr)
+        if name in ("cardinality", "subscript", "contains"):
+            return self._array_fn(expr)
         if name in _STRING_PREDICATES:
             return self._string_predicate(expr)
         if name in _STRING_TRANSFORMS:

@@ -31,8 +31,8 @@ import numpy as np
 from trino_tpu import types as T
 
 __all__ = [
-    "StringDictionary", "HashStringPool", "HashCollision", "Column",
-    "Page", "pad_capacity", "content_hash64",
+    "StringDictionary", "HashStringPool", "HashCollision", "ArrayPool",
+    "Column", "Page", "pad_capacity", "content_hash64",
 ]
 
 
@@ -217,6 +217,66 @@ class HashStringPool:
         other._joinable.add(self.token)
 
 
+class ArrayPool:
+    """Host-side offsets+values columnar store for ARRAY columns (the
+    ArrayBlock analog, SPI/block/ArrayBlock.java): ``offsets`` is
+    int64[n+1], ``values`` the flat element buffer in STORAGE form
+    (objects for varchar elements). Device columns carry int32 handles
+    into this pool; descriptor gathers on device never disturb the
+    flat buffer, exactly like dictionary codes vs the string pool."""
+
+    __slots__ = ("offsets", "values", "element", "token")
+
+    def __init__(self, offsets: np.ndarray, values: np.ndarray, element):
+        self.offsets = offsets
+        self.values = values
+        self.element = element
+        self.token = next(_POOL_TOKENS)
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    @staticmethod
+    def from_pylists(lists, element) -> tuple["ArrayPool", np.ndarray]:
+        """Build a pool from python sequences; returns (pool, handles).
+        None entries produce handle 0 with the caller masking validity."""
+        offsets = np.zeros(len(lists) + 1, dtype=np.int64)
+        flat = []
+        for i, v in enumerate(lists):
+            if v is None:
+                offsets[i + 1] = offsets[i]
+                continue
+            flat.extend(v)
+            offsets[i + 1] = offsets[i] + len(v)
+        from trino_tpu import types as T
+
+        if isinstance(element, T.VarcharType):
+            values = np.asarray(flat, dtype=object)
+        else:
+            values = np.asarray(
+                flat if flat else [], dtype=element.np_dtype
+            )
+        return (
+            ArrayPool(offsets, values, element),
+            np.arange(len(lists), dtype=np.int32),
+        )
+
+    def lengths(self) -> np.ndarray:
+        return np.diff(self.offsets).astype(np.int64)
+
+    def get(self, handle: int) -> list:
+        lo, hi = self.offsets[handle], self.offsets[handle + 1]
+        return list(self.values[lo:hi])
+
+    def decode(self, handles: np.ndarray) -> np.ndarray:
+        """Handles -> object array of python lists (the one shared
+        decode for result fetch / host spill / page_to_host)."""
+        out = np.empty(len(handles), dtype=object)
+        for i, h in enumerate(handles):
+            out[i] = self.get(int(h))
+        return out
+
+
 class HashCollision(RuntimeError):
     """Two distinct strings share a hash64 — astronomically rare; the
     caller rebuilds the column with a sorted dictionary."""
@@ -240,6 +300,9 @@ class Column:
     valid: jnp.ndarray | None = None  # None => all valid
     dictionary: StringDictionary | None = None
     hash_pool: HashStringPool | None = None
+    #: ARRAY columns: host offsets+values store indexed by the int32
+    #: handle lanes in ``data``
+    array_pool: "ArrayPool | None" = None
 
     @property
     def capacity(self) -> int:
@@ -258,6 +321,24 @@ class Column:
     ) -> "Column":
         n = len(values)
         cap = capacity or pad_capacity(n)
+        if isinstance(type_, T.ArrayType):
+            pool, handles = ArrayPool.from_pylists(values, type_.element)
+            data = np.zeros(cap, dtype=np.int32)
+            data[:n] = handles
+            col_valid = None
+            nulls = np.asarray(
+                [v is None for v in values], dtype=np.bool_
+            )
+            if valid is not None or nulls.any():
+                v = np.zeros(cap, dtype=np.bool_)
+                v[:n] = (
+                    np.ones(n, dtype=np.bool_) if valid is None
+                    else np.asarray(valid, dtype=np.bool_)
+                ) & ~nulls
+                col_valid = jnp.asarray(v)
+            return Column(
+                type_, jnp.asarray(data), col_valid, array_pool=pool
+            )
         if type_.is_dictionary and dictionary is None:
             dictionary, values = StringDictionary.from_strings(values)
         arr = np.asarray(values)
@@ -285,6 +366,8 @@ class Column:
             out = self.dictionary.decode(data).astype(object)
         elif self.hash_pool is not None:
             out = self.hash_pool.values[data[:, 1]].astype(object)
+        elif self.array_pool is not None:
+            out = self.array_pool.decode(data)
         elif isinstance(self.type, T.DecimalType):
             out = data  # unscaled; rendering applies the scale
         else:
@@ -390,6 +473,8 @@ class Page:
                 data = c.dictionary.decode(data).astype(object)
             elif c.hash_pool is not None:
                 data = c.hash_pool.values[data[:, 1]].astype(object)
+            elif c.array_pool is not None:
+                data = c.array_pool.decode(data)
             vals = [
                 None if (valid is not None and not valid[j]) else _pyvalue(c.type, data[j])
                 for j in range(len(sel))
@@ -402,6 +487,8 @@ class Page:
 
 
 def _pyvalue(type_: T.DataType, v):
+    if isinstance(type_, T.ArrayType):
+        return [_pyvalue(type_.element, x) for x in v]
     if isinstance(type_, T.BooleanType):
         return bool(v)
     if isinstance(type_, T.DecimalType):

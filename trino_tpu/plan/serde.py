@@ -39,7 +39,10 @@ def _expr(e):
         return None
     if isinstance(e, Literal):
         v = e.value
-        if not (v is None or isinstance(v, (bool, int, float, str))):
+        if isinstance(v, tuple):
+            # ARRAY literals carry a tuple of storage-form scalars
+            v = list(v)
+        elif not (v is None or isinstance(v, (bool, int, float, str))):
             raise TypeError(f"unserializable literal {v!r}")
         return {"k": "lit", "t": _t(e.type), "v": v}
     if isinstance(e, InputRef):
@@ -59,7 +62,10 @@ def _expr_back(d):
         return None
     k = d["k"]
     if k == "lit":
-        return Literal(_t_back(d["t"]), d["v"])
+        v = d["v"]
+        if isinstance(v, list):
+            v = tuple(v)
+        return Literal(_t_back(d["t"]), v)
     if k == "ref":
         return InputRef(_t_back(d["t"]), d["n"])
     if k == "call":
@@ -198,7 +204,11 @@ def plan_to_json(node: P.PlanNode) -> dict:
     if isinstance(node, P.Unnest):
         d.update(
             source=plan_to_json(node.source),
-            arrays=[[_expr(e) for e in a] for a in node.arrays],
+            arrays=[
+                [_expr(e) for e in a] if isinstance(a, tuple)
+                else {"ref": _expr(a)}
+                for a in node.arrays
+            ],
             element_symbols=list(node.element_symbols),
         )
         return d
@@ -325,7 +335,9 @@ def plan_from_json(d: dict) -> P.PlanNode:
         return P.Unnest(
             outputs, source=plan_from_json(d["source"]),
             arrays=[
-                tuple(_expr_back(e) for e in a) for a in d["arrays"]
+                _expr_back(a["ref"]) if isinstance(a, dict)
+                else tuple(_expr_back(e) for e in a)
+                for a in d["arrays"]
             ],
             element_symbols=list(d["element_symbols"]),
         )

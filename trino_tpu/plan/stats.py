@@ -571,7 +571,7 @@ def _hash_varchar_candidates(plan: P.PlanNode, metadata, threshold):
                     unsafe.update(expr_refs(a))
         elif isinstance(node, P.Unnest):
             for a in node.arrays:
-                for e in a:
+                for e in (a if isinstance(a, tuple) else (a,)):
                     unsafe.update(expr_refs(e))
         elif isinstance(node, P.Union):
             for ins in node.symbol_map.values():

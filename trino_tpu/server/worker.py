@@ -304,6 +304,16 @@ class WorkerServer:
         return task
 
 
+def _json_element(t, x):
+    from trino_tpu import types as T
+
+    if isinstance(t, T.VarcharType):
+        return str(x)
+    if isinstance(t, (T.DoubleType, T.RealType)):
+        return float(x)
+    return int(x)
+
+
 #: rows per result batch (bounds every HTTP response body regardless
 #: of result size — the reference targets bytes per page the same way,
 #: MAIN/server/TaskResource.java DEFAULT_MAX_SIZE)
@@ -326,7 +336,15 @@ def _encode_batch(task: _Task, token: int, batch_rows: int) -> dict:
     cols_out, nulls_out, types_out = [], [], []
     for t, (values, valid) in zip(payload["types"], payload["cols"]):
         v = values[lo:hi]
-        if isinstance(t, T.DecimalType):
+        if isinstance(t, T.ArrayType):
+            el = t.element
+            out = [
+                None if row is None else [
+                    _json_element(el, x) for x in row
+                ]
+                for row in v
+            ]
+        elif isinstance(t, T.DecimalType):
             import decimal as _d
 
             if v.ndim == 2:

@@ -16,6 +16,7 @@ __all__ = [
     "Ident", "IntLit", "DecimalLit", "FloatLit", "StrLit", "BoolLit", "NullLit",
     "DateLit", "TimestampLit", "IntervalLit", "Star",
     "Unary", "Binary", "FnCall", "CastExpr", "CaseExpr", "Between", "InList",
+    "ArrayLit", "Subscript",
     "InSubquery", "Exists", "ScalarSubquery", "LikeExpr", "IsNullExpr",
     "ExtractExpr",
     "TableRef", "SubqueryRel", "JoinRel",
@@ -156,6 +157,21 @@ class CaseExpr(Expr):
     operand: Optional[Expr]  # CASE x WHEN ... vs CASE WHEN ...
     whens: list[tuple[Expr, Expr]]
     else_: Optional[Expr]
+
+
+@dataclass
+class ArrayLit(Expr):
+    """ARRAY[e1, e2, ...] constructor (PARSER/tree/ArrayConstructor)."""
+
+    items: list[Expr]
+
+
+@dataclass
+class Subscript(Expr):
+    """base[index] element access (PARSER/tree/SubscriptExpression)."""
+
+    base: Expr
+    index: Expr
 
 
 @dataclass

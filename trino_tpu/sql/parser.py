@@ -427,20 +427,14 @@ class _Parser:
         alias = self._relation_alias()
         return ast.TableRef(parts, alias)
 
-    def _array_constructor(self) -> list[ast.Expr]:
-        """ARRAY[e1, e2, ...] — the element expressions."""
-        t = self.peek()
-        if not (t.kind == "IDENT" and t.text == "array"):
-            raise SqlSyntaxError(
-                "UNNEST argument must be an ARRAY[...] constructor"
-            )
-        self.next()
-        self.expect_op("[")
-        items = [self.expr()]
-        while self.accept_op(","):
-            items.append(self.expr())
-        self.expect_op("]")
-        return items
+    def _array_constructor(self):
+        """UNNEST argument: ARRAY[e1, ...] keeps its element-expression
+        list form; any other expression (an ARRAY-typed column
+        reference) passes through as one Expr."""
+        e = self.expr()
+        if isinstance(e, ast.ArrayLit):
+            return list(e.items)
+        return e
 
     def _relation_alias(self) -> str | None:
         if self.accept_kw("as"):
@@ -546,7 +540,14 @@ class _Parser:
         if self.at_op("+"):
             self.next()
             return self.unary()
-        return self.primary()
+        e = self.primary()
+        while self.at_op("["):
+            # postfix subscript: arr[i] (1-based, Trino semantics)
+            self.next()
+            idx = self.expr()
+            self.expect_op("]")
+            e = ast.Subscript(e, idx)
+        return e
 
     def primary(self) -> ast.Expr:
         t = self.peek()
@@ -559,6 +560,20 @@ class _Parser:
         if t.kind == "KEYWORD":
             return self._keyword_primary(t)
         if t.kind == "IDENT":
+            if (
+                t.text.lower() == "array"
+                and self.peek(1).kind == "OP"
+                and self.peek(1).text == "["
+            ):
+                self.next()
+                self.expect_op("[")
+                items = []
+                if not self.at_op("]"):
+                    items = [self.expr()]
+                    while self.accept_op(","):
+                        items.append(self.expr())
+                self.expect_op("]")
+                return ast.ArrayLit(items)
             return self._ident_primary()
         if self.accept_op("("):
             if self.at_kw("select", "with"):
@@ -729,12 +744,19 @@ class _Parser:
     def _type_name(self) -> str:
         base = self.next().text
         if self.accept_op("("):
-            params = [self.next().text]
+            params = [self._type_param()]
             while self.accept_op(","):
-                params.append(self.next().text)
+                params.append(self._type_param())
             self.expect_op(")")
             return f"{base}({','.join(params)})"
         return base
+
+    def _type_param(self) -> str:
+        """One type parameter: a number or a nested (possibly
+        parametric) type name — array(decimal(5,1)) nests."""
+        if self.peek().kind == "NUMBER":
+            return self.next().text
+        return self._type_name()
 
 
 #: keywords that may be used as identifiers / function names

@@ -266,6 +266,29 @@ class UnknownType(DataType):
 
 
 @dataclass(frozen=True, eq=False, repr=False)
+class ArrayType(DataType):
+    """ARRAY(element) (SPI/block/ArrayBlock.java analog). Device data
+    is an int32 HANDLE lane indexing a host-side ArrayPool holding the
+    offsets+values columnar layout (page.ArrayPool) — variable-width
+    data stays host-resident with device handles, the same design as
+    VARCHAR dictionaries (SURVEY §7 hard parts): per-row descriptors
+    gather freely on device while the flat element buffer never
+    reorders."""
+
+    element: DataType = None  # type: ignore[assignment]
+
+    np_dtype = np.dtype(np.int32)
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"array({self.element.name})"
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True, eq=False, repr=False)
 class SketchType(DataType):
     """Internal multi-lane aggregation state: HLL registers or quantile
     summaries (the analog of the reference's HyperLogLog / QDigest
@@ -334,6 +357,8 @@ def type_from_name(name: str) -> DataType:
     if base.startswith("sketch("):
         kind, lanes = base[7:-1].split(",")
         return SketchType(kind.strip(), int(lanes))
+    if base.startswith("array(") and base.endswith(")"):
+        return ArrayType(type_from_name(base[6:-1]))
     if base.startswith("char("):
         return CharType(int(base[5:-1]))
     if base in _BY_NAME:
