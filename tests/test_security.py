@@ -1,0 +1,72 @@
+"""Access control: the AccessControlManager analog — rule-based
+grants enforced at analysis (SELECT) and at the DML/DDL execution
+points (MAIN/security/AccessControlManager.java, file-based system
+access control semantics: first match wins, no match denies).
+"""
+
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.security import (
+    AccessDeniedError,
+    Rule,
+    RuleBasedAccessControl,
+)
+
+
+@pytest.fixture()
+def setup():
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    admin = QueryRunner(
+        md, Session(catalog="memory", schema="default", user="admin")
+    )
+    admin.execute("create table t (id bigint)")
+    admin.execute("insert into t values (1), (2)")
+    admin.execute("create table secrets (k varchar)")
+    admin.execute("insert into secrets values ('x')")
+    md.access_control = RuleBasedAccessControl([
+        Rule(user="admin"),  # everything
+        Rule(user="analyst", table="t", privileges=("select",)),
+    ])
+    return md
+
+
+def test_rule_based_access(setup):
+    md = setup
+    analyst = QueryRunner(
+        md, Session(catalog="memory", schema="default", user="analyst")
+    )
+    assert analyst.execute("select count(*) from t").rows == [(2,)]
+    with pytest.raises(AccessDeniedError, match="cannot select"):
+        analyst.execute("select * from secrets")
+    with pytest.raises(AccessDeniedError, match="cannot insert"):
+        analyst.execute("insert into t values (3)")
+    with pytest.raises(AccessDeniedError, match="cannot delete"):
+        analyst.execute("delete from t")
+    with pytest.raises(AccessDeniedError, match="cannot update"):
+        analyst.execute("update t set id = 9")
+    with pytest.raises(AccessDeniedError, match="cannot ddl"):
+        analyst.execute("create table t2 (x bigint)")
+    # a denied table behind a join is still denied
+    with pytest.raises(AccessDeniedError):
+        analyst.execute("select * from t, secrets")
+    # unknown user: no matching rule -> denied
+    nobody = QueryRunner(
+        md, Session(catalog="memory", schema="default", user="eve")
+    )
+    with pytest.raises(AccessDeniedError):
+        nobody.execute("select 1 from t")
+
+
+def test_admin_unrestricted(setup):
+    md = setup
+    admin = QueryRunner(
+        md, Session(catalog="memory", schema="default", user="admin")
+    )
+    admin.execute("insert into t values (3)")
+    admin.execute("update t set id = id + 1 where id = 3")
+    admin.execute("delete from t where id = 4")
+    assert admin.execute("select count(*) from t").rows == [(2,)]

@@ -1,0 +1,141 @@
+"""Views (CREATE/DROP VIEW, analysis-time expansion) and row-level
+DML (DELETE / UPDATE) — MetadataManager view resolution +
+MergeWriterOperator-family analogs.
+"""
+
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.metadata import Metadata, Session
+
+
+@pytest.fixture()
+def runner():
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    r = QueryRunner(md, Session(catalog="memory", schema="default"))
+    r.execute("create table t (id bigint, v bigint, name varchar)")
+    r.execute(
+        "insert into t values (1, 10, 'a'), (2, 20, 'b'), "
+        "(3, 30, 'c'), (4, null, 'd')"
+    )
+    return r
+
+
+def test_view_create_query_drop(runner):
+    runner.execute("create view big as select id, v from t where v >= 20")
+    rows = runner.execute("select id from big order by id").rows
+    assert rows == [(2,), (3,)]
+    # views see data changes (logical, analyzed at use)
+    runner.execute("insert into t values (5, 50, 'e')")
+    rows = runner.execute("select id from big order by id").rows
+    assert rows == [(2,), (3,), (5,)]
+    # joinable like a table, aliasable
+    rows = runner.execute(
+        "select b.id, t.name from big b, t where b.id = t.id order by 1"
+    ).rows
+    assert rows == [(2, "b"), (3, "c"), (5, "e")]
+    runner.execute("drop view big")
+    with pytest.raises(Exception):
+        runner.execute("select * from big")
+
+
+def test_view_or_replace_and_errors(runner):
+    runner.execute("create view w as select id from t")
+    with pytest.raises(ValueError, match="already exists"):
+        runner.execute("create view w as select v from t")
+    runner.execute("create or replace view w as select v from t where v > 15")
+    rows = runner.execute("select v from w order by 1").rows
+    assert rows == [(20,), (30,)]
+    # invalid view body must not store
+    with pytest.raises(Exception):
+        runner.execute("create view bad as select nope from t")
+    with pytest.raises(KeyError):
+        runner.execute("drop view bad")
+    runner.execute("drop view if exists bad")  # no error
+
+
+def test_view_over_aggregate(runner):
+    runner.execute(
+        "create view agg as select name, count(*) c, sum(v) s "
+        "from t group by name"
+    )
+    rows = dict(
+        (n, (c, s)) for n, c, s in
+        runner.execute("select name, c, s from agg").rows
+    )
+    assert rows["a"] == (1, 10)
+    assert rows["d"] == (1, None)
+
+
+def test_delete(runner):
+    res = runner.execute("delete from t where v >= 20")
+    assert res.rows == [(2,)]
+    rows = runner.execute("select id from t order by id").rows
+    assert rows == [(1,), (4,)]
+    # NULL predicate rows are NOT deleted (3VL)
+    res = runner.execute("delete from t where v < 100")
+    assert res.rows == [(1,)]
+    assert runner.execute("select id from t").rows == [(4,)]
+    # unconditional delete
+    res = runner.execute("delete from t")
+    assert res.rows == [(1,)]
+    assert runner.execute("select count(*) from t").rows == [(0,)]
+
+
+def test_update(runner):
+    res = runner.execute("update t set v = v * 2 where id <= 2")
+    assert res.rows == [(2,)]
+    rows = runner.execute("select id, v from t order by id").rows
+    assert rows == [(1, 20), (2, 40), (3, 30), (4, None)]
+    # update to NULL and from NULL
+    runner.execute("update t set v = null where id = 1")
+    runner.execute("update t set v = 7 where id = 4")
+    rows = runner.execute("select id, v from t order by id").rows
+    assert rows == [(1, None), (2, 40), (3, 30), (4, 7)]
+    # varchar + expression over another column
+    runner.execute("update t set name = upper(name) where v > 30")
+    rows = runner.execute("select id, name from t order by id").rows
+    assert rows == [(1, "a"), (2, "B"), (3, "c"), (4, "d")]
+
+
+def test_view_cannot_shadow_table_and_no_recursion(runner):
+    with pytest.raises(ValueError, match="cannot shadow"):
+        runner.execute("create view t as select id from t")
+    # indirect cycle: v1 -> v2, then v2 replaced to read v1
+    runner.execute("create view v1 as select id from t")
+    runner.execute("create view v2 as select id from v1")
+    from trino_tpu.analyzer.scope import AnalysisError
+
+    with pytest.raises((AnalysisError, Exception)):
+        runner.execute("create or replace view v1 as select id from v2")
+        runner.execute("select * from v1")
+
+
+def test_drop_requires_ddl_privilege(runner):
+    from trino_tpu.security import (
+        AccessDeniedError, Rule, RuleBasedAccessControl,
+    )
+
+    runner.execute("create view w as select id from t")
+    runner.metadata.access_control = RuleBasedAccessControl([
+        Rule(user="user", privileges=("select",)),
+    ])
+    with pytest.raises(AccessDeniedError):
+        runner.execute("drop table t")
+    with pytest.raises(AccessDeniedError):
+        runner.execute("drop view w")
+
+
+def test_dml_conflict_detection(runner):
+    """A concurrent write between predicate evaluation and the storage
+    rewrite raises a conflict instead of misaligning the row mask."""
+    conn = runner.metadata.connector("memory")
+    v0 = conn.table_version("default", "t")
+    import numpy as np
+
+    keep = np.ones(4, dtype=bool)
+    runner.execute("insert into t values (9, 90, 'z')")  # bumps version
+    with pytest.raises(RuntimeError, match="concurrent modification"):
+        conn.delete_rows("default", "t", keep, expected_version=v0)

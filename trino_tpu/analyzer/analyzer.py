@@ -479,7 +479,35 @@ class Analyzer:
                     for n, f in zip(names, sub_rp.scope.fields)
                 ]
                 return RelationPlan(sub_rp.node, Scope(fields, parent=outer))
+            hit = self.metadata.get_view(self.session, rel.parts)
+            if hit is not None:
+                # view expansion at analysis time (TableRef -> the
+                # stored Query, exactly like a named subquery); the
+                # expanding-set guard turns view cycles into an error
+                # instead of infinite recursion
+                key, view_q = hit
+                expanding = getattr(self, "_expanding_views", None)
+                if expanding is None:
+                    expanding = self._expanding_views = set()
+                if key in expanding:
+                    raise AnalysisError(
+                        f"view {'.'.join(key)} is recursive"
+                    )
+                expanding.add(key)
+                try:
+                    sub_rp, names = self.plan_query(view_q, outer, {})
+                finally:
+                    expanding.discard(key)
+                alias = (rel.alias or rel.parts[-1]).lower()
+                fields = [
+                    Field(n.lower(), f.symbol, f.type, alias)
+                    for n, f in zip(names, sub_rp.scope.fields)
+                ]
+                return RelationPlan(sub_rp.node, Scope(fields, parent=outer))
             qt, schema = self.metadata.resolve_table(self.session, rel.parts)
+            self.metadata.access_control.check_can_select(
+                self.session.user, qt.catalog, qt.schema, qt.table
+            )
             alias = (rel.alias or qt.table).lower()
             assignments = {}
             fields = []

@@ -230,6 +230,33 @@ class Connector:
         (values, valid|None) host arrays. Returns the row count."""
         raise NotImplementedError(f"{type(self).__name__} is read-only")
 
+    def table_version(self, schema: str, table: str) -> int:
+        """Monotonic write version (0 = versioning unsupported): DML
+        reads it before evaluating its row mask and passes it back as
+        ``expected_version`` so a concurrent write turns into a loud
+        conflict instead of a misaligned positional mask."""
+        return 0
+
+    def delete_rows(
+        self, schema: str, table: str, keep, expected_version: int = 0
+    ) -> int:
+        """Row-level DELETE: keep[i] marks surviving rows (table
+        order). Returns deleted count (MergeWriterOperator analog)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support DELETE"
+        )
+
+    def update_rows(
+        self, schema: str, table: str, columns: dict, mask,
+        expected_version: int = 0,
+    ) -> int:
+        """Row-level UPDATE: overwrite ``columns`` (name ->
+        (values, valid|None), full-length in table order) where
+        mask[i]. Returns updated count."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support UPDATE"
+        )
+
 
 @dataclass
 class Catalog:

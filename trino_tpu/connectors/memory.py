@@ -37,6 +37,8 @@ class _Table:
         self.n_rows = 0
         #: memoized TableStats; dropped on insert
         self.stats: TableStats | None = None
+        #: monotonic write version (DML conflict detection)
+        self.version = 1
 
 
 def _storage_dtype(t: T.DataType):
@@ -127,7 +129,67 @@ class MemoryConnector(Connector):
                     t.valid[c] = np.concatenate([ov, nv])
             t.n_rows += n_new or 0
             t.stats = None  # stats reflect the pre-insert version
+            t.version += 1
         return n_new or 0
+
+    def table_version(self, schema: str, table: str) -> int:
+        t = self._table(schema, table)
+        with self._lock:
+            return t.version
+
+    def _check_version(self, t, expected_version: int):
+        if expected_version and t.version != expected_version:
+            raise RuntimeError(
+                "concurrent modification: table changed while the DML "
+                "predicate evaluated (retry the statement)"
+            )
+
+    def delete_rows(
+        self, schema: str, table: str, keep, expected_version: int = 0
+    ) -> int:
+        t = self._table(schema, table)
+        with self._lock:
+            self._check_version(t, expected_version)
+            keep = np.asarray(keep, dtype=bool)
+            deleted = int((~keep).sum())
+            for c in list(t.columns):
+                t.columns[c] = t.columns[c][keep]
+                if t.valid[c] is not None:
+                    t.valid[c] = t.valid[c][keep]
+            t.n_rows -= deleted
+            t.stats = None
+            t.version += 1
+        return deleted
+
+    def update_rows(
+        self, schema: str, table: str, columns: dict, mask,
+        expected_version: int = 0,
+    ) -> int:
+        t = self._table(schema, table)
+        with self._lock:
+            self._check_version(t, expected_version)
+            mask = np.asarray(mask, dtype=bool)
+            for c, raw in columns.items():
+                vals, valid = raw if isinstance(raw, tuple) else (raw, None)
+                cur = t.columns[c].copy()
+                cur[mask] = np.asarray(
+                    vals, dtype=t.columns[c].dtype
+                )[mask]
+                t.columns[c] = cur
+                new_valid = (
+                    np.ones(len(cur), dtype=bool)
+                    if valid is None else np.asarray(valid, dtype=bool)
+                )
+                old_valid = t.valid[c]
+                if old_valid is None and not new_valid[mask].all():
+                    old_valid = np.ones(len(cur), dtype=bool)
+                if old_valid is not None:
+                    ov = old_valid.copy()
+                    ov[mask] = new_valid[mask]
+                    t.valid[c] = ov
+            t.stats = None
+            t.version += 1
+        return int(mask.sum())
 
     # ---- scan ------------------------------------------------------------
 

@@ -1010,3 +1010,139 @@ order by lochierarchy desc,
          rank_within_parent, i_category, i_class
 limit 100
 """
+
+QUERIES["q13"] = """
+select avg(ss_quantity), avg(ss_ext_sales_price),
+       avg(ss_ext_wholesale_cost), sum(ss_ext_wholesale_cost)
+from store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_year = 2001
+  and ((ss_hdemo_sk = hd_demo_sk
+        and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00
+        and hd_dep_count = 3)
+       or (ss_hdemo_sk = hd_demo_sk
+           and cd_demo_sk = ss_cdemo_sk
+           and cd_marital_status = 'S'
+           and cd_education_status = 'College'
+           and ss_sales_price between 50.00 and 100.00
+           and hd_dep_count = 1)
+       or (ss_hdemo_sk = hd_demo_sk
+           and cd_demo_sk = ss_cdemo_sk
+           and cd_marital_status = 'W'
+           and cd_education_status = '2 yr Degree'
+           and ss_sales_price between 150.00 and 200.00
+           and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('TX', 'OH', 'KS')
+        and ss_net_profit between 100 and 200)
+       or (ss_addr_sk = ca_address_sk
+           and ca_country = 'United States'
+           and ca_state in ('OR', 'NE', 'KY')
+           and ss_net_profit between 150 and 300)
+       or (ss_addr_sk = ca_address_sk
+           and ca_country = 'United States'
+           and ca_state in ('VA', 'TN', 'MS')
+           and ss_net_profit between 50 and 250))
+"""
+
+QUERIES["q48"] = """
+select sum(ss_quantity)
+from store_sales, store, customer_demographics, customer_address,
+     date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_year = 2000
+  and ((cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+       or (cd_demo_sk = ss_cdemo_sk
+           and cd_marital_status = 'D'
+           and cd_education_status = '2 yr Degree'
+           and ss_sales_price between 50.00 and 100.00)
+       or (cd_demo_sk = ss_cdemo_sk
+           and cd_marital_status = 'S'
+           and cd_education_status = 'College'
+           and ss_sales_price between 150.00 and 200.00))
+  and ((ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('CO', 'OH', 'TX')
+        and ss_net_profit between 0 and 2000)
+       or (ss_addr_sk = ca_address_sk
+           and ca_country = 'United States'
+           and ca_state in ('OR', 'MN', 'KY')
+           and ss_net_profit between 150 and 3000)
+       or (ss_addr_sk = ca_address_sk
+           and ca_country = 'United States'
+           and ca_state in ('VA', 'CA', 'MS')
+           and ss_net_profit between 50 and 25000))
+"""
+
+# q13/q48: sqlite cannot plan the spec's OR-embedded join conditions
+# (it cross-joins and never finishes even at tiny); the oracle text is
+# the factored-equivalent form — the same rewrite the engine's
+# optimizer applies (ExtractCommonPredicates analog)
+SQLITE_ORACLE["q13"] = """
+select avg(ss_quantity), avg(ss_ext_sales_price),
+       avg(ss_ext_wholesale_cost), sum(ss_ext_wholesale_cost)
+from store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_year = 2001
+  and ss_hdemo_sk = hd_demo_sk
+  and cd_demo_sk = ss_cdemo_sk
+  and ss_addr_sk = ca_address_sk
+  and ca_country = 'United States'
+  and ((cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00
+        and hd_dep_count = 3)
+       or (cd_marital_status = 'S'
+           and cd_education_status = 'College'
+           and ss_sales_price between 50.00 and 100.00
+           and hd_dep_count = 1)
+       or (cd_marital_status = 'W'
+           and cd_education_status = '2 yr Degree'
+           and ss_sales_price between 150.00 and 200.00
+           and hd_dep_count = 1))
+  and ((ca_state in ('TX', 'OH', 'KS')
+        and ss_net_profit between 100 and 200)
+       or (ca_state in ('OR', 'NE', 'KY')
+           and ss_net_profit between 150 and 300)
+       or (ca_state in ('VA', 'TN', 'MS')
+           and ss_net_profit between 50 and 250))
+"""
+
+SQLITE_ORACLE["q48"] = """
+select sum(ss_quantity)
+from store_sales, store, customer_demographics, customer_address,
+     date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_year = 2000
+  and cd_demo_sk = ss_cdemo_sk
+  and ss_addr_sk = ca_address_sk
+  and ca_country = 'United States'
+  and ((cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+       or (cd_marital_status = 'D'
+           and cd_education_status = '2 yr Degree'
+           and ss_sales_price between 50.00 and 100.00)
+       or (cd_marital_status = 'S'
+           and cd_education_status = 'College'
+           and ss_sales_price between 150.00 and 200.00))
+  and ((ca_state in ('CO', 'OH', 'TX')
+        and ss_net_profit between 0 and 2000)
+       or (ca_state in ('OR', 'MN', 'KY')
+           and ss_net_profit between 150 and 3000)
+       or (ca_state in ('VA', 'CA', 'MS')
+           and ss_net_profit between 50 and 25000))
+"""

@@ -171,6 +171,42 @@ class QueryRunner:
             else:
                 self.session.schema = parts[0]
             return QueryResult(["result"], [("USE",)])
+        if isinstance(stmt, ast.CreateView):
+            qualified = self._qualify(stmt.name)
+            self.metadata.access_control.check_can_ddl(
+                self.session.user, *qualified
+            )
+            cat, sch, tab = qualified
+            try:
+                exists = tab in self.metadata.connector(cat).list_tables(sch)
+            except Exception:
+                exists = False
+            if exists:
+                # a view shadowing a table would make SELECT and DML
+                # see different objects (and a self-referencing body
+                # would recurse at use)
+                raise ValueError(
+                    f"table {'.'.join(qualified)} already exists; "
+                    "a view cannot shadow it"
+                )
+            # validate now: a view that cannot analyze must not store
+            self.plan_stmt(stmt.query)
+            self.metadata.create_view(
+                qualified, stmt.query, or_replace=stmt.or_replace
+            )
+            return QueryResult(["result"], [("CREATE VIEW",)])
+        if isinstance(stmt, ast.DropView):
+            qualified = self._qualify(stmt.name)
+            self.metadata.access_control.check_can_ddl(
+                self.session.user, *qualified
+            )
+            if not self.metadata.drop_view(qualified) and not stmt.if_exists:
+                raise KeyError(f"view not found: {'.'.join(stmt.name)}")
+            return QueryResult(["result"], [("DROP VIEW",)])
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, ast.Update):
+            return self._update(stmt)
         if isinstance(stmt, ast.SessionSet):
             v = stmt.value
             val = getattr(v, "value", None)
@@ -186,6 +222,9 @@ class QueryRunner:
             return self._insert(stmt)
         if isinstance(stmt, ast.DropTable):
             cat, sch, tab = self._qualify(stmt.name)
+            self.metadata.access_control.check_can_ddl(
+                self.session.user, cat, sch, tab
+            )
             conn = self.metadata.connector(cat)
             if stmt.if_exists and tab not in conn.list_tables(sch):
                 return QueryResult(["result"], [("DROP TABLE",)])
@@ -243,6 +282,9 @@ class QueryRunner:
         from trino_tpu.connectors.base import TableSchema
 
         cat, sch, tab = self._qualify(stmt.name)
+        self.metadata.access_control.check_can_ddl(
+            self.session.user, cat, sch, tab
+        )
         conn = self.metadata.connector(cat)
         if stmt.if_not_exists and tab in conn.list_tables(sch):
             return QueryResult(["result"], [("CREATE TABLE",)])
@@ -257,6 +299,9 @@ class QueryRunner:
         from trino_tpu.connectors.base import TableSchema
 
         cat, sch, tab = self._qualify(stmt.name)
+        self.metadata.access_control.check_can_ddl(
+            self.session.user, cat, sch, tab
+        )
         conn = self.metadata.connector(cat)
         if stmt.if_not_exists and tab in conn.list_tables(sch):
             return QueryResult(["rows"], [(0,)])
@@ -273,6 +318,9 @@ class QueryRunner:
 
     def _insert(self, stmt: ast.InsertInto) -> QueryResult:
         cat, sch, tab = self._qualify(stmt.name)
+        self.metadata.access_control.check_can_insert(
+            self.session.user, cat, sch, tab
+        )
         conn = self.metadata.connector(cat)
         ts = conn.table_schema(sch, tab)
         target_cols = stmt.columns or ts.column_names
@@ -314,6 +362,79 @@ class QueryRunner:
         return QueryResult(["rows"], [(n,)])
 
     # ---- EXPLAIN ---------------------------------------------------------
+
+    def _dml_rows(self, name, items):
+        """Evaluate DML expressions per row IN TABLE ORDER: one
+        ``SELECT e1, .., en FROM t`` (Project over the scan — row count
+        and order preserved, single scan for predicate AND assignments)
+        returning python rows."""
+        q = ast.Query(
+            select=ast.Select(
+                items=[ast.SelectItem(e) for e in items],
+                relations=[ast.TableRef(tuple(name))],
+            ),
+            with_=[],
+        )
+        plan = self.plan_stmt(q, optimized=False)
+        page = self.executor.execute(plan)
+        return page.to_pylist()
+
+    def _delete(self, stmt: "ast.Delete") -> QueryResult:
+        """Row-level DELETE (the MergeWriter family's delete case): the
+        predicate evaluates device-side in table order; the connector
+        rewrites its storage to the kept rows, rejecting the write if
+        the table version moved underneath (conflict detection)."""
+        import numpy as np
+
+        cat, sch, tab = self._qualify(stmt.name)
+        self.metadata.access_control.check_can_delete(
+            self.session.user, cat, sch, tab
+        )
+        conn = self.metadata.connector(cat)
+        version = conn.table_version(sch, tab)
+        if stmt.where is None:
+            keep = np.zeros(conn.row_count(sch, tab), dtype=bool)
+        else:
+            rows = self._dml_rows(stmt.name, [stmt.where])
+            keep = ~np.asarray(
+                [r[0] is True for r in rows], dtype=bool
+            )
+        n = conn.delete_rows(sch, tab, keep, expected_version=version)
+        self.executor.invalidate_scan(cat, sch, tab)
+        return QueryResult(["rows"], [(n,)])
+
+    def _update(self, stmt: "ast.Update") -> QueryResult:
+        """Row-level UPDATE: ONE query evaluates the predicate and
+        every assignment expression together, then the connector
+        overwrites the masked rows' columns in place (version-checked
+        against concurrent writers)."""
+        import numpy as np
+
+        cat, sch, tab = self._qualify(stmt.name)
+        self.metadata.access_control.check_can_update(
+            self.session.user, cat, sch, tab
+        )
+        conn = self.metadata.connector(cat)
+        version = conn.table_version(sch, tab)
+        ts = conn.table_schema(sch, tab)
+        cols = [c for c, _ in stmt.assignments]
+        items = [e for _, e in stmt.assignments]
+        if stmt.where is not None:
+            items = items + [stmt.where]
+        rows = self._dml_rows(stmt.name, items)
+        if stmt.where is not None:
+            mask = np.asarray(
+                [r[-1] is True for r in rows], dtype=bool
+            )
+            rows = [r[:-1] for r in rows]
+        else:
+            mask = np.ones(len(rows), dtype=bool)
+        new_cols = _rows_to_columns(ts, cols, rows)
+        n = conn.update_rows(
+            sch, tab, new_cols, mask, expected_version=version
+        )
+        self.executor.invalidate_scan(cat, sch, tab)
+        return QueryResult(["rows"], [(n,)])
 
     def _explain(self, stmt: ast.Explain) -> QueryResult:
         plan = self.plan_stmt(stmt.statement)

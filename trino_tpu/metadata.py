@@ -35,6 +35,36 @@ class QualifiedTable:
 class Metadata:
     def __init__(self):
         self._catalogs: dict[str, Catalog] = {}
+        #: (catalog, schema, name) -> view Query AST; views expand at
+        #: analysis time like CTEs (MetadataManager view resolution,
+        #: MAIN/metadata/MetadataManager.java)
+        self._views: dict = {}
+        #: pluggable access control (AccessControlManager analog);
+        #: allow-all by default
+        from trino_tpu.security import AllowAllAccessControl
+
+        self.access_control = AllowAllAccessControl()
+
+    def create_view(self, qualified, query, or_replace: bool = False):
+        if qualified in self._views and not or_replace:
+            raise ValueError(f"view {'.'.join(qualified)} already exists")
+        self._views[qualified] = query
+
+    def drop_view(self, qualified) -> bool:
+        return self._views.pop(qualified, None) is not None
+
+    def get_view(self, session: "Session", parts: tuple[str, ...]):
+        """(key, view Query) for a (possibly partial) name, or None."""
+        if len(parts) == 3:
+            key = tuple(parts)
+        elif len(parts) == 2:
+            key = (session.catalog, parts[0], parts[1])
+        elif len(parts) == 1:
+            key = (session.catalog, session.schema, parts[0])
+        else:
+            return None
+        q = self._views.get(key)
+        return None if q is None else (key, q)
 
     def register_catalog(self, name: str, connector: Connector, **properties):
         self._catalogs[name] = Catalog(name, connector, properties)

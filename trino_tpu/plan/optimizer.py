@@ -44,6 +44,7 @@ __all__ = ["optimize"]
 
 def optimize(plan: P.PlanNode, metadata: Metadata, session: Session) -> P.PlanNode:
     plan = _rewrite_bottom_up(plan, _merge_adjacent_filters)
+    plan = _rewrite_bottom_up(plan, _factor_filter_ors)
     plan = _rewrite_bottom_up(plan, _extract_joins)
     plan = _push_predicates(plan, metadata)
     plan = _rewrite_bottom_up(plan, _push_semijoin_filters)
@@ -120,6 +121,73 @@ def _merge_adjacent_filters(node: P.PlanNode) -> P.PlanNode:
     if src is node.source:
         return node
     return P.Filter(dict(node.outputs), source=src, predicate=_and_all(preds))
+
+
+def _factor_filter_ors(node: P.PlanNode) -> P.PlanNode:
+    """Extract conjuncts common to every OR branch:
+    (A and B) or (A and C) -> A and (B or C)
+    (ExtractCommonPredicatesExpressionRewriter analog). TPC-DS q13/q48
+    repeat their equi-join conditions inside every OR branch — without
+    factoring, join extraction sees no top-level equi conjuncts and
+    plans a cross join."""
+    if not isinstance(node, P.Filter):
+        return node
+    new_pred = _factor_or_common(node.predicate)
+    if new_pred is node.predicate:
+        return node
+    return dc_replace(node, predicate=new_pred)
+
+
+def _factor_or_common(e: RowExpression) -> RowExpression:
+    if not isinstance(e, Call):
+        return e
+    if e.name == "and":
+        args = tuple(_factor_or_common(a) for a in e.args)
+        return Call(e.type, "and", args) if args != e.args else e
+    if e.name != "or":
+        return e
+    branches = _disjuncts(e)
+    if len(branches) < 2:
+        return e
+    conj_sets = [
+        {repr(c): c for c in _conjuncts(_factor_or_common(b))}
+        for b in branches
+    ]
+    common_keys = set(conj_sets[0])
+    for s in conj_sets[1:]:
+        common_keys &= set(s)
+    if not common_keys:
+        return e
+    # keep a stable order: first branch's conjunct order
+    common = [
+        conj_sets[0][k] for k in conj_sets[0] if k in common_keys
+    ]
+    rests = []
+    for s in conj_sets:
+        rest = [c for k, c in s.items() if k not in common_keys]
+        if not rest:
+            # one branch is exactly the common part: the OR is
+            # implied by it — the whole predicate reduces to common
+            return _and_all(common)
+        rests.append(_and_all(rest))
+    out = _and_all(common + [_or_all(rests)])
+    return out
+
+
+def _disjuncts(e: RowExpression) -> list[RowExpression]:
+    if isinstance(e, Call) and e.name == "or":
+        out = []
+        for a in e.args:
+            out.extend(_disjuncts(a))
+        return out
+    return [e]
+
+
+def _or_all(parts: list[RowExpression]) -> RowExpression:
+    out = parts[0]
+    for p in parts[1:]:
+        out = Call(T.BOOLEAN, "or", (out, p))
+    return out
 
 
 # ---- generic walking -------------------------------------------------------

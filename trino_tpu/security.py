@@ -1,0 +1,107 @@
+"""Access control: the security SPI and its built-in implementations.
+
+The analog of the reference's AccessControlManager stack
+(MAIN/security/AccessControlManager.java + SPI security): a pluggable
+``AccessControl`` checked at analysis time for reads and at the DML/DDL
+execution points for writes. The default is allow-all (the reference's
+default system access control); ``RuleBasedAccessControl`` mirrors the
+file-based rules plugin (plugin/trino-password-authenticators' sibling
+file-based system access control): ordered rules matched on
+(user, catalog, schema, table) granting privilege sets, first match
+wins, no match denies.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+
+__all__ = [
+    "AccessDeniedError", "AccessControl", "AllowAllAccessControl",
+    "RuleBasedAccessControl", "Rule",
+]
+
+#: privilege names (a subset of the reference's Privilege enum)
+PRIVILEGES = ("select", "insert", "delete", "update", "ddl")
+
+
+class AccessDeniedError(PermissionError):
+    """Raised when a privilege check fails (AccessDeniedException
+    analog, SPI/security/AccessDeniedException.java)."""
+
+
+class AccessControl:
+    """SPI: every method raises AccessDeniedError to deny."""
+
+    def check_can_select(self, user: str, catalog: str, schema: str, table: str):
+        pass
+
+    def check_can_insert(self, user: str, catalog: str, schema: str, table: str):
+        pass
+
+    def check_can_delete(self, user: str, catalog: str, schema: str, table: str):
+        pass
+
+    def check_can_update(self, user: str, catalog: str, schema: str, table: str):
+        pass
+
+    def check_can_ddl(self, user: str, catalog: str, schema: str, table: str):
+        pass
+
+
+class AllowAllAccessControl(AccessControl):
+    """The default: everything permitted."""
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One access rule: glob patterns over the identity and the object,
+    plus the granted privilege set."""
+
+    user: str = "*"
+    catalog: str = "*"
+    schema: str = "*"
+    table: str = "*"
+    privileges: tuple = PRIVILEGES
+
+    def matches(self, user, catalog, schema, table) -> bool:
+        return (
+            fnmatch.fnmatchcase(user, self.user)
+            and fnmatch.fnmatchcase(catalog, self.catalog)
+            and fnmatch.fnmatchcase(schema, self.schema)
+            and fnmatch.fnmatchcase(table, self.table)
+        )
+
+
+@dataclass
+class RuleBasedAccessControl(AccessControl):
+    """First-match-wins rule list; no match denies (the file-based
+    system access control's table-rules semantics)."""
+
+    rules: list[Rule] = field(default_factory=list)
+
+    def _check(self, privilege, user, catalog, schema, table):
+        for r in self.rules:
+            if r.matches(user, catalog, schema, table):
+                if privilege in r.privileges:
+                    return
+                break
+        raise AccessDeniedError(
+            f"Access Denied: user {user!r} cannot {privilege} "
+            f"{catalog}.{schema}.{table}"
+        )
+
+    def check_can_select(self, user, catalog, schema, table):
+        self._check("select", user, catalog, schema, table)
+
+    def check_can_insert(self, user, catalog, schema, table):
+        self._check("insert", user, catalog, schema, table)
+
+    def check_can_delete(self, user, catalog, schema, table):
+        self._check("delete", user, catalog, schema, table)
+
+    def check_can_update(self, user, catalog, schema, table):
+        self._check("update", user, catalog, schema, table)
+
+    def check_can_ddl(self, user, catalog, schema, table):
+        self._check("ddl", user, catalog, schema, table)

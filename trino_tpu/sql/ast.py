@@ -22,7 +22,7 @@ __all__ = [
     "TableRef", "SubqueryRel", "JoinRel",
     "GroupingElement", "SelectItem", "Select", "OrderItem", "Query", "SetOp",
     "Explain", "ShowTables", "ShowSchemas", "ShowCatalogs", "DescribeTable",
-    "SessionSet", "Use",
+    "SessionSet", "Use", "CreateView", "DropView", "Delete", "Update",
 ]
 
 
@@ -291,6 +291,41 @@ class Select(Node):
     group_by: list[Expr] = field(default_factory=list)
     having: Optional[Expr] = None
     distinct: bool = False
+
+
+@dataclass
+class CreateView(Statement):
+    """CREATE [OR REPLACE] VIEW name AS query (the analyzed-at-use
+    logical view of the reference, MAIN/metadata/MetadataManager.java
+    view resolution)."""
+
+    name: tuple[str, ...]
+    query: "Query"
+    or_replace: bool = False
+
+
+@dataclass
+class DropView(Statement):
+    name: tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclass
+class Delete(Statement):
+    """DELETE FROM t [WHERE pred] (row-level DML,
+    MAIN/operator/MergeWriterOperator.java family)."""
+
+    name: tuple[str, ...]
+    where: Optional[Expr] = None
+
+
+@dataclass
+class Update(Statement):
+    """UPDATE t SET c = e, ... [WHERE pred]."""
+
+    name: tuple[str, ...]
+    assignments: list[tuple[str, Expr]] = field(default_factory=list)
+    where: Optional[Expr] = None
 
 
 @dataclass

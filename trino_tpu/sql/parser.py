@@ -114,6 +114,20 @@ class _Parser:
             self.expect_op("=")
             return ast.SessionSet(".".join(name_parts), self.expr())
         if self.accept_kw("create"):
+            or_replace = False
+            if self.at_kw("or"):
+                self.next()
+                if not self._at_ident("replace"):
+                    raise SqlSyntaxError("expected REPLACE after OR")
+                self.next()
+                or_replace = True
+            if self._at_ident("view"):
+                self.next()
+                name = self.qualified_name()
+                self.expect_kw("as")
+                return ast.CreateView(name, self.query(), or_replace)
+            if or_replace:
+                raise SqlSyntaxError("OR REPLACE applies to VIEW only")
             self.expect_kw("table")
             if_not_exists = False
             if self.accept_kw("if"):
@@ -162,12 +176,39 @@ class _Parser:
                 return ast.InsertInto(name, columns, rows=rows)
             return ast.InsertInto(name, columns, query=self.query())
         if self.accept_kw("drop"):
-            self.expect_kw("table")
+            is_view = False
+            if self._at_ident("view"):
+                self.next()
+                is_view = True
+            else:
+                self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):
                 self.expect_kw("exists")
                 if_exists = True
-            return ast.DropTable(self.qualified_name(), if_exists)
+            name = self.qualified_name()
+            if is_view:
+                return ast.DropView(name, if_exists)
+            return ast.DropTable(name, if_exists)
+        if self._at_ident("delete"):
+            self.next()
+            self.expect_kw("from")
+            name = self.qualified_name()
+            where = self.expr() if self.accept_kw("where") else None
+            return ast.Delete(name, where)
+        if self._at_ident("update"):
+            self.next()
+            name = self.qualified_name()
+            self.expect_kw("set")
+            assignments = []
+            while True:
+                col = self.ident()
+                self.expect_op("=")
+                assignments.append((col, self.expr()))
+                if not self.accept_op(","):
+                    break
+            where = self.expr() if self.accept_kw("where") else None
+            return ast.Update(name, assignments, where)
         return self.query()
 
     def qualified_name(self) -> tuple[str, ...]:
