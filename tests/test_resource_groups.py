@@ -1,0 +1,113 @@
+"""Resource groups: admission control on the coordinator
+(InternalResourceGroupManager analog, MAIN/execution/resourcegroups/):
+per-group running/queued limits, FIFO admission, queue-full fail-fast,
+group selection by user.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+from trino_tpu.server.coordinator import Coordinator
+from trino_tpu.server.resource_groups import (
+    QueryQueueFullError,
+    ResourceGroup,
+    ResourceGroupManager,
+)
+
+
+def test_group_selection_and_queue_full():
+    from trino_tpu.server.resource_groups import QueryRejectedError
+
+    mgr = ResourceGroupManager([
+        ResourceGroup("etl", max_running=1, max_queued=1, user="etl_*"),
+        ResourceGroup("global", max_running=1, max_queued=1),
+    ])
+    assert mgr.select("etl_nightly").name == "etl"
+    assert mgr.select("alice").name == "global"
+    g = mgr.select("alice")
+    # free slot -> admitted straight to RUNNING (max_queued only ever
+    # counts queries that genuinely cannot run)
+    assert mgr.enqueue(g, "q1") is True
+    assert mgr.enqueue(g, "q2") is False  # slot busy: queued
+    with pytest.raises(QueryQueueFullError, match="Too many queued"):
+        mgr.enqueue(g, "q3")
+    # an unmatched identity is a REJECTION, not a capacity signal
+    strict = ResourceGroupManager([ResourceGroup("etl", user="etl_*")])
+    with pytest.raises(QueryRejectedError, match="no resource group"):
+        strict.select("alice")
+
+
+def test_fifo_acquire_release():
+    mgr = ResourceGroupManager([ResourceGroup("g", max_running=1)])
+    g = mgr.groups[0]
+    adm_a = mgr.enqueue(g, "a")   # direct (slot free)
+    adm_b = mgr.enqueue(g, "b")   # queued behind a
+    adm_c = mgr.enqueue(g, "c")   # queued behind b
+    assert (adm_a, adm_b, adm_c) == (True, False, False)
+    order = []
+
+    def worker(qid, admitted):
+        assert mgr.acquire(g, qid, lambda: False, admitted=admitted)
+        order.append(qid)
+        time.sleep(0.05)
+        mgr.release(g)
+
+    tc = threading.Thread(target=worker, args=("c", adm_c))
+    tb = threading.Thread(target=worker, args=("b", adm_b))
+    ta = threading.Thread(target=worker, args=("a", adm_a))
+    tc.start()
+    time.sleep(0.02)
+    tb.start(); ta.start()
+    ta.join(); tb.join(); tc.join()
+    # FIFO by enqueue order, not thread start order
+    assert order == ["a", "b", "c"]
+    assert mgr.stats()["g"]["running"] == 0
+
+
+def test_coordinator_admission_end_to_end():
+    mgr = ResourceGroupManager([
+        ResourceGroup("tiny", max_running=1, max_queued=1),
+    ])
+    coord = Coordinator(
+        QueryRunner.tpch("tiny"), resource_groups=mgr
+    ).start()
+    try:
+        def post(sql, user="user"):
+            req = urllib.request.Request(
+                f"{coord.uri}/v1/statement", data=sql.encode(),
+                headers={"X-Trino-User": user},
+            )
+            with urllib.request.urlopen(req) as resp:
+                return json.loads(resp.read())
+
+        def drain(payload):
+            while "nextUri" in payload:
+                with urllib.request.urlopen(payload["nextUri"]) as resp:
+                    payload = json.loads(resp.read())
+            return payload
+
+        # a slow-ish query holds the single slot; the 3rd submission
+        # (1 running + 1 queued) must fail fast with QUEUE_FULL
+        p1 = post("select count(*) from lineitem, orders "
+                  "where l_orderkey = o_orderkey")
+        p2 = post("select 1")
+        p3 = post("select 2")
+        st3 = drain(p3)
+        err = (st3.get("error") or {}).get("message", "")
+        assert "QueryQueueFull" in err, st3
+        # the first two eventually finish with results
+        st1 = drain(p1)
+        assert st1.get("error") is None and st1.get("data"), st1
+        st2 = drain(p2)
+        assert st2.get("error") is None
+        # list_queries exposes user + group
+        with urllib.request.urlopen(f"{coord.uri}/v1/queries") as resp:
+            qs = json.loads(resp.read())
+        assert all(q["resourceGroup"] == "tiny" for q in qs)
+    finally:
+        coord.stop()

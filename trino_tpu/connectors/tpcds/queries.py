@@ -1146,3 +1146,323 @@ where s_store_sk = ss_store_sk
        or (ca_state in ('VA', 'CA', 'MS')
            and ss_net_profit between 50 and 25000))
 """
+
+
+def _rollup_union(keys, aggs, body):
+    """sqlite oracle helper: spell GROUP BY ROLLUP(keys) as the union
+    of its grouping sets (sqlite has no ROLLUP)."""
+    branches = []
+    for i in range(len(keys), -1, -1):
+        cols = keys[:i] + ["null"] * (len(keys) - i)
+        group = f"group by {', '.join(keys[:i])}" if i else ""
+        branches.append(
+            f"select {', '.join(cols)}, {aggs} {body} {group}"
+        )
+    return " union all ".join(branches)
+
+
+QUERIES["q67"] = """
+select * from (
+  select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales,
+         rank() over (partition by i_category
+                      order by sumsales desc) rk
+  from (select i_category, i_class, i_brand, i_product_name, d_year,
+               d_qoy, d_moy, s_store_id,
+               sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales
+        from store_sales, date_dim, store, item
+        where ss_sold_date_sk = d_date_sk
+          and ss_item_sk = i_item_sk
+          and ss_store_sk = s_store_sk
+          and d_month_seq between 108 and 119
+        group by rollup(i_category, i_class, i_brand, i_product_name,
+                        d_year, d_qoy, d_moy, s_store_id)) dw1) dw2
+where rk <= 100
+order by i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales, rk
+limit 100
+"""
+
+SQLITE_ORACLE["q67"] = (
+    "select * from (select i_category, i_class, i_brand, "
+    "i_product_name, d_year, d_qoy, d_moy, s_store_id, sumsales, "
+    "rank() over (partition by i_category order by sumsales desc) rk "
+    "from ("
+    + _rollup_union(
+        ["i_category", "i_class", "i_brand", "i_product_name",
+         "d_year", "d_qoy", "d_moy", "s_store_id"],
+        "sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales",
+        "from store_sales, date_dim, store, item "
+        "where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk "
+        "and ss_store_sk = s_store_sk "
+        "and d_month_seq between 108 and 119",
+    )
+    + ") dw1) dw2 where rk <= 100 "
+    "order by i_category nulls last, i_class nulls last, "
+    "i_brand nulls last, i_product_name nulls last, "
+    "d_year nulls last, d_qoy nulls last, d_moy nulls last, "
+    "s_store_id nulls last, sumsales, rk limit 100"
+)
+
+_Q80_CHANNELS = """
+   select 'store channel' channel, 'store' || store_id id, sales,
+          returns, profit
+   from (select s_store_id store_id, sum(ss_ext_sales_price) sales,
+                sum(coalesce(sr_return_amt, 0)) returns,
+                sum(ss_net_profit - coalesce(sr_net_loss, 0)) profit
+         from store_sales left join store_returns
+              on ss_item_sk = sr_item_sk
+              and ss_ticket_number = sr_ticket_number,
+              date_dim, store, item, promotion
+         where ss_sold_date_sk = d_date_sk
+           and d_date between date '2000-08-23'
+               and date '2000-08-23' + interval '30' day
+           and ss_store_sk = s_store_sk
+           and ss_item_sk = i_item_sk
+           and i_current_price > 50
+           and ss_promo_sk = p_promo_sk
+           and p_channel_tv = 'N'
+         group by s_store_id) ssr
+   union all
+   select 'catalog channel', 'catalog_page' || catalog_page_id, sales,
+          returns, profit
+   from (select cp_catalog_page_id catalog_page_id,
+                sum(cs_ext_sales_price) sales,
+                sum(coalesce(cr_return_amount, 0)) returns,
+                sum(cs_net_profit - coalesce(cr_net_loss, 0)) profit
+         from catalog_sales left join catalog_returns
+              on cs_item_sk = cr_item_sk
+              and cs_order_number = cr_order_number,
+              date_dim, catalog_page, item, promotion
+         where cs_sold_date_sk = d_date_sk
+           and d_date between date '2000-08-23'
+               and date '2000-08-23' + interval '30' day
+           and cs_catalog_page_sk = cp_catalog_page_sk
+           and cs_item_sk = i_item_sk
+           and i_current_price > 50
+           and cs_promo_sk = p_promo_sk
+           and p_channel_tv = 'N'
+         group by cp_catalog_page_id) csr
+   union all
+   select 'web channel', 'web_site' || web_id, sales, returns, profit
+   from (select web_site_id web_id, sum(ws_ext_sales_price) sales,
+                sum(coalesce(wr_return_amt, 0)) returns,
+                sum(ws_net_profit - coalesce(wr_net_loss, 0)) profit
+         from web_sales left join web_returns
+              on ws_item_sk = wr_item_sk
+              and ws_order_number = wr_order_number,
+              date_dim, web_site, item, promotion
+         where ws_sold_date_sk = d_date_sk
+           and d_date between date '2000-08-23'
+               and date '2000-08-23' + interval '30' day
+           and ws_web_site_sk = web_site_sk
+           and ws_item_sk = i_item_sk
+           and i_current_price > 50
+           and ws_promo_sk = p_promo_sk
+           and p_channel_tv = 'N'
+         group by web_site_id) wsr
+"""
+
+QUERIES["q80"] = f"""
+select channel, id, sum(sales) sales, sum(returns) returns,
+       sum(profit) profit
+from ({_Q80_CHANNELS}) x
+group by rollup(channel, id)
+order by channel, id
+limit 100
+"""
+
+SQLITE_ORACLE["q80"] = (
+    _rollup_union(
+        ["channel", "id"],
+        "sum(sales) sales, sum(returns) returns, sum(profit) profit",
+        f"from ({_Q80_CHANNELS}) x",
+    )
+    + " order by 1 nulls last, 2 nulls last limit 100"
+)
+
+_Q77_BODY = """
+with ss as (
+  select s_store_sk, sum(ss_ext_sales_price) sales,
+         sum(ss_net_profit) profit
+  from store_sales, date_dim, store
+  where ss_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '30' day
+    and ss_store_sk = s_store_sk
+  group by s_store_sk),
+sr as (
+  select sr_store_sk s_store_sk, sum(sr_return_amt) returns,
+         sum(sr_net_loss) profit_loss
+  from store_returns, date_dim, store
+  where sr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '30' day
+    and sr_store_sk = s_store_sk
+  group by sr_store_sk),
+cs as (
+  select cs_call_center_sk, sum(cs_ext_sales_price) sales,
+         sum(cs_net_profit) profit
+  from catalog_sales, date_dim
+  where cs_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '30' day
+  group by cs_call_center_sk),
+cr as (
+  select cr_call_center_sk, sum(cr_return_amount) returns,
+         sum(cr_net_loss) profit_loss
+  from catalog_returns, date_dim
+  where cr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '30' day
+  group by cr_call_center_sk),
+ws as (
+  select wp_web_page_sk, sum(ws_ext_sales_price) sales,
+         sum(ws_net_profit) profit
+  from web_sales, date_dim, web_page
+  where ws_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '30' day
+    and ws_web_page_sk = wp_web_page_sk
+  group by wp_web_page_sk),
+wr as (
+  select wr_web_page_sk wp_web_page_sk, sum(wr_return_amt) returns,
+         sum(wr_net_loss) profit_loss
+  from web_returns, date_dim, web_page
+  where wr_returned_date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '30' day
+    and wr_web_page_sk = wp_web_page_sk
+  group by wr_web_page_sk)
+"""
+
+_Q77_UNION = """
+   select 'store channel' channel, ss.s_store_sk id, sales,
+          coalesce(returns, 0) returns,
+          profit - coalesce(profit_loss, 0) profit
+   from ss left join sr on ss.s_store_sk = sr.s_store_sk
+   union all
+   select 'catalog channel', cs_call_center_sk, sales, returns,
+          profit - profit_loss
+   from cs, cr
+   union all
+   select 'web channel', ws.wp_web_page_sk, sales,
+          coalesce(returns, 0) returns,
+          profit - coalesce(profit_loss, 0) profit
+   from ws left join wr on ws.wp_web_page_sk = wr.wp_web_page_sk
+"""
+
+QUERIES["q77"] = f"""
+{_Q77_BODY}
+select channel, id, sum(sales) sales, sum(returns) returns,
+       sum(profit) profit
+from ({_Q77_UNION}) x
+group by rollup(channel, id)
+order by channel, id, sales
+limit 100
+"""
+
+SQLITE_ORACLE["q77"] = (
+    _Q77_BODY
+    + _rollup_union(
+        ["channel", "id"],
+        "sum(sales) sales, sum(returns) returns, sum(profit) profit",
+        f"from ({_Q77_UNION}) x",
+    )
+    + " order by 1 nulls last, 2 nulls last, 3 limit 100"
+)
+
+_Q5_BODY = """
+with ssr as (
+  select s_store_id, sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns, sum(net_loss) profit_loss
+  from (select ss_store_sk store_sk, ss_sold_date_sk date_sk,
+               ss_ext_sales_price sales_price, ss_net_profit profit,
+               cast(0 as decimal(7,2)) return_amt,
+               cast(0 as decimal(7,2)) net_loss
+        from store_sales
+        union all
+        select sr_store_sk, sr_returned_date_sk,
+               cast(0 as decimal(7,2)), cast(0 as decimal(7,2)),
+               sr_return_amt, sr_net_loss
+        from store_returns) salesreturns, date_dim, store
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '14' day
+    and store_sk = s_store_sk
+  group by s_store_id),
+csr as (
+  select cp_catalog_page_id, sum(sales_price) sales,
+         sum(profit) profit, sum(return_amt) returns,
+         sum(net_loss) profit_loss
+  from (select cs_catalog_page_sk page_sk, cs_sold_date_sk date_sk,
+               cs_ext_sales_price sales_price, cs_net_profit profit,
+               cast(0 as decimal(7,2)) return_amt,
+               cast(0 as decimal(7,2)) net_loss
+        from catalog_sales
+        union all
+        select cr_catalog_page_sk, cr_returned_date_sk,
+               cast(0 as decimal(7,2)), cast(0 as decimal(7,2)),
+               cr_return_amount, cr_net_loss
+        from catalog_returns) salesreturns, date_dim, catalog_page
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '14' day
+    and page_sk = cp_catalog_page_sk
+  group by cp_catalog_page_id),
+wsr as (
+  select web_site_id, sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns, sum(net_loss) profit_loss
+  from (select ws_web_site_sk wsr_web_site_sk, ws_sold_date_sk date_sk,
+               ws_ext_sales_price sales_price, ws_net_profit profit,
+               cast(0 as decimal(7,2)) return_amt,
+               cast(0 as decimal(7,2)) net_loss
+        from web_sales
+        union all
+        select ws_web_site_sk, wr_returned_date_sk,
+               cast(0 as decimal(7,2)), cast(0 as decimal(7,2)),
+               wr_return_amt, wr_net_loss
+        from web_returns left join web_sales
+             on wr_item_sk = ws_item_sk
+             and wr_order_number = ws_order_number) salesreturns,
+       date_dim, web_site
+  where date_sk = d_date_sk
+    and d_date between date '2000-08-23'
+        and date '2000-08-23' + interval '14' day
+    and wsr_web_site_sk = web_site_sk
+  group by web_site_id)
+"""
+
+_Q5_UNION = """
+   select 'store channel' channel, 'store' || s_store_id id, sales,
+          returns, profit - profit_loss profit
+   from ssr
+   union all
+   select 'catalog channel', 'catalog_page' || cp_catalog_page_id,
+          sales, returns, profit - profit_loss
+   from csr
+   union all
+   select 'web channel', 'web_site' || web_site_id, sales, returns,
+          profit - profit_loss
+   from wsr
+"""
+
+QUERIES["q5"] = f"""
+{_Q5_BODY}
+select channel, id, sum(sales) sales, sum(returns) returns,
+       sum(profit) profit
+from ({_Q5_UNION}) x
+group by rollup(channel, id)
+order by channel, id
+limit 100
+"""
+
+SQLITE_ORACLE["q5"] = (
+    _Q5_BODY
+    + _rollup_union(
+        ["channel", "id"],
+        "sum(sales) sales, sum(returns) returns, sum(profit) profit",
+        f"from ({_Q5_UNION}) x",
+    )
+    + " order by 1 nulls last, 2 nulls last limit 100"
+)

@@ -1320,8 +1320,12 @@ class LocalExecutor:
                         sections[sym].append(penv[sym])
                     else:
                         d0, _ = benv[sym]
+                        # preserve trailing lanes (two-limb decimals,
+                        # sketch states) in the NULL section
                         sections[sym].append((
-                            jnp.zeros((p_cap,), dtype=d0.dtype),
+                            jnp.zeros(
+                                (p_cap,) + d0.shape[1:], dtype=d0.dtype
+                            ),
                             jnp.zeros((p_cap,), dtype=jnp.bool_),
                         ))
                 masks.append(unmatched)
@@ -1332,7 +1336,9 @@ class LocalExecutor:
                     if from_probe:
                         d0, _ = penv[sym]
                         sections[sym].append((
-                            jnp.zeros((b_cap,), dtype=d0.dtype),
+                            jnp.zeros(
+                                (b_cap,) + d0.shape[1:], dtype=d0.dtype
+                            ),
                             jnp.zeros((b_cap,), dtype=jnp.bool_),
                         ))
                     else:
@@ -1989,7 +1995,9 @@ def _concat_pages(pages: list[Page]) -> Page:
         if any(p.columns[i].valid is not None for p in pages):
             valid = jnp.concatenate([
                 (
-                    jnp.ones(p.columns[i].data.shape, dtype=jnp.bool_)
+                    # [:1]: valids are per-ROW even for multi-lane data
+                    # (two-limb decimals, sketch states)
+                    jnp.ones(p.columns[i].data.shape[:1], dtype=jnp.bool_)
                     if p.columns[i].valid is None else p.columns[i].valid
                 )
                 for p in pages
@@ -2036,7 +2044,7 @@ def _concat_sections(parts):
     if all(v is None for _, v in parts):
         return data, None
     valids = [
-        jnp.ones(d.shape, dtype=jnp.bool_) if v is None else v
+        jnp.ones(d.shape[:1], dtype=jnp.bool_) if v is None else v
         for d, v in parts
     ]
     return data, jnp.concatenate(valids)

@@ -973,6 +973,9 @@ _STRING_TRANSFORMS: dict[str, Callable] = {
     "rtrim": lambda s: s.rstrip(),
     "reverse": lambda s: s[::-1],
     "replace": lambda s, find, repl="": s.replace(find, repl),
+    # || with a literal operand (ConcatFunction over dictionary values)
+    "concat_suffix": lambda s, suffix: s + str(suffix),
+    "concat_prefix": lambda s, prefix: str(prefix) + s,
     # Trino regex semantics (JoniRegexpFunctions): extract returns the
     # group (NULL-as-empty here: dictionary transforms cannot produce
     # NULL) or '' when unmatched; replace substitutes every match

@@ -47,6 +47,8 @@ class QueryState:
     slug: str
     sql: str
     state: str = "QUEUED"  # QUEUED | RUNNING | FINISHED | FAILED
+    user: str = "user"
+    resource_group: str = "global"
     result: QueryResult | None = None
     error: str | None = None
     error_detail: str | None = None  # server-side traceback
@@ -61,13 +63,20 @@ class Coordinator:
     """Embedded coordinator server (TestingTrinoServer analog,
     MAIN/server/testing/TestingTrinoServer.java:141)."""
 
-    def __init__(self, runner: QueryRunner | None = None, port: int = 0):
+    def __init__(
+        self, runner: QueryRunner | None = None, port: int = 0,
+        resource_groups=None,
+    ):
+        from trino_tpu.server.resource_groups import ResourceGroupManager
+
         self.runner = runner or QueryRunner.tpch("tiny")
         self._queries: dict[str, QueryState] = {}
         self._lock = threading.Lock()
         self._seq = 0
         #: finished queries stay fetchable at least this long
         self.history_grace_s = 60.0
+        #: admission control (InternalResourceGroupManager analog)
+        self.resource_groups = resource_groups or ResourceGroupManager()
         # system.runtime tables over live coordinator state
         # (MAIN/connector/system/ analog)
         from trino_tpu.connectors.system import SystemConnector
@@ -99,7 +108,8 @@ class Coordinator:
                     return
                 n = int(self.headers.get("Content-Length", "0"))
                 sql = self.rfile.read(n).decode()
-                q = coordinator.submit(sql)
+                user = self.headers.get("X-Trino-User") or "user"
+                q = coordinator.submit(sql, user=user)
                 self._send(200, coordinator.proto_response(q, 0, self._base()))
 
             def do_GET(self):
@@ -164,11 +174,32 @@ class Coordinator:
 
     # ---- query management ------------------------------------------------
 
-    def submit(self, sql: str) -> QueryState:
+    def submit(self, sql: str, user: str = "user") -> QueryState:
+        from trino_tpu.server.resource_groups import (
+            QueryQueueFullError,
+            QueryRejectedError,
+        )
+
         with self._lock:
             self._seq += 1
             qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{self._seq:05d}_{uuid.uuid4().hex[:5]}"
-        q = QueryState(query_id=qid, slug=secrets.token_hex(8), sql=sql)
+        q = QueryState(
+            query_id=qid, slug=secrets.token_hex(8), sql=sql, user=user,
+        )
+        # admission (resource groups): selection + queue-full fail-fast
+        # happen BEFORE the dispatch thread exists (DispatchManager ->
+        # resource-group queueing, MAIN/dispatcher/DispatchManager.java:146)
+        try:
+            group = self.resource_groups.select(user)
+            q.resource_group = group.name
+            admitted = self.resource_groups.enqueue(group, qid)
+        except (QueryQueueFullError, QueryRejectedError) as e:
+            q.state = "FAILED"
+            q.error = f"{type(e).__name__}: {e}"
+            q.finished_at = time.time()
+            with self._lock:
+                self._queries[qid] = q
+            return q
         with self._lock:
             self._queries[qid] = q
             # bounded history: release old finished results (the
@@ -202,27 +233,41 @@ class Coordinator:
                     del self._queries[k]
 
         def run():
-            if q.cancelled:
+            # wait for a running slot (FIFO within the group; immediate
+            # when admission already granted one at submit)
+            if not self.resource_groups.acquire(
+                group, qid, lambda: q.cancelled, admitted=admitted
+            ):
+                q.state = "FAILED"
+                q.error = "Query was canceled while queued"
                 q.finished_at = time.time()
                 return
-            q.state = "RUNNING"
             try:
-                # cooperative cancellation: DELETE sets the event and
-                # the executor aborts at its next operator boundary
-                result = self.runner.execute(
-                    sql, cancel_event=q.cancel_event
-                )
                 if q.cancelled:
                     q.state = "FAILED"
-                else:
-                    q.result = result
-                    q.state = "FINISHED"
-            except Exception as e:  # surfaces through the protocol
-                q.error = f"{type(e).__name__}: {e}"
-                q.error_detail = traceback.format_exc()
-                q.state = "FAILED"
-                q.result = None
-            q.finished_at = time.time()
+                    q.error = "Query was canceled while queued"
+                    q.finished_at = time.time()
+                    return
+                q.state = "RUNNING"
+                try:
+                    # cooperative cancellation: DELETE sets the event
+                    # and the executor aborts at its next boundary
+                    result = self.runner.execute(
+                        sql, cancel_event=q.cancel_event
+                    )
+                    if q.cancelled:
+                        q.state = "FAILED"
+                    else:
+                        q.result = result
+                        q.state = "FINISHED"
+                except Exception as e:  # surfaces through the protocol
+                    q.error = f"{type(e).__name__}: {e}"
+                    q.error_detail = traceback.format_exc()
+                    q.state = "FAILED"
+                    q.result = None
+                q.finished_at = time.time()
+            finally:
+                self.resource_groups.release(group)
 
         threading.Thread(target=run, daemon=True).start()
         return q
@@ -244,6 +289,8 @@ class Coordinator:
                 "queryId": q.query_id,
                 "state": q.state,
                 "query": q.sql,
+                "user": q.user,
+                "resourceGroup": q.resource_group,
                 "error": q.error,
                 "errorDetail": q.error_detail,
             }

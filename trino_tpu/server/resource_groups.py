@@ -1,0 +1,144 @@
+"""Resource groups: admission control ahead of dispatch.
+
+The analog of the reference's InternalResourceGroupManager /
+InternalResourceGroup tree (MAIN/execution/resourcegroups/): queries
+select a group by identity, each group bounds concurrently-RUNNING and
+QUEUED queries, admission is FIFO within a group, and over-limit
+submissions fail fast with the reference's QUERY_QUEUE_FULL behavior.
+Kept one level deep (no sub-group tree) and fair-share only — the
+knobs that matter for a single-runner coordinator.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+__all__ = [
+    "ResourceGroup", "ResourceGroupManager", "QueryQueueFullError",
+    "QueryRejectedError",
+]
+
+
+class QueryQueueFullError(RuntimeError):
+    """Too many queued queries for the selected group
+    (QUERY_QUEUE_FULL analog — retryable)."""
+
+
+class QueryRejectedError(RuntimeError):
+    """No resource group matches the identity (QUERY_REJECTED analog —
+    a configuration condition, not a capacity one)."""
+
+
+@dataclass
+class ResourceGroup:
+    """One group's limits + its user selector (the resource-group
+    config file's matching rules, plugin/trino-resource-group-managers)."""
+
+    name: str
+    max_running: int = 8
+    max_queued: int = 100
+    user: str = "*"
+
+    def matches(self, user: str) -> bool:
+        return fnmatch.fnmatchcase(user, self.user)
+
+
+class _GroupState:
+    __slots__ = ("running", "queue")
+
+    def __init__(self):
+        self.running = 0
+        self.queue: deque[str] = deque()
+
+
+@dataclass
+class ResourceGroupManager:
+    """First-match-wins group selection + per-group FIFO admission."""
+
+    groups: list[ResourceGroup] = field(
+        default_factory=lambda: [ResourceGroup("global")]
+    )
+
+    def __post_init__(self):
+        self._cond = threading.Condition()
+        self._state = {g.name: _GroupState() for g in self.groups}
+
+    def select(self, user: str) -> ResourceGroup:
+        for g in self.groups:
+            if g.matches(user):
+                return g
+        raise QueryRejectedError(
+            f"no resource group matches user {user!r}"
+        )
+
+    def enqueue(self, group: ResourceGroup, qid: str) -> bool:
+        """Admit at submit time: straight to RUNNING when a slot is
+        free and nothing queues ahead (so max_queued only ever counts
+        queries that genuinely cannot run — the reference's semantics),
+        else into the FIFO queue, else fail fast when the queue is
+        full. Returns True when admitted directly to running."""
+        with self._cond:
+            st = self._state[group.name]
+            if not st.queue and st.running < group.max_running:
+                st.running += 1
+                return True
+            if len(st.queue) >= group.max_queued:
+                raise QueryQueueFullError(
+                    f"Too many queued queries for {group.name!r} "
+                    f"(max {group.max_queued})"
+                )
+            st.queue.append(qid)
+            return False
+
+    def acquire(
+        self, group: ResourceGroup, qid: str, cancelled,
+        admitted: bool = False,
+    ) -> bool:
+        """Block until ``qid`` reaches the queue head AND a running
+        slot frees (FIFO fairness); immediate when enqueue() already
+        admitted it. Returns False if cancelled while queued."""
+        if admitted:
+            return True
+        with self._cond:
+            st = self._state[group.name]
+            while True:
+                if cancelled():
+                    try:
+                        st.queue.remove(qid)
+                    except ValueError:
+                        pass
+                    self._cond.notify_all()
+                    return False
+                if (
+                    st.queue
+                    and st.queue[0] == qid
+                    and st.running < group.max_running
+                ):
+                    st.queue.popleft()
+                    st.running += 1
+                    self._cond.notify_all()
+                    return True
+                self._cond.wait(timeout=0.1)
+
+    def release(self, group: ResourceGroup) -> None:
+        with self._cond:
+            st = self._state[group.name]
+            st.running = max(st.running - 1, 0)
+            self._cond.notify_all()
+
+    def stats(self) -> dict:
+        """name -> {running, queued, max_running, max_queued} (the
+        resource-group JMX/system-table view)."""
+        with self._cond:
+            return {
+                g.name: {
+                    "running": self._state[g.name].running,
+                    "queued": len(self._state[g.name].queue),
+                    "max_running": g.max_running,
+                    "max_queued": g.max_queued,
+                }
+                for g in self.groups
+            }
