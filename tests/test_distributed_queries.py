@@ -139,3 +139,16 @@ def test_dist_topn_and_limit(runner, oracle):
         "select o_orderkey, o_totalprice from orders "
         "order by o_totalprice desc limit 10",
     )
+
+
+def test_explain_analyze_reports_exchange_stats(runner):
+    """Distributed EXPLAIN ANALYZE surfaces exchange telemetry:
+    all_to_all count, bytes moved, skew-split and escalation counters
+    (the per-stage exchange stats of the reference's EXPLAIN ANALYZE)."""
+    rows = runner.execute(
+        "explain analyze select l_shipmode, count(*) from lineitem "
+        "group by l_shipmode"
+    ).rows
+    text = "\n".join(r[0] for r in rows)
+    assert "Exchanges:" in text and "all_to_all" in text, text
+    assert "moved" in text and "escalations" in text

@@ -462,6 +462,11 @@ class QueryRunner:
         # instance-level patch: other executors (and other threads'
         # runners) are untouched
         ex.execute = timed
+        xstats = getattr(ex, "exchange_stats", None)
+        # snapshot-delta (never reset shared counters)
+        x0 = dict(xstats) if xstats is not None else None
+        skew0 = getattr(ex, "skew_joins", 0)
+        esc0 = getattr(ex, "exchange_escalations", 0)
         try:
             t0 = time.perf_counter()
             page = ex.execute(plan)
@@ -472,6 +477,17 @@ class QueryRunner:
         lines = [
             f"Query: {len(rows)} rows, {total_ms:.1f} ms total",
         ]
+        if xstats is not None and xstats["exchanges"] > x0["exchanges"]:
+            # distributed exchange telemetry (the reference surfaces
+            # per-stage exchange bytes in EXPLAIN ANALYZE the same way)
+            lines.append(
+                f"Exchanges: {xstats['exchanges'] - x0['exchanges']} "
+                f"all_to_all, "
+                f"{_fmt_bytes(xstats['bytes'] - x0['bytes'])} moved, "
+                f"skew-split joins: {getattr(ex, 'skew_joins', 0) - skew0}, "
+                f"bucket escalations: "
+                f"{getattr(ex, 'exchange_escalations', 0) - esc0}"
+            )
         lines.extend(_annotated_tree(plan, stats).splitlines())
         return QueryResult(["Query Plan"], [(line,) for line in lines])
 

@@ -188,6 +188,9 @@ class MeshExecutor(LocalExecutor):
         #: count of exchange bucket-capacity escalations (tests assert
         #: skew-proof plans never escalate)
         self.exchange_escalations = 0
+        #: per-query exchange telemetry (EXPLAIN ANALYZE + tests):
+        #: all_to_all count and device bytes moved through them
+        self.exchange_stats = {"exchanges": 0, "bytes": 0}
 
     def _attempt(self, tag: str, call):
         """Run one stage-shard program with injected-failure retry.
@@ -589,6 +592,10 @@ class MeshExecutor(LocalExecutor):
             pad_capacity(max(2 * shard_cap // n, 128)), shard_cap
         )
         leaves, meta = _page_leaves(sp)
+        self.exchange_stats["exchanges"] += 1
+        self.exchange_stats["bytes"] += sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves
+        )
         while True:
             key = (
                 "mesh-exchange",
