@@ -1,0 +1,212 @@
+"""Date/time scalar function family vs a python-datetime oracle.
+
+Reference: MAIN/operator/scalar/DateTimeFunctions.java:73 —
+date_trunc, date_add, date_diff, extract fields (quarter, week,
+day_of_week, day_of_year, year_of_week), last_day_of_month, and
+interval arithmetic over columns. The engine evaluates these as
+vectorized civil-calendar decompositions on device.
+"""
+
+import datetime
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+def dates(runner, sql):
+    """Run `sql` projecting (o_orderdate, expr) over orders."""
+    return runner.execute(sql).rows
+
+
+def py_dates(runner):
+    rows = runner.execute(
+        "select o_orderdate from orders order by o_orderkey limit 200"
+    ).rows
+    return [datetime.date.fromisoformat(r[0]) for r in rows]
+
+
+def test_extract_fields(runner):
+    rows = dates(
+        runner,
+        "select o_orderdate, quarter(o_orderdate), week(o_orderdate), "
+        "day_of_week(o_orderdate), day_of_year(o_orderdate), "
+        "year_of_week(o_orderdate) "
+        "from orders order by o_orderkey limit 200",
+    )
+    for text, q, w, dow, doy, yow in rows:
+        d = datetime.date.fromisoformat(text)
+        iso = d.isocalendar()
+        assert q == (d.month - 1) // 3 + 1
+        assert w == iso[1]
+        assert dow == iso[2]
+        assert doy == d.timetuple().tm_yday
+        assert yow == iso[0]
+
+
+def test_extract_syntax_aliases(runner):
+    rows = dates(
+        runner,
+        "select o_orderdate, extract(dow from o_orderdate), "
+        "extract(quarter from o_orderdate), extract(week from o_orderdate) "
+        "from orders order by o_orderkey limit 50",
+    )
+    for text, dow, q, w in rows:
+        d = datetime.date.fromisoformat(text)
+        assert dow == d.isocalendar()[2]
+        assert q == (d.month - 1) // 3 + 1
+        assert w == d.isocalendar()[1]
+
+
+def test_date_trunc(runner):
+    rows = dates(
+        runner,
+        "select o_orderdate, date_trunc('year', o_orderdate), "
+        "date_trunc('quarter', o_orderdate), "
+        "date_trunc('month', o_orderdate), "
+        "date_trunc('week', o_orderdate) "
+        "from orders order by o_orderkey limit 200",
+    )
+    for text, y, q, m, w in rows:
+        d = datetime.date.fromisoformat(text)
+        assert y == d.replace(month=1, day=1).isoformat()
+        assert q == d.replace(month=(d.month - 1) // 3 * 3 + 1, day=1).isoformat()
+        assert m == d.replace(day=1).isoformat()
+        assert w == (d - datetime.timedelta(days=d.isocalendar()[2] - 1)).isoformat()
+
+
+def test_date_add(runner):
+    rows = dates(
+        runner,
+        "select o_orderdate, date_add('day', 45, o_orderdate), "
+        "date_add('week', -2, o_orderdate), "
+        "date_add('month', 1, o_orderdate), "
+        "date_add('year', 3, o_orderdate) "
+        "from orders order by o_orderkey limit 200",
+    )
+    for text, d45, wm2, m1, y3 in rows:
+        d = datetime.date.fromisoformat(text)
+        assert d45 == (d + datetime.timedelta(days=45)).isoformat()
+        assert wm2 == (d - datetime.timedelta(days=14)).isoformat()
+        assert m1 == _add_months(d, 1).isoformat()
+        assert y3 == _add_months(d, 36).isoformat()
+
+
+def _add_months(d: datetime.date, months: int) -> datetime.date:
+    m0 = d.year * 12 + d.month - 1 + months
+    y, m = divmod(m0, 12)
+    m += 1
+    for day in range(d.day, 27, -1):
+        try:
+            return datetime.date(y, m, day)
+        except ValueError:
+            continue
+    return datetime.date(y, m, min(d.day, 28))
+
+
+def test_add_months_eom_clamp(runner):
+    rows = runner.execute(
+        "select date_add('month', 1, date '2000-01-31'), "
+        "date_add('year', 1, date '2000-02-29'), "
+        "date_add('month', -1, date '2000-03-31') "
+        "from nation limit 1"
+    ).rows
+    assert rows[0] == ("2000-02-29", "2001-02-28", "2000-02-29")
+
+
+def test_date_diff(runner):
+    rows = dates(
+        runner,
+        "select o_orderdate, "
+        "date_diff('day', date '1995-01-01', o_orderdate), "
+        "date_diff('week', date '1995-01-01', o_orderdate), "
+        "date_diff('month', date '1995-01-01', o_orderdate), "
+        "date_diff('year', date '1995-01-01', o_orderdate) "
+        "from orders order by o_orderkey limit 200",
+    )
+    base = datetime.date(1995, 1, 1)
+    for text, dd, dw, dm, dy in rows:
+        d = datetime.date.fromisoformat(text)
+        delta = (d - base).days
+        assert dd == delta
+        assert dw == int(delta / 7)  # truncating division
+        assert dm == _py_months_between(base, d)
+        assert dy == int(_py_months_between(base, d) / 12)
+
+
+def _py_months_between(a: datetime.date, b: datetime.date) -> int:
+    m = (b.year * 12 + b.month) - (a.year * 12 + a.month)
+    if m > 0 and _add_months(a, m) > b:
+        m -= 1
+    if m < 0 and _add_months(a, m) < b:
+        m += 1
+    return m
+
+
+def test_last_day_of_month(runner):
+    rows = dates(
+        runner,
+        "select o_orderdate, last_day_of_month(o_orderdate) "
+        "from orders order by o_orderkey limit 200",
+    )
+    for text, last in rows:
+        d = datetime.date.fromisoformat(text)
+        nxt = _add_months(d.replace(day=1), 1)
+        assert last == (nxt - datetime.timedelta(days=1)).isoformat()
+
+
+def test_interval_column_arithmetic(runner):
+    rows = dates(
+        runner,
+        "select o_orderdate, o_orderdate + interval '3' month, "
+        "o_orderdate - interval '1' year "
+        "from orders order by o_orderkey limit 100",
+    )
+    for text, p3m, m1y in rows:
+        d = datetime.date.fromisoformat(text)
+        assert p3m == _add_months(d, 3).isoformat()
+        assert m1y == _add_months(d, -12).isoformat()
+
+
+def test_date_trunc_in_group_by(runner):
+    rows = runner.execute(
+        "select date_trunc('year', o_orderdate) y, count(*) c "
+        "from orders group by 1 order by 1"
+    ).rows
+    py = {}
+    for d in (datetime.date.fromisoformat(r[0]) for r in runner.execute(
+        "select o_orderdate from orders"
+    ).rows):
+        py[d.replace(month=1, day=1).isoformat()] = py.get(
+            d.replace(month=1, day=1).isoformat(), 0
+        ) + 1
+    assert {r[0]: r[1] for r in rows} == py
+
+
+def test_concat_function(runner):
+    rows = runner.execute(
+        "select concat(n_name, '-', 'x') from nation order by n_name limit 3"
+    ).rows
+    base = runner.execute(
+        "select n_name from nation order by n_name limit 3"
+    ).rows
+    assert [r[0] for r in rows] == [r[0] + "-x" for r in base]
+
+
+def test_timestamp_trunc_and_diff(runner):
+    rows = runner.execute(
+        "select date_trunc('hour', timestamp '2001-08-22 03:04:05'), "
+        "date_add('hour', 5, timestamp '2001-08-22 03:04:05'), "
+        "date_diff('minute', timestamp '2001-08-22 03:00:00', "
+        "timestamp '2001-08-22 04:30:00') "
+        "from nation limit 1"
+    ).rows
+    t, t5, dm = rows[0]
+    assert str(t).startswith("2001-08-22 03:00:00")
+    assert str(t5).startswith("2001-08-22 08:04:05")
+    assert dm == 90

@@ -1476,6 +1476,9 @@ class ExprAnalyzer:
             raise AnalysisError(f"unsupported expression {type(e).__name__}")
         return m(e)
 
+    def _AnalyzedExpr(self, e: "AnalyzedExpr"):
+        return e.ir
+
     # literals
     def _IntLit(self, e: ast.IntLit):
         return Literal(T.BIGINT, e.value)
@@ -1567,22 +1570,36 @@ class ExprAnalyzer:
     def _date_interval(self, e: ast.Binary):
         left = self.analyze(e.left)
         iv: ast.IntervalLit = e.right  # type: ignore[assignment]
-        if not isinstance(left.type, T.DateType):
-            raise AnalysisError("interval arithmetic requires a date operand")
         amount = int(iv.value) * (-1 if iv.negative else 1)
         if e.op == "-":
             amount = -amount
+        if isinstance(left.type, T.TimestampType):
+            micros = _INTERVAL_MICROS.get(iv.unit)
+            if micros is not None:
+                return Call(
+                    T.TIMESTAMP, "add",
+                    (left, Literal(T.BIGINT, amount * micros)),
+                )
+            months = _INTERVAL_MONTHS.get(iv.unit)
+            if months is None:
+                raise AnalysisError(f"unsupported interval unit {iv.unit}")
+            return Call(
+                T.TIMESTAMP, "ts_add_months",
+                (left, Literal(T.BIGINT, amount * months)),
+            )
+        if not isinstance(left.type, T.DateType):
+            raise AnalysisError("interval arithmetic requires a date operand")
         if iv.unit in ("day", "week"):
             days = amount * (7 if iv.unit == "week" else 1)
             if isinstance(left, Literal):
                 return Literal(T.DATE, T.format_date(T.parse_date(left.value) + days))
             return Call(T.DATE, "add", (left, Literal(T.INTEGER, days)))
-        if iv.unit in ("month", "year"):
-            months = amount * (12 if iv.unit == "year" else 1)
+        if iv.unit in ("month", "quarter", "year"):
+            months = amount * _INTERVAL_MONTHS[iv.unit]
             if isinstance(left, Literal):
                 return Literal(T.DATE, _add_months(left.value, months))
-            raise AnalysisError(
-                "non-constant date +- month/year interval not supported yet"
+            return Call(
+                T.DATE, "add_months", (left, Literal(T.BIGINT, months))
             )
         raise AnalysisError(f"unsupported interval unit {iv.unit}")
 
@@ -1656,18 +1673,115 @@ class ExprAnalyzer:
         return Cast(T.type_from_name(e.type_name), arg)
 
     def _ExtractExpr(self, e: ast.ExtractExpr):
-        arg = self.analyze(e.arg)
-        if e.field in ("hour", "minute", "second"):
+        return self._extract_field(e.field, self.analyze(e.arg))
+
+    def _extract_field(self, field: str, arg) -> Call:
+        """EXTRACT(field FROM x) and the function forms year(x),
+        quarter(x), day_of_week(x), ... (reference:
+        MAIN/operator/scalar/DateTimeFunctions.java:73)."""
+        field = _EXTRACT_ALIASES.get(field, field)
+        if field in ("hour", "minute", "second"):
             if not isinstance(arg.type, T.TimestampType):
                 raise AnalysisError(
-                    f"EXTRACT({e.field}) requires a timestamp"
+                    f"EXTRACT({field}) requires a timestamp"
                 )
-            return Call(T.BIGINT, f"extract_{e.field}", (arg,))
-        if e.field not in ("year", "month", "day"):
-            raise AnalysisError(f"EXTRACT({e.field}) not supported yet")
+            return Call(T.BIGINT, f"extract_{field}", (arg,))
+        if field not in _DATE_FIELDS:
+            raise AnalysisError(f"EXTRACT({field}) not supported yet")
         if isinstance(arg.type, T.TimestampType):
             arg = Cast(T.DATE, arg)
-        return Call(T.BIGINT, f"extract_{e.field}", (arg,))
+        if not isinstance(arg.type, T.DateType):
+            raise AnalysisError(f"EXTRACT({field}) requires a date")
+        return Call(T.BIGINT, f"extract_{field}", (arg,))
+
+    def _datetime_unit(self, e: ast.FnCall, arity: int) -> str:
+        if len(e.args) != arity:
+            raise AnalysisError(f"{e.name} takes {arity} arguments")
+        unit_ast = e.args[0]
+        if not isinstance(unit_ast, ast.StrLit):
+            raise AnalysisError(
+                f"{e.name}: unit must be a string literal"
+            )
+        return unit_ast.value.lower()
+
+    def _date_trunc_fn(self, e: ast.FnCall):
+        unit = self._datetime_unit(e, 2)
+        arg = self.analyze(e.args[1])
+        if isinstance(arg.type, T.TimestampType):
+            if unit not in ("year", "quarter", "month", "week", "day",
+                            "hour", "minute", "second"):
+                raise AnalysisError(f"date_trunc: bad unit {unit!r}")
+            return Call(T.TIMESTAMP, f"ts_trunc_{unit}", (arg,))
+        if not isinstance(arg.type, T.DateType):
+            raise AnalysisError("date_trunc requires a date or timestamp")
+        if unit not in ("year", "quarter", "month", "week", "day"):
+            raise AnalysisError(
+                f"date_trunc: unit {unit!r} invalid for DATE"
+            )
+        return Call(T.DATE, f"date_trunc_{unit}", (arg,))
+
+    def _date_add_fn(self, e: ast.FnCall):
+        unit = self._datetime_unit(e, 3)
+        n = self.analyze(e.args[1])
+        arg = self.analyze(e.args[2])
+        if not n.type.is_integer:
+            raise AnalysisError("date_add: amount must be an integer")
+
+        def scaled(mult: int):
+            if mult == 1:
+                return n
+            return Call(T.BIGINT, "multiply", (n, Literal(T.BIGINT, mult)))
+
+        if isinstance(arg.type, T.TimestampType):
+            micros = _INTERVAL_MICROS.get(unit)
+            if micros is not None:
+                return Call(T.TIMESTAMP, "add", (arg, scaled(micros)))
+            months = _INTERVAL_MONTHS.get(unit)
+            if months is not None:
+                return Call(T.TIMESTAMP, "ts_add_months", (arg, scaled(months)))
+            raise AnalysisError(f"date_add: bad unit {unit!r}")
+        if not isinstance(arg.type, T.DateType):
+            raise AnalysisError("date_add requires a date or timestamp")
+        if unit in ("day", "week"):
+            return Call(T.DATE, "add", (arg, scaled(7 if unit == "week" else 1)))
+        months = _INTERVAL_MONTHS.get(unit)
+        if months is None:
+            raise AnalysisError(f"date_add: unit {unit!r} invalid for DATE")
+        return Call(T.DATE, "add_months", (arg, scaled(months)))
+
+    def _date_diff_fn(self, e: ast.FnCall):
+        unit = self._datetime_unit(e, 3)
+        a = self.analyze(e.args[1])
+        b = self.analyze(e.args[2])
+        a_ts = isinstance(a.type, T.TimestampType)
+        b_ts = isinstance(b.type, T.TimestampType)
+        if a_ts != b_ts:
+            a, b = (Cast(T.TIMESTAMP, a) if not a_ts else a), (
+                Cast(T.TIMESTAMP, b) if not b_ts else b
+            )
+            a_ts = b_ts = True
+        if unit in _INTERVAL_MONTHS:
+            months = Call(
+                T.BIGINT,
+                "ts_months_between" if a_ts else "months_between",
+                (a, b),
+            )
+            per = _INTERVAL_MONTHS[unit]
+            if per == 1:
+                return months
+            return Call(T.BIGINT, "divide", (months, Literal(T.BIGINT, per)))
+        if a_ts:
+            micros = _INTERVAL_MICROS.get(unit)
+            if micros is None:
+                raise AnalysisError(f"date_diff: bad unit {unit!r}")
+            delta = Call(T.BIGINT, "subtract", (b, a))
+            return Call(T.BIGINT, "divide", (delta, Literal(T.BIGINT, micros)))
+        if unit not in ("day", "week"):
+            raise AnalysisError(f"date_diff: unit {unit!r} invalid for DATE")
+        delta = Call(T.BIGINT, "subtract", (b, a))
+        if unit == "week":
+            return Call(T.BIGINT, "divide", (delta, Literal(T.BIGINT, 7)))
+        return delta
 
     def _FnCall(self, e: ast.FnCall):
         name = e.name.lower()
@@ -1720,6 +1834,32 @@ class ExprAnalyzer:
                     rtype, "if",
                     (either_null, Literal(rtype, None), pick),
                 )
+            return out
+        if name == "date_trunc":
+            return self._date_trunc_fn(e)
+        if name == "date_add":
+            return self._date_add_fn(e)
+        if name == "date_diff":
+            return self._date_diff_fn(e)
+        if name in _DATE_FIELDS or name in _EXTRACT_ALIASES or name in (
+            "hour", "minute", "second",
+        ):
+            if len(e.args) != 1:
+                raise AnalysisError(f"{name} takes 1 argument")
+            return self._extract_field(name, self.analyze(e.args[0]))
+        if name == "last_day_of_month":
+            if len(e.args) != 1:
+                raise AnalysisError(f"{name} takes 1 argument")
+            arg = self.analyze(e.args[0])
+            if isinstance(arg.type, T.TimestampType):
+                arg = Cast(T.DATE, arg)
+            return Call(T.DATE, "last_day_of_month", (arg,))
+        if name == "concat":
+            if len(e.args) < 2:
+                raise AnalysisError("concat requires at least 2 arguments")
+            out = self.analyze(e.args[0])
+            for a in e.args[1:]:
+                out = self._concat(ast.Binary("||", AnalyzedExpr(out), a))
             return out
         if name not in SCALAR_FNS:
             raise AnalysisError(f"unknown function {name}")
@@ -1778,6 +1918,40 @@ class ExprAnalyzer:
 
     def _InSubquery(self, e):
         raise AnalysisError("IN (subquery) is only supported as a WHERE conjunct")
+
+
+class AnalyzedExpr:
+    """AST shim carrying an already-analyzed RowExpression, so rewrite
+    helpers can re-enter binary analysis paths (e.g. concat folding)."""
+
+    def __init__(self, ir: RowExpression):
+        self.ir = ir
+
+
+#: EXTRACT field aliases (reference: DateTimeFunctions @ScalarFunction
+#: alias lists)
+_EXTRACT_ALIASES = {
+    "dow": "day_of_week",
+    "doy": "day_of_year",
+    "day_of_month": "day",
+    "week_of_year": "week",
+    "yow": "year_of_week",
+}
+
+_DATE_FIELDS = {
+    "year", "quarter", "month", "week", "day",
+    "day_of_week", "day_of_year", "year_of_week",
+}
+
+_INTERVAL_MICROS = {
+    "second": 1_000_000,
+    "minute": 60_000_000,
+    "hour": 3_600_000_000,
+    "day": 86_400_000_000,
+    "week": 7 * 86_400_000_000,
+}
+
+_INTERVAL_MONTHS = {"month": 1, "quarter": 3, "year": 12}
 
 
 def _cast_to(ir: RowExpression, target: T.DataType) -> RowExpression:

@@ -196,9 +196,8 @@ SCALAR_FNS = {
     "regexp_replace": ("regexp_replace", lambda ts: T.VARCHAR),
     "upper": ("upper", lambda ts: T.VARCHAR),
     "trim": ("trim", lambda ts: T.VARCHAR),
-    "year": ("extract_year", lambda ts: T.BIGINT),
-    "month": ("extract_month", lambda ts: T.BIGINT),
-    "day": ("extract_day", lambda ts: T.BIGINT),
+    # year/month/day and the rest of the date-field family dispatch
+    # through the analyzer's _extract_field branch, not this table
     "coalesce": ("coalesce", None),  # special typing
     # math (reference: MAIN/operator/scalar/MathFunctions.java)
     "exp": ("exp", lambda ts: T.DOUBLE),

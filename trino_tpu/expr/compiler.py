@@ -1052,6 +1052,128 @@ def _extract_civil(days):
     return y, m, d
 
 
+def _days_from_civil(y, m, d):
+    """Vectorized inverse of _extract_civil: (y, m, d) -> epoch days
+    (Howard Hinnant's days_from_civil)."""
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146_097 + doe - 719_468
+
+
+def _days_in_month(y, m):
+    ny = y + (m == 12)
+    nm = jnp.where(m == 12, 1, m + 1)
+    return _days_from_civil(ny, nm, 1) - _days_from_civil(y, m, 1)
+
+
+def _iso_dow(days):
+    """ISO day-of-week of epoch days: Monday=1..Sunday=7 (epoch day 0,
+    1970-01-01, is a Thursday -> 4). Reference: DateTimeFunctions
+    dayOfWeekFromDate."""
+    return (days.astype(jnp.int64) + 3) % 7 + 1
+
+
+def _doy(days):
+    y, _, _ = _extract_civil(days)
+    return days.astype(jnp.int64) - _days_from_civil(y, jnp.int64(1), jnp.int64(1)) + 1
+
+
+def _iso_week(days):
+    """ISO-8601 week of year: the week containing this day's Thursday
+    determines the year; weeks start Monday (reference:
+    DateTimeFunctions.weekFromDate via ISOChronology weekOfWeekyear)."""
+    days = days.astype(jnp.int64)
+    thursday = days - (_iso_dow(days) - 4)
+    ty, _, _ = _extract_civil(thursday)
+    jan1 = _days_from_civil(ty, jnp.int64(1), jnp.int64(1))
+    return (thursday - jan1) // 7 + 1
+
+
+def _add_months_days(days, months):
+    """date + n months with end-of-month day clamping (reference:
+    DateTimeFunctions.addFieldValueDate -> Joda addMonths semantics)."""
+    y, m, d = _extract_civil(days)
+    m0 = y * 12 + (m - 1) + months.astype(jnp.int64)
+    y2 = m0 // 12
+    m2 = m0 - y2 * 12 + 1
+    d2 = jnp.minimum(d, _days_in_month(y2, m2))
+    return _days_from_civil(y2, m2, d2)
+
+
+def _months_between(a, b):
+    """Full months from date a to date b: the largest n with
+    a + n months <= b (sign-symmetric; reference:
+    DateTimeFunctions.diffDate('month') -> Joda monthsBetween)."""
+    a = a.astype(jnp.int64)
+    b = b.astype(jnp.int64)
+    ya, ma, _ = _extract_civil(a)
+    yb, mb, _ = _extract_civil(b)
+    m = (yb * 12 + mb) - (ya * 12 + ma)
+    cand = _add_months_days(a, m)
+    m = m - jnp.where((m > 0) & (cand > b), 1, 0)
+    return m + jnp.where((m < 0) & (cand < b), 1, 0)
+
+
+def _ts_add_months(x, m):
+    x = x.astype(jnp.int64)
+    days = x // 86_400_000_000
+    tod = x % 86_400_000_000
+    return _add_months_days(days, m) * 86_400_000_000 + tod
+
+
+def _ts_months_between(a, b):
+    """Full months between instants: time-of-day participates (Joda
+    monthsBetween over instants — a month has not elapsed until the
+    end instant reaches start + n months to the microsecond)."""
+    a = a.astype(jnp.int64)
+    b = b.astype(jnp.int64)
+    m = _months_between(a // 86_400_000_000, b // 86_400_000_000)
+    cand = _ts_add_months(a, m)
+    m = m - jnp.where((m > 0) & (cand > b), 1, 0)
+    return m + jnp.where((m < 0) & (cand < b), 1, 0)
+
+
+def _ts_trunc(unit_micros):
+    def f(x):
+        x = x.astype(jnp.int64)
+        return x - x % unit_micros  # jnp % floors: correct pre-epoch
+
+    return f
+
+
+def _ts_trunc_civil(date_trunc_fn):
+    """Truncate a timestamp through its civil date component."""
+
+    def f(x):
+        days = x.astype(jnp.int64) // 86_400_000_000
+        return date_trunc_fn(days) * 86_400_000_000
+
+    return f
+
+
+def _date_trunc_year(d):
+    y, _, _ = _extract_civil(d)
+    return _days_from_civil(y, jnp.int64(1), jnp.int64(1))
+
+
+def _date_trunc_quarter(d):
+    y, m, _ = _extract_civil(d)
+    return _days_from_civil(y, ((m - 1) // 3) * 3 + 1, jnp.int64(1))
+
+
+def _date_trunc_month(d):
+    y, m, _ = _extract_civil(d)
+    return _days_from_civil(y, m, jnp.int64(1))
+
+
+def _date_trunc_week(d):
+    return d.astype(jnp.int64) - (_iso_dow(d) - 1)
+
+
 _SIMPLE_FNS: dict[str, Callable] = {
     "extract_year": lambda d: _extract_civil(d)[0],
     "extract_month": lambda d: _extract_civil(d)[1],
@@ -1082,4 +1204,34 @@ _SIMPLE_FNS: dict[str, Callable] = {
     "extract_hour": lambda x: (x // 3_600_000_000) % 24,
     "extract_minute": lambda x: (x // 60_000_000) % 60,
     "extract_second": lambda x: (x // 1_000_000) % 60,
+    # date/time family (reference: MAIN/operator/scalar/
+    # DateTimeFunctions.java:73 — civil-calendar decomposition runs
+    # vectorized on device, no per-row host work)
+    "extract_quarter": lambda d: (_extract_civil(d)[1] - 1) // 3 + 1,
+    "extract_day_of_week": _iso_dow,
+    "extract_day_of_year": _doy,
+    "extract_week": _iso_week,
+    "extract_year_of_week": lambda d: _extract_civil(
+        d.astype(jnp.int64) - (_iso_dow(d) - 4)
+    )[0],
+    "last_day_of_month": lambda d: (
+        lambda y, m, _d: _days_from_civil(y, m, _days_in_month(y, m))
+    )(*_extract_civil(d)),
+    "date_trunc_year": _date_trunc_year,
+    "date_trunc_quarter": _date_trunc_quarter,
+    "date_trunc_month": _date_trunc_month,
+    "date_trunc_week": _date_trunc_week,
+    "date_trunc_day": lambda d: d,
+    "ts_trunc_year": _ts_trunc_civil(_date_trunc_year),
+    "ts_trunc_quarter": _ts_trunc_civil(_date_trunc_quarter),
+    "ts_trunc_month": _ts_trunc_civil(_date_trunc_month),
+    "ts_trunc_week": _ts_trunc_civil(_date_trunc_week),
+    "ts_trunc_day": _ts_trunc(86_400_000_000),
+    "ts_trunc_hour": _ts_trunc(3_600_000_000),
+    "ts_trunc_minute": _ts_trunc(60_000_000),
+    "ts_trunc_second": _ts_trunc(1_000_000),
+    "add_months": _add_months_days,
+    "ts_add_months": _ts_add_months,
+    "months_between": _months_between,
+    "ts_months_between": _ts_months_between,
 }
