@@ -278,3 +278,107 @@ def test_window_float_sum_cross_partition_precision():
     ).rows
     small = [v for p, _, v in rows if p == 2]
     assert small == [1.0, 3.0, 6.0]  # exact, no cross-partition ulp loss
+
+
+def test_percent_rank_cume_dist(runner, oracle):
+    check(
+        runner, oracle,
+        "select o_custkey, o_orderkey, "
+        "percent_rank() over (partition by o_custkey order by o_totalprice), "
+        "cume_dist() over (partition by o_custkey order by o_totalprice) "
+        "from orders order by o_orderkey",
+    )
+
+
+def test_percent_rank_single_row_partitions(runner, oracle):
+    check(
+        runner, oracle,
+        "select o_orderkey, "
+        "percent_rank() over (partition by o_orderkey order by o_totalprice), "
+        "cume_dist() over (partition by o_orderkey order by o_totalprice) "
+        "from orders order by o_orderkey limit 50",
+    )
+
+
+def test_nth_value(runner, oracle):
+    check(
+        runner, oracle,
+        "select o_orderkey, "
+        "nth_value(o_totalprice, 2) over ("
+        "partition by o_custkey order by o_orderdate "
+        "rows between unbounded preceding and unbounded following) "
+        "from orders order by o_orderkey",
+    )
+
+
+def test_nth_value_default_frame(runner, oracle):
+    # default frame: nth_value is NULL until the 3rd peer position
+    check(
+        runner, oracle,
+        "select o_orderkey, "
+        "nth_value(o_orderkey, 3) over ("
+        "partition by o_custkey order by o_orderkey) "
+        "from orders order by o_orderkey",
+    )
+
+
+def test_range_offset_frame_sum(runner, oracle):
+    check(
+        runner, oracle,
+        "select o_orderkey, "
+        "sum(o_shippriority + 1) over ("
+        "partition by o_custkey order by o_orderkey "
+        "range between 5 preceding and 5 following), "
+        "count(*) over ("
+        "partition by o_custkey order by o_orderkey "
+        "range between 10 preceding and current row) "
+        "from orders order by o_orderkey",
+    )
+
+
+def test_range_offset_frame_desc(runner, oracle):
+    check(
+        runner, oracle,
+        "select o_orderkey, "
+        "count(*) over ("
+        "partition by o_custkey order by o_orderkey desc "
+        "range between 8 preceding and 4 following) "
+        "from orders order by o_orderkey",
+    )
+
+
+def test_range_offset_following_only(runner, oracle):
+    check(
+        runner, oracle,
+        "select o_orderkey, "
+        "sum(o_shippriority + 1) over ("
+        "partition by o_custkey order by o_orderkey "
+        "range between 3 following and 9 following) "
+        "from orders order by o_orderkey",
+    )
+
+
+def test_range_offset_decimal_key(runner, oracle):
+    # decimal ORDER BY key: the offset scales to the key's unscaled units
+    check(
+        runner, oracle,
+        "select o_orderkey, "
+        "count(*) over ("
+        "partition by o_custkey order by o_totalprice "
+        "range between 10000 preceding and 10000 following) "
+        "from orders order by o_orderkey",
+    )
+
+
+def test_range_offset_null_keys(runner, oracle):
+    # null order keys form their own peer group whose frame is the
+    # null group itself (reference RANGE semantics)
+    check(
+        runner, oracle,
+        "select o_orderkey, "
+        "count(*) over ("
+        "partition by o_custkey "
+        "order by nullif(o_shippriority, 0) "
+        "range between 1 preceding and 1 following) "
+        "from orders order by o_orderkey limit 500",
+    )

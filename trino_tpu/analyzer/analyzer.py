@@ -816,7 +816,8 @@ class Analyzer:
 
     WINDOW_ONLY_FNS = {
         "row_number", "rank", "dense_rank", "ntile", "lead", "lag",
-        "first_value", "last_value",
+        "first_value", "last_value", "percent_rank", "cume_dist",
+        "nth_value",
     }
 
     def _collect_windows(self, sel: ast.Select) -> list[ast.FnCall]:
@@ -907,6 +908,14 @@ class Analyzer:
                     if name != "ntile" and args:
                         raise AnalysisError(f"{name}() takes no arguments")
                     call = P.WindowCall(name, args, T.BIGINT, frame)
+                elif name in ("percent_rank", "cume_dist"):
+                    if args:
+                        raise AnalysisError(f"{name}() takes no arguments")
+                    call = P.WindowCall(name, args, T.DOUBLE, frame)
+                elif name == "nth_value":
+                    if len(args) != 2:
+                        raise AnalysisError("nth_value takes 2 arguments")
+                    call = P.WindowCall(name, args, args[0].type, frame)
                 elif name in ("lead", "lag", "first_value", "last_value"):
                     if not args:
                         raise AnalysisError(f"{name} requires an argument")

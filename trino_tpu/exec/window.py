@@ -123,11 +123,39 @@ def build_window_program(node: P.Window, layout_types, layout_dicts, capacity):
 
         row_number = (pos - pstart + 1).astype(jnp.int64)
 
+        # RANGE-offset support: with exactly one order key, expose the
+        # key in sorted, direction-normalized form so offset bounds
+        # become segmented binary searches (the reference's
+        # RangeValueWindowFrame, MAIN/operator/window/ — here O(log n)
+        # vectorized probes instead of per-row cursors)
+        range_ctx = None
+        if len(order_keys) == 1:
+            s, asc, nf = order_keys[0]
+            data, valid = env[s]
+            t = layout_types.get(s)
+            if jnp.ndim(data) != 2 and jnp.issubdtype(
+                jnp.asarray(data).dtype, jnp.number
+            ):
+                scale = (
+                    10 ** t.scale if isinstance(t, T.DecimalType) else 1
+                )
+                w = data[perm]
+                if not asc:
+                    w = -w
+                vp = None
+                if valid is not None:
+                    vp = valid[perm]
+                    nulls_first = nf if nf is not None else (not asc)
+                    sent = _fill_for(w.dtype, not nulls_first)
+                    w = jnp.where(vp, w, sent)
+                range_ctx = (w, vp, scale)
+
         env2 = dict(env)
         for sym, call in fns.items():
             data_s, valid_s = _eval_call(
                 call, env, mask, perm, info, pos, live_s,
                 pstart, pend, peer_start, peer_end, peer_b, row_number, n,
+                range_ctx,
             )
             # back to original row order
             data = data_s[inv]
@@ -141,6 +169,7 @@ def build_window_program(node: P.Window, layout_types, layout_dicts, capacity):
 def _eval_call(
     call, env, mask, perm, info, pos, live_s,
     pstart, pend, peer_start, peer_end, peer_b, row_number, n,
+    range_ctx=None,
 ):
     """One window function in sorted space."""
     name = call.name
@@ -151,6 +180,21 @@ def _eval_call(
     if name == "dense_rank":
         c = jnp.cumsum(peer_b.astype(jnp.int64))
         return c - c[jnp.clip(pstart, 0, n - 1)] + 1, None
+    if name == "percent_rank":
+        # (rank - 1) / (partition rows - 1); 0 for single-row partitions
+        size = (pend - pstart).astype(jnp.float64)
+        rank = (peer_start - pstart + 1).astype(jnp.float64)
+        return (
+            jnp.where(size > 1, (rank - 1) / jnp.maximum(size - 1, 1), 0.0),
+            None,
+        )
+    if name == "cume_dist":
+        # rows preceding or peer with current / partition rows
+        size = (pend - pstart).astype(jnp.float64)
+        return (
+            (peer_end - pstart).astype(jnp.float64) / jnp.maximum(size, 1),
+            None,
+        )
     if name == "ntile":
         k = _const_arg(call.args[0])
         size = (pend - pstart).astype(jnp.int64)
@@ -191,9 +235,34 @@ def _eval_call(
         start[0] in ("preceding", "following")
         or end[0] in ("preceding", "following")
     ):
-        raise NotImplementedError("RANGE frames with offsets")
-    lo = _bound_pos(start, pos, pstart, pend, peer_start, peer_end, mode, True)
-    hi = _bound_pos(end, pos, pstart, pend, peer_start, peer_end, mode, False)
+        if range_ctx is None:
+            raise NotImplementedError(
+                "RANGE offset frames require exactly one numeric "
+                "ORDER BY key"
+            )
+        w, vp, scale = range_ctx
+
+        def rbound(b, is_lo):
+            kind, off = b
+            if kind == "unbounded_preceding":
+                return pstart
+            if kind == "unbounded_following":
+                return pend
+            if kind == "current":
+                return peer_start if is_lo else peer_end
+            delta = off * scale * (1 if kind == "following" else -1)
+            target = w + jnp.asarray(delta).astype(w.dtype)
+            return _seg_searchsorted(w, target, pstart, pend, is_lo, n)
+
+        lo = rbound(start, True)
+        hi = rbound(end, False)
+        if vp is not None:
+            # null-key rows: the frame is the null peer group
+            lo = jnp.where(vp, lo, peer_start)
+            hi = jnp.where(vp, hi, peer_end)
+    else:
+        lo = _bound_pos(start, pos, pstart, pend, peer_start, peer_end, mode, True)
+        hi = _bound_pos(end, pos, pstart, pend, peer_start, peer_end, mode, False)
     lo = jnp.clip(lo, pstart, pend)
     hi = jnp.clip(hi, pstart, pend)
 
@@ -201,6 +270,13 @@ def _eval_call(
         data, valid = _sorted_arg(env, call.args[0], perm)
         at = jnp.clip(jnp.where(name == "first_value", lo, hi - 1), 0, n - 1)
         ok = hi > lo
+        out_valid = ok if valid is None else (ok & valid[at])
+        return data[at], out_valid
+    if name == "nth_value":
+        k = _const_arg(call.args[1])
+        data, valid = _sorted_arg(env, call.args[0], perm)
+        at = jnp.clip(lo + k - 1, 0, n - 1)
+        ok = (k >= 1) & (lo + k - 1 < hi)
         out_valid = ok if valid is None else (ok & valid[at])
         return data[at], out_valid
     # aggregates over the frame
@@ -327,6 +403,23 @@ def _bound_pos(bound, pos, pstart, pend, peer_start, peer_end, mode, is_lo):
         return pos - off if is_lo else pos - off + 1
     # following
     return pos + off if is_lo else pos + off + 1
+
+
+def _seg_searchsorted(w, target, lo0, hi0, left, n):
+    """Per-row binary search of ``target[i]`` within the row's own
+    sorted segment ``w[lo0[i]:hi0[i])``. ``left`` gives the first
+    position with w >= target, else first with w > target. Fixed
+    log-depth unrolled loop — jittable, O(n log n) gathers total."""
+    lo = lo0.astype(jnp.int64)
+    hi = hi0.astype(jnp.int64)
+    for _ in range(max(n.bit_length(), 1)):
+        cont = lo < hi
+        mid = (lo + hi) // 2
+        vm = w[jnp.clip(mid, 0, n - 1)]
+        go = (vm < target) if left else (vm <= target)
+        lo = jnp.where(cont & go, mid + 1, lo)
+        hi = jnp.where(cont & ~go, mid, hi)
+    return lo.astype(lo0.dtype)
 
 
 def _range_sum(vals, lo, hi, n, gid=None):
