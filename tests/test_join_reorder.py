@@ -1,0 +1,117 @@
+"""Stats-driven join reordering plan assertions.
+
+The ReorderJoins/DetermineJoinDistributionType analog
+(MAIN/sql/planner/iterative/rule/ReorderJoins.java:97): the optimizer
+grows the join tree greedily by estimated cardinality, so selective
+filtered dimensions join before large facts regardless of syntactic
+order.
+"""
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+from trino_tpu.plan import nodes as P
+
+
+@pytest.fixture(scope="module")
+def tpch():
+    return QueryRunner.tpch("tiny")
+
+
+@pytest.fixture(scope="module")
+def tpcds():
+    return QueryRunner.tpcds("tiny")
+
+
+def joins_bottom_up(plan):
+    """All Join nodes, deepest first."""
+    out = []
+
+    def walk(n, depth):
+        for s in n.sources:
+            walk(s, depth + 1)
+        if isinstance(n, P.Join):
+            out.append((depth, n))
+
+    walk(plan, 0)
+    out.sort(key=lambda t: -t[0])
+    return [j for _, j in out]
+
+
+def scan_tables(n):
+    out = set()
+
+    def walk(x):
+        if isinstance(x, P.TableScan):
+            out.add(x.table)
+        for s in x.sources:
+            walk(s)
+
+    walk(n)
+    return out
+
+
+def test_selective_pair_joins_first(tpch):
+    # syntactic order starts from lineitem; stats must start from the
+    # filtered customer x orders pair instead
+    plan = tpch.plan_sql(
+        "select o_orderkey from lineitem, orders, customer "
+        "where l_orderkey = o_orderkey and c_custkey = o_custkey "
+        "and c_mktsegment = 'BUILDING'"
+    )
+    deepest = joins_bottom_up(plan)[0]
+    assert scan_tables(deepest) == {"orders", "customer"}
+
+
+def test_no_cross_products_on_connected_graph(tpch):
+    plan = tpch.plan_sql(
+        "select n_name from customer, orders, lineitem, supplier, "
+        "nation, region "
+        "where c_custkey = o_custkey and l_orderkey = o_orderkey "
+        "and l_suppkey = s_suppkey and c_nationkey = s_nationkey "
+        "and s_nationkey = n_nationkey and n_regionkey = r_regionkey "
+        "and r_name = 'ASIA'"
+    )
+    assert all(j.kind != "cross" for j in joins_bottom_up(plan))
+
+
+def test_q72_facts_not_joined_first(tpcds):
+    # TPC-DS q72 (deep tree over catalog_sales x inventory x dims):
+    # the two big facts must not be the starting pair; a filtered
+    # dimension joins in before inventory
+    from trino_tpu.connectors.tpcds.queries import QUERIES
+
+    plan = tpcds.plan_sql(QUERIES["q72"])
+    joins = joins_bottom_up(plan)
+    deepest = scan_tables(joins[0])
+    assert deepest != {"catalog_sales", "inventory"}
+    # the deepest join involving catalog_sales pairs it with a
+    # dimension, not the inventory fact
+    for j in joins:
+        tabs = scan_tables(j)
+        if "catalog_sales" in tabs:
+            assert "inventory" not in scan_tables(j.right) or \
+                "catalog_sales" not in scan_tables(j.left) or len(tabs) > 2
+            break
+
+
+def test_result_unchanged_by_reorder(tpch):
+    # ordering is a pure optimization: results must match the oracle
+    from trino_tpu.testing.golden import (
+        assert_rows_match,
+        load_tpch_sqlite,
+        to_sqlite,
+    )
+
+    sql = (
+        "select c_mktsegment, count(*) c, sum(l_extendedprice) s "
+        "from lineitem, orders, customer "
+        "where l_orderkey = o_orderkey and c_custkey = o_custkey "
+        "and o_orderdate < date '1995-01-01' "
+        "group by c_mktsegment order by c_mktsegment"
+    )
+    data = tpch.metadata.connector("tpch").data("tiny")
+    oracle = load_tpch_sqlite(data)
+    result = tpch.execute(sql)
+    expected = oracle.execute(to_sqlite(sql)).fetchall()
+    assert_rows_match(result.rows, expected, ordered=True, abs_tol=1e-6)

@@ -45,7 +45,10 @@ __all__ = ["optimize"]
 def optimize(plan: P.PlanNode, metadata: Metadata, session: Session) -> P.PlanNode:
     plan = _rewrite_bottom_up(plan, _merge_adjacent_filters)
     plan = _rewrite_bottom_up(plan, _factor_filter_ors)
-    plan = _rewrite_bottom_up(plan, _extract_joins)
+    plan = _rewrite_bottom_up(plan, lambda n: _extract_joins(n, metadata))
+    plan = _push_predicates(plan, metadata)
+    plan = _reorder_inner_joins(plan, metadata)
+    # residual conjuncts hoisted by the reorder re-push onto the new tree
     plan = _push_predicates(plan, metadata)
     plan = _rewrite_bottom_up(plan, _push_semijoin_filters)
     plan = _choose_build_sides(plan, metadata)
@@ -268,8 +271,21 @@ def _flatten_cross(node: P.PlanNode) -> list[P.PlanNode] | None:
     return None
 
 
-def _extract_joins(node: P.PlanNode) -> P.PlanNode:
-    """Filter(cross-join chain) -> connected equi-join tree."""
+def _extract_joins(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    """Filter(cross-join chain) -> connected equi-join tree, ordered by
+    estimated cardinality.
+
+    The ReorderJoins/DetermineJoinDistributionType analog
+    (MAIN/sql/planner/iterative/rule/ReorderJoins.java:97): instead of
+    enumerating all orders through a memo, the tree grows greedily by
+    cost — start from the connected pair with the smallest estimated
+    join output, then repeatedly join in the connected relation whose
+    addition yields the smallest estimated intermediate result (stats
+    from plan.stats: connector row counts, NDVs, predicate
+    selectivity). Deep TPC-DS trees (q72/q95) depend on this: syntactic
+    order joins the largest fact tables first."""
+    from trino_tpu.plan.stats import estimate
+
     if not isinstance(node, P.Filter):
         return node
     rels = _flatten_cross(node.source)
@@ -298,57 +314,14 @@ def _extract_joins(node: P.PlanNode) -> P.PlanNode:
         else:
             residual.append(c)
 
-    parts: list[P.PlanNode | None] = list(rels)
+    parts: list[P.PlanNode] = list(rels)
     for i, preds in local.items():
         src = parts[i]
         parts[i] = P.Filter(
             dict(src.outputs), source=src, predicate=_and_all(preds)
         )
 
-    # greedy connected join-tree growth: start from the largest
-    # relation's component? No — start anywhere, always join in a
-    # relation connected by at least one equi edge
-    remaining = set(range(len(rels)))
-    placed = {min(remaining)}
-    remaining -= placed
-    tree = parts[min(placed)]
-    used_edges: set[int] = set()
-    while remaining:
-        progress = False
-        for k, (c, i, j, ls, rs) in enumerate(equi):
-            if k in used_edges:
-                continue
-            if (i in placed) == (j in placed):
-                continue
-            new = i if i in remaining else j
-            # gather every unused equi edge between the tree and `new`
-            criteria = []
-            for k2, (c2, i2, j2, ls2, rs2) in enumerate(equi):
-                if k2 in used_edges:
-                    continue
-                if {i2, j2} <= (placed | {new}) and new in (i2, j2):
-                    crit = (ls2, rs2) if j2 == new else (rs2, ls2)
-                    criteria.append(crit)
-                    used_edges.add(k2)
-            right = parts[new]
-            tree = P.Join(
-                {**tree.outputs, **right.outputs},
-                kind="inner", left=tree, right=right, criteria=criteria,
-            )
-            placed.add(new)
-            remaining.remove(new)
-            progress = True
-            break
-        if not progress:
-            # disconnected component: true cross join
-            new = min(remaining)
-            right = parts[new]
-            tree = P.Join(
-                {**tree.outputs, **right.outputs},
-                kind="cross", left=tree, right=right,
-            )
-            placed.add(new)
-            remaining.remove(new)
+    tree, used_edges = _grow_join_tree(parts, equi, metadata)
     # equi edges whose endpoints landed in the same component earlier
     # than expected become residual comparisons
     for k, (c, *_rest) in enumerate(equi):
@@ -367,6 +340,167 @@ def _extract_joins(node: P.PlanNode) -> P.PlanNode:
             },
         )
     return tree
+
+
+def _grow_join_tree(
+    parts: list[P.PlanNode],
+    equi: list[tuple],
+    metadata: Metadata,
+) -> tuple[P.PlanNode, set[int]]:
+    """Greedy cost-ordered join-tree growth over relations ``parts``
+    and equi edges ``equi`` (entries (expr|None, i, j, left_sym,
+    right_sym)). Starts from the connected pair with the smallest
+    estimated join output, then repeatedly joins in the connected
+    relation minimizing the estimated intermediate result. Returns
+    (tree, consumed edge ids)."""
+    from trino_tpu.plan.stats import estimate
+
+    cache: dict = {}
+
+    def rows(n: P.PlanNode) -> float:
+        try:
+            return estimate(n, metadata, cache).rows
+        except Exception:
+            return float("inf")
+
+    def candidate(tree: P.PlanNode, placed: set[int], new: int):
+        """Join(tree, parts[new]) using every unused equi edge between
+        the placed set and `new`; returns (join, consumed edge ids)."""
+        criteria, edges = [], []
+        for k2, (_c2, i2, j2, ls2, rs2) in enumerate(equi):
+            if k2 in used_edges:
+                continue
+            if {i2, j2} <= (placed | {new}) and new in (i2, j2):
+                criteria.append((ls2, rs2) if j2 == new else (rs2, ls2))
+                edges.append(k2)
+        right = parts[new]
+        join = P.Join(
+            {**tree.outputs, **right.outputs},
+            kind="inner" if criteria else "cross",
+            left=tree, right=right, criteria=criteria,
+        )
+        return join, edges
+
+    used_edges: set[int] = set()
+    # starting pair: the connected pair with the smallest estimated
+    # join output (ties: smaller combined inputs, then syntactic order)
+    pair_ids = sorted({
+        (min(i, j), max(i, j)) for _c, i, j, _ls, _rs in equi if i != j
+    })
+    if pair_ids:
+        def pair_cost(p):
+            i, j = p
+            join, _ = candidate(parts[i], {i}, j)
+            return (rows(join), rows(parts[i]) + rows(parts[j]), p)
+
+        i0, j0 = min(pair_ids, key=pair_cost)
+        tree, edges = candidate(parts[i0], {i0}, j0)
+        used_edges.update(edges)
+        placed = {i0, j0}
+    else:
+        placed = {0}
+        tree = parts[0]
+    remaining = set(range(len(parts))) - placed
+    while remaining:
+        connected = []
+        for new in sorted(remaining):
+            join, edges = candidate(tree, placed, new)
+            if edges:
+                connected.append((rows(join), new, join, edges))
+        if connected:
+            _, new, join, edges = min(
+                connected, key=lambda t: (t[0], t[1])
+            )
+            tree = join
+            used_edges.update(edges)
+        else:
+            # disconnected component: cross join, smallest first
+            new = min(remaining, key=lambda r: (rows(parts[r]), r))
+            right = parts[new]
+            tree = P.Join(
+                {**tree.outputs, **right.outputs},
+                kind="cross", left=tree, right=right,
+            )
+        placed.add(new)
+        remaining.remove(new)
+    return tree, used_edges
+
+
+def _reorder_inner_joins(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    """Flatten maximal pure-inner-join subtrees (explicit JOIN ... ON
+    syntax) into a relation set + equi-edge multigraph and regrow the
+    tree by estimated cardinality (ReorderJoins.java:97's multi-join
+    flattening). Runs after predicate pushdown so relation estimates
+    see their filters. Non-equi join filters re-attach above the new
+    tree — equivalent for inner joins."""
+    def walk(n: P.PlanNode) -> P.PlanNode:
+        srcs = n.sources
+        if srcs:
+            n = _replace_sources(n, [walk(s) for s in srcs])
+        if not (
+            isinstance(n, P.Join) and n.kind == "inner" and n.criteria
+        ):
+            return n
+        parts: list[P.PlanNode] = []
+        crits: list[tuple[str, str]] = []
+        residual: list[RowExpression] = []
+
+        def flatten(j: P.PlanNode):
+            if isinstance(j, P.Join) and j.kind == "inner" and j.criteria:
+                flatten(j.left)
+                flatten(j.right)
+                crits.extend(j.criteria)
+                if j.filter is not None:
+                    residual.extend(_conjuncts(j.filter))
+            elif isinstance(j, P.Filter) and isinstance(j.source, P.Join) \
+                    and j.source.kind == "inner" and j.source.criteria:
+                # a residual (non-equi) filter parked on an inner join:
+                # flatten through it; the conjuncts re-push after the
+                # reorder (optimize runs _push_predicates again)
+                residual.extend(_conjuncts(j.predicate))
+                flatten(j.source)
+            else:
+                parts.append(j)
+
+        flatten(n)
+        if len(parts) < 3:
+            return n
+        rel_syms = [set(p.outputs) for p in parts]
+
+        def owner(sym: str) -> int | None:
+            for i, syms in enumerate(rel_syms):
+                if sym in syms:
+                    return i
+            return None
+
+        equi: list[tuple] = []
+        for ls, rs in crits:
+            i, j = owner(ls), owner(rs)
+            if i is None or j is None or i == j:
+                # criteria inside one relation (shouldn't happen) —
+                # bail out, keep the original tree
+                return n
+            equi.append((None, i, j, ls, rs))
+        tree, used_edges = _grow_join_tree(parts, equi, metadata)
+        for k, (_c, _i, _j, ls, rs) in enumerate(equi):
+            if k not in used_edges:
+                lt = tree.outputs[ls]
+                residual.append(Call(
+                    T.BOOLEAN, "eq",
+                    (InputRef(lt, ls), InputRef(tree.outputs[rs], rs)),
+                ))
+        out: P.PlanNode = _attach(tree, residual)
+        if set(out.outputs) != set(n.outputs):
+            out = P.Project(
+                dict(n.outputs),
+                source=out,
+                assignments={
+                    s: InputRef(t, s) for s, t in n.outputs.items()
+                },
+            )
+        return out
+
+    return walk(node)
 
 
 def _hoist_or_common(conjuncts: list[RowExpression]) -> list[RowExpression]:
