@@ -1318,11 +1318,8 @@ def _extract_correlation(
                     assignments[inner] = InputRef(src.outputs[inner], inner)
                     outputs[inner] = src.outputs[inner]
             return P.Project(outputs, source=src, assignments=assignments)
-        if isinstance(n, P.Aggregate):
-            raise AnalysisError(
-                "correlated subquery with aggregation requires scalar form"
-            )
-        # any other node ends the Filter/Project spine: correlation may
+        # any node below the Filter/Project spine (including an
+        # aggregate from an inlined CTE) ends the walk: correlation may
         # not hide below it — verify and keep the subtree as-is
         _assert_no_outer_refs(n, outer_syms)
         return n

@@ -246,11 +246,19 @@ class _Compiler:
                 return wrap(lambda x: x.astype(dtype) / scale)
             return wrap(lambda x: x.astype(dtype))
         if isinstance(d_t, T.DecimalType):
-            if (isinstance(s_t, T.DecimalType) and s_t.is_long) or d_t.is_long:
-                raise NotImplementedError(
-                    f"cast {s_t} -> {d_t}: long-decimal rescaling is "
-                    "not implemented (route through DOUBLE)"
+            if (isinstance(s_t, T.DecimalType) and s_t.is_long) or (
+                isinstance(s_t, T.DecimalType) and d_t.is_long
+            ):
+                return self._limb_rescale_cast(src, s_t, d_t)
+            if d_t.is_long and s_t.is_integer:
+                from trino_tpu.exec.aggregates import _limb_encode
+
+                m = 10 ** d_t.scale
+                return wrap(
+                    lambda x: _limb_encode(x.astype(jnp.int64) * m)
                 )
+            if d_t.is_long:
+                raise NotImplementedError(f"cast {s_t} -> {d_t}")
             if isinstance(s_t, T.DecimalType):
                 if d_t.scale >= s_t.scale:
                     m = 10 ** (d_t.scale - s_t.scale)
@@ -278,6 +286,41 @@ class _Compiler:
                 # reference rounds (Math.round): floor(x + 0.5)
                 return wrap(lambda x: jnp.floor(x + 0.5).astype(dtype))
             return wrap(lambda x: x.astype(dtype))
+        if isinstance(d_t, T.DateType) and isinstance(s_t, T.VarcharType):
+            # host-parse the dictionary once -> device gather by code;
+            # unparseable values become NULL (reference: cast raises;
+            # vectorized execution masks instead)
+            if src.dictionary is None:
+                raise NotImplementedError(
+                    "cast varchar -> date requires a dictionary input"
+                )
+            vals, bad = [], []
+            for v in src.dictionary.values:
+                try:
+                    vals.append(T.parse_date(str(v)))
+                    bad.append(False)
+                except (ValueError, TypeError):
+                    vals.append(0)
+                    bad.append(True)
+            n = max(len(vals), 1)
+            table = jnp.asarray(np.asarray(
+                vals + [0] * (n - len(vals)), dtype=np.int32
+            ))
+            badt = jnp.asarray(np.asarray(
+                bad + [True] * (n - len(bad)), dtype=np.bool_
+            ))
+            has_bad = any(bad)
+
+            def ev_vc_date(env):
+                data, valid = src.fn(env)
+                code = jnp.clip(data, 0, n - 1)
+                out = table[code]
+                if has_bad:
+                    okv = ~badt[code]
+                    valid = okv if valid is None else (valid & okv)
+                return out, valid
+
+            return CompiledExpr(ev_vc_date, d_t, is_literal=src.is_literal)
         if isinstance(d_t, T.DateType) and isinstance(s_t, T.TimestampType):
             return wrap(
                 lambda x: (x // T.MICROS_PER_DAY).astype(jnp.int32)
@@ -289,6 +332,47 @@ class _Compiler:
         if isinstance(d_t, T.VarcharType):
             raise NotImplementedError(f"cast {s_t} -> varchar not yet supported")
         raise NotImplementedError(f"cast {s_t} -> {d_t}")
+
+    def _limb_rescale_cast(
+        self, src: CompiledExpr, s_t: "T.DecimalType", d_t: "T.DecimalType"
+    ) -> CompiledExpr:
+        """Exact decimal rescale where either side is a two-limb
+        decimal(>18): upscale multiplies limbs with carry
+        normalization, downscale divides 96/64 rounding half away from
+        zero (reference: SPI/type/Decimals.rescale over Int128)."""
+        from trino_tpu.exec.aggregates import (
+            _limb_div_round,
+            _limb_encode,
+            _limb_norm,
+        )
+
+        diff = d_t.scale - s_t.scale
+        if 10 ** abs(diff) > 2**31:
+            raise NotImplementedError(
+                f"cast {s_t} -> {d_t}: rescale by >10^9"
+            )
+        s_long = s_t.is_long
+
+        def ev(env):
+            x, v = src.fn(env)
+            if s_long:
+                hi, lo = x[..., 0], x[..., 1]
+            else:
+                xi = x.astype(jnp.int64)
+                hi, lo = xi >> jnp.int64(32), xi & jnp.int64(0xFFFFFFFF)
+            if diff > 0:
+                m = 10 ** diff
+                hi, lo = _limb_norm(hi * m, lo * m)
+            elif diff < 0:
+                q = _limb_div_round(hi, lo, jnp.int64(10 ** (-diff)))
+                if d_t.is_long:
+                    return _limb_encode(q), v
+                return q, v
+            if d_t.is_long:
+                return jnp.stack([hi, lo], axis=-1), v
+            return hi * jnp.int64(4294967296) + lo, v
+
+        return CompiledExpr(ev, d_t, is_literal=src.is_literal)
 
     # ---- calls -----------------------------------------------------------
     def _call(self, expr: Call) -> CompiledExpr:
@@ -347,9 +431,45 @@ class _Compiler:
             return CompiledExpr(
                 lambda env: (lambda d, v: (-d, v))(*a.fn(env)), expr.type
             )
+        if name == "round":
+            return self._round(expr)
         if name in _SIMPLE_FNS:
             return self._simple(expr)
         raise NotImplementedError(f"function {name} not implemented")
+
+    def _round(self, expr: Call) -> CompiledExpr:
+        """round(x[, n]): half away from zero (reference
+        MathFunctions.round — NOT banker's rounding). Decimal inputs
+        round on the unscaled integer; the digit count must be a
+        constant (it shapes the compiled program)."""
+        a = self.compile(expr.args[0])
+        ndig = 0
+        if len(expr.args) > 1:
+            d = expr.args[1]
+            if not isinstance(d, Literal) or d.value is None:
+                raise NotImplementedError(
+                    "round() digit count must be a constant"
+                )
+            ndig = int(d.value)
+        out_t = expr.type
+
+        def ev(env):
+            x, v = a.fn(env)
+            if isinstance(a.type, T.DecimalType):
+                s = a.type.scale
+                if ndig >= s:
+                    return x, v
+                m = 10 ** (s - ndig)
+                return _div_round_half_up(x, m) * m, v
+            if a.type.is_integer:
+                return x, v
+            scale = jnp.asarray(10.0 ** ndig, dtype=x.dtype)
+            y = x * scale
+            return (
+                jnp.sign(y) * jnp.floor(jnp.abs(y) + 0.5) / scale
+            ).astype(out_t.np_dtype), v
+
+        return CompiledExpr(ev, out_t)
 
     def _logic(self, expr: Call) -> CompiledExpr:
         parts = [self.compile(a) for a in expr.args]
@@ -1182,7 +1302,6 @@ _SIMPLE_FNS: dict[str, Callable] = {
     "sqrt": jnp.sqrt,
     "floor": jnp.floor,
     "ceil": jnp.ceil,
-    "round": jnp.round,
     "exp": jnp.exp,
     "ln": jnp.log,
     "log2": jnp.log2,

@@ -115,7 +115,79 @@ def _load_into(
                 zip(*arrays),
             )
     conn.commit()
+    _register_aggregates(conn)
     return conn
+
+
+class _SampleStdDev:
+    """stddev_samp for the sqlite oracle (sqlite has no stddev)."""
+
+    def __init__(self):
+        self.vals: list[float] = []
+
+    def step(self, v):
+        if v is not None:
+            self.vals.append(float(v))
+
+    def _var(self):
+        n = len(self.vals)
+        if n < 2:
+            return None
+        m = sum(self.vals) / n
+        return sum((x - m) ** 2 for x in self.vals) / (n - 1)
+
+    def finalize(self):
+        v = self._var()
+        return None if v is None else math.sqrt(v)
+
+
+class _SampleVar(_SampleStdDev):
+    def finalize(self):
+        return self._var()
+
+
+def _register_aggregates(conn: sqlite3.Connection) -> None:
+    conn.create_aggregate("stddev_samp", 1, _SampleStdDev)
+    conn.create_aggregate("stddev", 1, _SampleStdDev)
+    conn.create_aggregate("var_samp", 1, _SampleVar)
+    conn.create_aggregate("variance", 1, _SampleVar)
+    conn.create_function(
+        "concat", -1,
+        lambda *a: "".join("" if x is None else str(x) for x in a),
+        deterministic=True,
+    )
+
+
+def _strip_compound_member_parens(sql: str) -> str:
+    """sqlite rejects parenthesized compound-query members
+    ((SELECT ...) UNION ALL (SELECT ...)); strip parens directly
+    wrapping a member adjacent to a set operator."""
+    import re
+
+    changed = True
+    while changed:
+        changed = False
+        stack: list[int] = []
+        pairs: dict[int, int] = {}
+        for i, ch in enumerate(sql):
+            if ch == "(":
+                stack.append(i)
+            elif ch == ")" and stack:
+                pairs[stack.pop()] = i
+        for o in sorted(pairs):
+            c = pairs[o]
+            inner = sql[o + 1:c].lstrip()
+            if not re.match(r"select\b|\(", inner, re.I):
+                continue
+            before = sql[:o].rstrip()
+            after = sql[c + 1:].lstrip()
+            if re.search(
+                r"(union(\s+all)?|intersect|except)\s*$", before, re.I
+            ) or re.match(r"(union|intersect|except)\b", after, re.I):
+                sql = sql[:o] + " " + sql[o + 1:c] + " " + sql[c + 1:]
+                changed = True
+                break
+    return sql
 
 
 def to_sqlite(sql: str) -> str:
@@ -125,7 +197,23 @@ def to_sqlite(sql: str) -> str:
     import datetime
     import re
 
-    out = re.sub(r"\bdate\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", sql, flags=re.I)
+    out = _strip_compound_member_parens(sql)
+
+    def norm_cast_date(m):
+        y, mo, d = m.group(1).split("-")
+        return f"'{int(y):04d}-{int(mo):02d}-{int(d):02d}'"
+
+    out = re.sub(
+        r"CAST\s*\(\s*'(\d{4}-\d{1,2}-\d{1,2})'\s+AS\s+DATE\s*\)",
+        norm_cast_date, out, flags=re.I,
+    )
+    # CAST(col AS DATE) would take sqlite's NUMERIC affinity ('2000-03-15'
+    # -> 2000); dates are ISO TEXT here, so the cast is a no-op
+    out = re.sub(
+        r"CAST\s*\(\s*([A-Za-z_][A-Za-z0-9_.]*)\s+AS\s+DATE\s*\)",
+        r"\1", out, flags=re.I,
+    )
+    out = re.sub(r"\bdate\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", out, flags=re.I)
 
     def fold(m):
         d = datetime.date.fromisoformat(m.group(1))
