@@ -24,3 +24,23 @@ import jax  # noqa: E402
 # not through the remote-compile tunnel.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+# XLA:CPU in-process compile accumulation: one process compiling many
+# large query programs segfaults inside LLVM around the ~45th heavy
+# compile (observed deterministically on the TPC-DS suite; the crash
+# is cumulative, not query-specific — any 44 heavy tests then boom).
+# Dropping jax's executable caches every N tests keeps the process
+# healthy; the persistent on-disk cache makes re-JITs cheap.
+import pytest  # noqa: E402
+
+_test_count = 0
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    global _test_count
+    yield
+    _test_count += 1
+    if _test_count % 15 == 0:
+        jax.clear_caches()

@@ -58,11 +58,15 @@ def mesh_runner():
     return QueryRunner.tpcds("tiny", mesh=make_mesh())
 
 
-@pytest.mark.parametrize(
-    "qid",
-    ["q3", "q7", "q18", "q22", "q27", "q36", "q72", "q89", "q95", "q96"],
-)
+# the distributed executor is the product: every query runs on the
+# mesh by default; entries here name the exceptions (with the reason)
+DISTRIBUTED_SKIP: dict[str, str] = {}
+
+
+@pytest.mark.parametrize("qid", ALL)
 def test_tpcds_distributed(oracle, mesh_runner, qid):
+    if qid in DISTRIBUTED_SKIP:
+        pytest.skip(DISTRIBUTED_SKIP[qid])
     check(mesh_runner, oracle, qid)
 
 

@@ -98,6 +98,28 @@ def _find_scalar_subqueries(e, out: list):
                             _find_scalar_subqueries(y, out)
 
 
+def _find_subquery_predicates(e, out: list):
+    """Collect [NOT] EXISTS / [NOT] IN (query) terms at any depth of a
+    boolean expression (they may sit under OR — reference: these plan
+    as SemiJoins whose match symbol substitutes into the predicate)."""
+    if isinstance(e, (ast.Exists, ast.InSubquery)):
+        out.append(e)
+        return
+    if isinstance(e, ast.ScalarSubquery):
+        return
+    for v in vars(e).values() if hasattr(e, "__dict__") else []:
+        if isinstance(v, ast.Expr):
+            _find_subquery_predicates(v, out)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, ast.Expr):
+                    _find_subquery_predicates(x, out)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, ast.Expr):
+                            _find_subquery_predicates(y, out)
+
+
 class Analyzer:
     def __init__(self, metadata: Metadata, session: Session):
         self.metadata = metadata
@@ -428,8 +450,19 @@ class Analyzer:
                     names.append(f.name)
                     fields.append(Field(f.name, sym, f.type))
                 continue
+            # scalar subqueries in the SELECT list (e.g. CASE WHEN
+            # (SELECT count(*) ...) THEN ...) plan as joins whose value
+            # symbol substitutes into the projection
+            item_subqs: list[ast.ScalarSubquery] = []
+            _find_scalar_subqueries(item.expr, item_subqs)
+            item_repl = dict(replacements)
+            for sq in item_subqs:
+                node, scope_sq, v_sym, v_typ = self._plan_scalar_subquery(
+                    node, scope, sq, ctes
+                )
+                item_repl[_ast_key(sq)] = InputRef(v_typ, v_sym)
             ea = ExprAnalyzer(
-                self, scope, replacements=replacements,
+                self, scope, replacements=item_repl,
                 restrict_to=restrict, outer_refs=outer_refs,
             )
             ir = ea.analyze(item.expr)
@@ -678,6 +711,21 @@ class Analyzer:
                         node, scope, sq, ctes
                     )
                     repl[_ast_key(sq)] = InputRef(typ, sym)
+            # EXISTS / IN (query) nested under OR (non-conjunct
+            # position): plan each as a SemiJoin and substitute its
+            # match symbol into the predicate
+            sub_preds: list = []
+            _find_subquery_predicates(c, sub_preds)
+            match_syms: set[str] = set()
+            for sp in sub_preds:
+                node, scope, match_sym = self._plan_semijoin(
+                    node, scope, sp, ctes
+                )
+                match_syms.add(match_sym)
+                pred: RowExpression = InputRef(T.BOOLEAN, match_sym)
+                if getattr(sp, "negated", False):
+                    pred = Call(T.BOOLEAN, "not", (pred,))
+                repl[_ast_key(sp)] = pred
             ea = ExprAnalyzer(
                 self, scope, replacements=repl,
                 restrict_to=restrict_to, outer_refs=outer_refs,
@@ -685,7 +733,13 @@ class Analyzer:
             ir = ea.analyze(c if not negated else ast.Unary("not", c))
             if ir.type != T.BOOLEAN:
                 raise AnalysisError("WHERE/HAVING predicate must be boolean")
-            node = P.Filter(dict(node.outputs), source=node, predicate=ir)
+            node = P.Filter(
+                {
+                    k: v for k, v in node.outputs.items()
+                    if k not in match_syms
+                },
+                source=node, predicate=ir,
+            )
         return node, scope
 
     def _plan_semijoin(self, node, scope, c, ctes):
@@ -1287,8 +1341,14 @@ def _extract_correlation(
 
     def rewrite(n: P.PlanNode) -> P.PlanNode:
         if isinstance(n, P.Filter):
+            # factor conjuncts common to every OR branch first: the
+            # spec pattern "(corr = x AND a) OR (corr = x AND b)"
+            # hoists its correlation equality to the top level
+            # (TPC-DS q41's shape)
+            from trino_tpu.plan.optimizer import _factor_or_common
+
             kept: list[RowExpression] = []
-            for cj in _ir_conjuncts(n.predicate):
+            for cj in _ir_conjuncts(_factor_or_common(n.predicate)):
                 pair = _corr_eq_pair(cj, outer_syms)
                 if pair is not None:
                     corr.append(pair)
@@ -1618,7 +1678,7 @@ class ExprAnalyzer:
             return Call(T.VARCHAR, "concat_suffix", (left, right))
         if isinstance(left, Literal):
             return Call(T.VARCHAR, "concat_prefix", (right, left))
-        raise AnalysisError("varchar || varchar between two columns not supported yet")
+        return Call(T.VARCHAR, "concat_cols", (left, right))
 
     # predicates
     def _Between(self, e: ast.Between):

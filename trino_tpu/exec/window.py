@@ -353,6 +353,10 @@ def _eval_call(
         return s / jnp.maximum(cnt, 1), cnt > 0
     if name in ("min", "max"):
         is_min = name == "min"
+        if jnp.ndim(data) == 2:
+            return _range_minmax_limbs(
+                data, contrib, lo, hi, info, is_min, n
+            ), cnt > 0
         return _range_minmax(
             data, contrib, lo, hi, pos, pstart, info, is_min, n
         ), cnt > 0
@@ -477,6 +481,37 @@ def _range_minmax(data, contrib, lo, hi, pos, pstart, info, is_min, n):
     _, scan = jax.lax.associative_scan(op, (info.gid_sorted, masked))
     at = jnp.clip(hi - 1, 0, n - 1)
     return jnp.where(hi > lo, scan[at], fill)
+
+
+def _range_minmax_limbs(data, contrib, lo, hi, info, is_min, n):
+    """Running min/max over a two-limb decimal column: numeric order is
+    lexicographic (hi signed, lo canonical non-negative), so the
+    segmented scan carries both limbs and picks per comparison."""
+    i64 = jnp.iinfo(jnp.int64)
+    fill_hi = jnp.int64(i64.max if is_min else i64.min)
+    fill_lo = jnp.int64(0xFFFFFFFF if is_min else 0)
+    hi_l = jnp.where(contrib, data[:, 0], fill_hi)
+    lo_l = jnp.where(contrib, data[:, 1], fill_lo)
+
+    def op(a, b):
+        ga, ha, la = a
+        gb, hb, lb = b
+        if is_min:
+            a_better = (ha < hb) | ((ha == hb) & (la < lb))
+        else:
+            a_better = (ha > hb) | ((ha == hb) & (la > lb))
+        use_a = (ga == gb) & a_better
+        return gb, jnp.where(use_a, ha, hb), jnp.where(use_a, la, lb)
+
+    _, sh, sl = jax.lax.associative_scan(
+        op, (info.gid_sorted, hi_l, lo_l)
+    )
+    at = jnp.clip(hi - 1, 0, n - 1)
+    ok = hi > lo
+    return jnp.stack(
+        [jnp.where(ok, sh[at], fill_hi), jnp.where(ok, sl[at], fill_lo)],
+        axis=-1,
+    )
 
 
 def _fill_for(dtype, is_min):

@@ -257,6 +257,23 @@ class _Compiler:
                 return wrap(
                     lambda x: _limb_encode(x.astype(jnp.int64) * m)
                 )
+            if d_t.is_long and isinstance(s_t, (T.DoubleType, T.RealType)):
+                # double -> decimal(>18): scale, round half away from
+                # zero, split into limbs (float64 carries ~15-16
+                # significant digits; beyond that the reference's
+                # Int128 exactness is unattainable from a double too)
+                m = 10.0 ** d_t.scale
+
+                def ev_f2l(env, _m=m):
+                    x, v = src.fn(env)
+                    y = jnp.sign(x) * jnp.floor(jnp.abs(x) * _m + 0.5)
+                    hi = jnp.floor(y / 4294967296.0)
+                    lo = (y - hi * 4294967296.0).astype(jnp.int64)
+                    return jnp.stack(
+                        [hi.astype(jnp.int64), lo], axis=-1
+                    ), v
+
+                return CompiledExpr(ev_f2l, d_t, is_literal=src.is_literal)
             if d_t.is_long:
                 raise NotImplementedError(f"cast {s_t} -> {d_t}")
             if isinstance(s_t, T.DecimalType):
@@ -431,11 +448,50 @@ class _Compiler:
             return CompiledExpr(
                 lambda env: (lambda d, v: (-d, v))(*a.fn(env)), expr.type
             )
+        if name == "concat_cols":
+            return self._concat_cols(expr)
         if name == "round":
             return self._round(expr)
         if name in _SIMPLE_FNS:
             return self._simple(expr)
         raise NotImplementedError(f"function {name} not implemented")
+
+    def _concat_cols(self, expr: Call) -> CompiledExpr:
+        """varchar || varchar between two dictionary-backed columns:
+        the result dictionary is the (bounded) cross product of the
+        operand dictionaries; the device op is one gather by the
+        composite code a*|B| + b (the ConcatFunction analog under the
+        dictionary-encode-early design)."""
+        a = self.compile(expr.args[0])
+        b = self.compile(expr.args[1])
+        da, db = a.dictionary, b.dictionary
+        if da is None or db is None:
+            raise NotImplementedError(
+                "|| requires dictionary-backed varchar operands"
+            )
+        na, nb = max(len(da), 1), max(len(db), 1)
+        if na * nb > 4_000_000:
+            raise NotImplementedError(
+                f"|| dictionary product too large ({na}x{nb})"
+            )
+        pairs = np.asarray(
+            [str(x) + str(y) for x in da.values for y in db.values]
+            or [""],
+            dtype=object,
+        )
+        new_dict, codes = StringDictionary.from_strings(pairs)
+        remap = jnp.asarray(codes.astype(np.int32))
+
+        def ev(env):
+            ad, av = a.fn(env)
+            bd, bv = b.fn(env)
+            code = jnp.clip(
+                ad.astype(jnp.int32) * nb + bd.astype(jnp.int32),
+                0, na * nb - 1,
+            )
+            return jnp.take(remap, code, mode="clip"), _and_valid(av, bv)
+
+        return CompiledExpr(ev, T.VARCHAR, new_dict)
 
     def _round(self, expr: Call) -> CompiledExpr:
         """round(x[, n]): half away from zero (reference

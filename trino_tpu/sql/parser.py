@@ -456,7 +456,16 @@ class _Parser:
                     self.expect_op(")")
             return ast.UnnestRel(args, alias, cols)
         if self.accept_op("("):
-            if self.at_kw("select", "with") or self.at_op("("):
+            # lookahead through nested parens: SELECT/WITH starts a
+            # subquery; anything else is a parenthesized join tree
+            # ("((a JOIN b ON ...) JOIN c ON ...)")
+            k = 0
+            while self.peek(k).kind == "OP" and self.peek(k).text == "(":
+                k += 1
+            starts_query = self.peek(k).kind == "KEYWORD" and self.peek(
+                k
+            ).text in ("select", "with")
+            if starts_query:
                 q = self.query()
                 self.expect_op(")")
                 alias = self._relation_alias()

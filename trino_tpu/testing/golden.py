@@ -181,6 +181,10 @@ def _strip_compound_member_parens(sql: str) -> str:
                 continue
             before = sql[:o].rstrip()
             after = sql[c + 1:].lstrip()
+            if re.search(r"(\bfrom|\bjoin|,)\s*$", before, re.I):
+                # a derived table: its parens stay even when the
+                # ENCLOSING query continues with a set operator
+                continue
             if re.search(
                 r"(union(\s+all)?|intersect|except)\s*$", before, re.I
             ) or re.match(r"(union|intersect|except)\b", after, re.I):
@@ -214,6 +218,13 @@ def to_sqlite(sql: str) -> str:
         r"\1", out, flags=re.I,
     )
     out = re.sub(r"\bdate\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", out, flags=re.I)
+    # CAST(col AS DECIMAL(p,s)) keeps INTEGER affinity in sqlite, so
+    # a following division would truncate; force float arithmetic
+    out = re.sub(
+        r"CAST\s*\(\s*([A-Za-z_][A-Za-z0-9_.]*)\s+AS\s+"
+        r"DECIMAL\s*\(\s*\d+\s*,\s*\d+\s*\)\s*\)",
+        r"(\1 * 1.0)", out, flags=re.I,
+    )
 
     def fold(m):
         d = datetime.date.fromisoformat(m.group(1))
