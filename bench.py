@@ -23,9 +23,21 @@ Set BENCH_BASELINE=skip to emit vs_baseline=0 quickly.
 import json
 import math
 import os
+import statistics
 import time
 
 QUERY_IDS = ("q01", "q03", "q18")
+
+
+def timed_runs(fn, reps: int):
+    """median + spread over `reps` timed runs (VERDICT r4 weak #1:
+    best-of-N overstates; medians with min/max are reported)."""
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), min(times), max(times)
 
 #: north-star microbench (BASELINE.md): rows/sec/chip through a
 #: hash-join + aggregation pipeline (the analog of the reference's
@@ -40,7 +52,7 @@ JOIN_AGG_SQL = (
 
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "1"))
-    reps = int(os.environ.get("BENCH_REPS", "3"))
+    reps = int(os.environ.get("BENCH_REPS", "5"))
     schema = f"sf{sf:g}" if sf != 0.01 else "tiny"
 
     from trino_tpu.connectors.tpch.queries import QUERIES
@@ -51,29 +63,23 @@ def main() -> None:
     n_rows = conn.row_count(schema, "lineitem")
 
     ours = {}
+    spread = {}
     rowcounts = {}
     for q in QUERY_IDS:
         sql = QUERIES[q]
         result = runner.execute(sql)  # warmup: compile + cache
         rowcounts[q] = len(result.rows)
-        best = math.inf
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            result = runner.execute(sql)
-            best = min(best, time.perf_counter() - t0)
-        ours[q] = best
+        ours[q], lo, hi = timed_runs(lambda: runner.execute(sql), reps)
+        spread[q] = (lo, hi)
     assert rowcounts["q01"] == 4, f"Q1 must yield 4 groups, got {rowcounts['q01']}"
 
     # north-star: rows/sec/chip through hash-join + aggregation
     runner.execute(JOIN_AGG_SQL)  # warmup
-    ja_best = math.inf
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        runner.execute(JOIN_AGG_SQL)
-        ja_best = min(ja_best, time.perf_counter() - t0)
+    ja_med, _, _ = timed_runs(lambda: runner.execute(JOIN_AGG_SQL), reps)
     probe_build_rows = n_rows + conn.row_count(schema, "orders")
 
     base = {}
+    np_base = {}
     if os.environ.get("BENCH_BASELINE") != "skip":
         from trino_tpu.testing.golden import load_tpch_sqlite, to_sqlite
 
@@ -81,9 +87,19 @@ def main() -> None:
         for q in QUERY_IDS:
             sql = to_sqlite(QUERIES[q])
             oracle.execute(sql).fetchall()  # warm page cache
-            t1 = time.perf_counter()
-            oracle.execute(sql).fetchall()
-            base[q] = time.perf_counter() - t1
+            base[q], _, _ = timed_runs(
+                lambda: oracle.execute(sql).fetchall(), max(reps - 2, 3)
+            )
+        # second baseline: hand-vectorized numpy columnar path over the
+        # same storage arrays (sort/searchsorted/reduceat — what a
+        # columnar CPU engine runs); stronger than sqlite's row loop
+        from trino_tpu.testing import numpy_baseline as nb
+
+        data = conn.data(schema)
+        for q, fn in (("q01", nb.q01), ("q03", nb.q03), ("q18", nb.q18)):
+            fn(data)  # warm (page-ins)
+            times = [fn(data)[0] for _ in range(max(reps - 2, 3))]
+            np_base[q] = statistics.median(times)
 
     speedups = {q: base[q] / ours[q] for q in base}
     vs = (
@@ -91,10 +107,40 @@ def main() -> None:
         if speedups else 0.0
     )
     detail = {f"{q}_ms": round(ours[q] * 1e3, 1) for q in QUERY_IDS}
-    detail["join_agg_rows_per_sec_chip"] = round(probe_build_rows / ja_best, 1)
-    detail["join_agg_ms"] = round(ja_best * 1e3, 1)
+    detail.update({
+        f"{q}_ms_spread": [round(s * 1e3, 1) for s in spread[q]]
+        for q in QUERY_IDS
+    })
+    detail["join_agg_rows_per_sec_chip"] = round(probe_build_rows / ja_med, 1)
+    detail["join_agg_ms"] = round(ja_med * 1e3, 1)
     detail.update({f"{q}_sqlite_ms": round(base[q] * 1e3, 1) for q in base})
     detail.update({f"{q}_speedup": round(s, 2) for q, s in speedups.items()})
+    detail.update({
+        f"{q}_numpy_ms": round(t * 1e3, 1) for q, t in np_base.items()
+    })
+    detail.update({
+        f"{q}_vs_numpy": round(np_base[q] / ours[q], 2) for q in np_base
+    })
+    if np_base:
+        detail["vs_numpy_geomean"] = round(
+            math.prod(np_base[q] / ours[q] for q in np_base)
+            ** (1 / len(np_base)), 3,
+        )
+
+    if os.environ.get("BENCH_TPCDS", "1") != "0" and sf == 1:
+        # BASELINE config #4: deep join trees (q72) and self-join CTE +
+        # IN-subqueries (q95) at TPC-DS SF1. NOTE (VERDICT r4 weak #9):
+        # the generator is spec-shaped but not dsdgen-bit-identical, so
+        # these wall-clocks are internal trend numbers, not comparable
+        # to reference-engine published TPC-DS results.
+        from trino_tpu.connectors.tpcds.queries import QUERIES as DSQ
+
+        ds = QueryRunner.tpcds("sf1")
+        for q in ("q72", "q95"):
+            sql = DSQ[q]
+            ds.execute(sql)  # warmup
+            med, _, _ = timed_runs(lambda: ds.execute(sql), max(reps - 2, 3))
+            detail[f"tpcds_sf1_{q}_ms"] = round(med * 1e3, 1)
 
     if os.environ.get("BENCH_SF10", "1") != "0" and sf == 1:
         # BASELINE config #3 direction: bigger-than-HBM execution. Q1

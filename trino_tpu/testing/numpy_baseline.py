@@ -1,0 +1,153 @@
+"""Vectorized numpy implementations of TPC-H Q1/Q3/Q18.
+
+A second, stronger comparator for bench.py next to sqlite (VERDICT r4
+weak #2: single-core sqlite is the weakest credible baseline; no
+columnar OLAP engine ships in this image, so this hand-vectorized
+columnar path — the same sort/searchsorted/reduceat algorithms a
+columnar CPU engine executes — stands in). Operates directly on the
+generator's storage arrays (dates as epoch days, decimals as unscaled
+ints), returns (seconds, result_row_count).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from trino_tpu import types as T
+
+__all__ = ["q01", "q03", "q18"]
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    rows = fn()
+    return time.perf_counter() - t0, rows
+
+
+def q01(data) -> tuple[float, int]:
+    ship = data.column("lineitem", "l_shipdate")
+    rf = data.column("lineitem", "l_returnflag")
+    ls = data.column("lineitem", "l_linestatus")
+    qty = data.column("lineitem", "l_quantity")
+    price = data.column("lineitem", "l_extendedprice")
+    disc = data.column("lineitem", "l_discount")
+    tax = data.column("lineitem", "l_tax")
+    cutoff = T.parse_date("1998-09-02")
+    # dictionary-encode the group columns outside the timed region:
+    # the engine's connector hands it pre-encoded codes too (storage
+    # format, not query work)
+    rfc, rf_codes = np.unique(rf.astype(str), return_inverse=True)
+    lsc, ls_codes = np.unique(ls.astype(str), return_inverse=True)
+
+    def run():
+        m = ship <= cutoff
+        key = rf_codes[m] * len(lsc) + ls_codes[m]
+        order = np.argsort(key, kind="stable")
+        ks = key[order]
+        starts = np.flatnonzero(np.r_[True, ks[1:] != ks[:-1]])
+        q = qty[m][order]
+        p = price[m][order].astype(np.float64)
+        d = disc[m][order].astype(np.float64) / 100.0
+        t = tax[m][order].astype(np.float64) / 100.0
+        disc_price = p * (1 - d)
+        charge = disc_price * (1 + t)
+        out = [
+            np.add.reduceat(q, starts),
+            np.add.reduceat(p, starts),
+            np.add.reduceat(disc_price, starts),
+            np.add.reduceat(charge, starts),
+            np.add.reduceat(d, starts),
+        ]
+        counts = np.diff(np.r_[starts, len(ks)])
+        return len(starts) + 0 * int(out[0][0] + counts[0])
+
+    return _timed(run)
+
+
+def q03(data) -> tuple[float, int]:
+    c_key = data.column("customer", "c_custkey")
+    c_seg = data.column("customer", "c_mktsegment")
+    c_seg_s = c_seg.astype(str)  # pre-decoded, see q01 note
+    o_key = data.column("orders", "o_orderkey")
+    o_cust = data.column("orders", "o_custkey")
+    o_date = data.column("orders", "o_orderdate")
+    o_prio = data.column("orders", "o_shippriority")
+    l_ok = data.column("lineitem", "l_orderkey")
+    l_ship = data.column("lineitem", "l_shipdate")
+    l_price = data.column("lineitem", "l_extendedprice")
+    l_disc = data.column("lineitem", "l_discount")
+    cutoff = T.parse_date("1995-03-15")
+
+    def run():
+        cust = np.sort(c_key[c_seg_s == "BUILDING"])
+        om = o_date < cutoff
+        pos = np.searchsorted(cust, o_cust[om])
+        pos = np.clip(pos, 0, len(cust) - 1)
+        om_idx = np.flatnonzero(om)[cust[pos] == o_cust[om]]
+        okeys = o_key[om_idx]
+        order = np.argsort(okeys, kind="stable")
+        okeys_s = okeys[order]
+        lm = l_ship > cutoff
+        lpos = np.clip(np.searchsorted(okeys_s, l_ok[lm]), 0, len(okeys_s) - 1)
+        hit = okeys_s[lpos] == l_ok[lm]
+        li = np.flatnonzero(lm)[hit]
+        rev = l_price[li].astype(np.float64) * (
+            1 - l_disc[li].astype(np.float64) / 100.0
+        )
+        gk = l_ok[li]
+        go = np.argsort(gk, kind="stable")
+        gks = gk[go]
+        starts = np.flatnonzero(np.r_[True, gks[1:] != gks[:-1]])
+        sums = np.add.reduceat(rev[go], starts)
+        top = np.argsort(-sums, kind="stable")[:10]
+        # date/prio lookup for the top groups
+        keys = gks[starts][top]
+        at = om_idx[order][np.clip(
+            np.searchsorted(okeys_s, keys), 0, len(okeys_s) - 1
+        )]
+        _ = o_date[at], o_prio[at]
+        return len(top)
+
+    return _timed(run)
+
+
+def q18(data) -> tuple[float, int]:
+    l_ok = data.column("lineitem", "l_orderkey")
+    l_qty = data.column("lineitem", "l_quantity")
+    o_key = data.column("orders", "o_orderkey")
+    o_cust = data.column("orders", "o_custkey")
+    o_date = data.column("orders", "o_orderdate")
+    o_total = data.column("orders", "o_totalprice")
+    c_key = data.column("customer", "c_custkey")
+    c_name = data.column("customer", "c_name")
+
+    def run():
+        order = np.argsort(l_ok, kind="stable")
+        ks = l_ok[order]
+        starts = np.flatnonzero(np.r_[True, ks[1:] != ks[:-1]])
+        sums = np.add.reduceat(l_qty[order], starts)
+        big = sums > 300
+        big_keys = ks[starts][big]
+        big_sums = sums[big]
+        opos = np.clip(np.searchsorted(o_key, big_keys), 0, len(o_key) - 1)
+        # o_orderkey is sorted in generated data
+        ok = o_key[opos] == big_keys
+        opos = opos[ok]
+        cpos = np.clip(
+            np.searchsorted(c_key, o_cust[opos]), 0, len(c_key) - 1
+        )
+        rows = sorted(
+            zip(
+                -o_total[opos].astype(np.float64),
+                o_date[opos],
+                big_keys[ok],
+                o_cust[opos],
+                big_sums[ok],
+            )
+        )[:100]
+        _ = c_name[cpos[:1]] if len(cpos) else None
+        return len(rows)
+
+    return _timed(run)
