@@ -1762,6 +1762,21 @@ class LocalExecutor:
                 (p.column(s).data, p.column(s).valid)
                 for p, s in zip(pages, src_syms)
             ]
+            out_t = node.outputs[sym]
+            if isinstance(out_t, T.DecimalType) and out_t.is_long:
+                # a branch may carry the column 1-D (typed NULL
+                # literals / short-encoded values); widen to limbs so
+                # sections concatenate shape-consistently
+                from trino_tpu.exec.aggregates import _limb_encode
+
+                parts = [
+                    (
+                        d if jnp.ndim(d) == 2
+                        else _limb_encode(d.astype(jnp.int64)),
+                        v,
+                    )
+                    for d, v in parts
+                ]
             data, valid = _concat_sections(parts)
             ref = pages[0].column(src_syms[0])
             names.append(sym)

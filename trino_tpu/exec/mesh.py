@@ -1224,8 +1224,13 @@ class MeshExecutor(LocalExecutor):
                             col_sections[s].append((d, v))
                         else:
                             d0, _ = b_env[s]
+                            # match trailing dims (two-limb decimals
+                            # are [n, 2])
                             col_sections[s].append((
-                                jnp.zeros((p_cap,), dtype=d0.dtype),
+                                jnp.zeros(
+                                    (p_cap,) + d0.shape[1:],
+                                    dtype=d0.dtype,
+                                ),
                                 jnp.zeros((p_cap,), dtype=jnp.bool_),
                             ))
                     mask_sections.append(unmatched)
@@ -1236,7 +1241,10 @@ class MeshExecutor(LocalExecutor):
                         if from_probe:
                             d0, _ = p_env[s]
                             col_sections[s].append((
-                                jnp.zeros((b_cap,), dtype=d0.dtype),
+                                jnp.zeros(
+                                    (b_cap,) + d0.shape[1:],
+                                    dtype=d0.dtype,
+                                ),
                                 jnp.zeros((b_cap,), dtype=jnp.bool_),
                             ))
                         else:
