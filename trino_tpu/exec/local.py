@@ -696,14 +696,47 @@ class LocalExecutor:
         return self.execute(node.source)
 
     def _Values(self, node: P.Values) -> Page:
-        # only the zero-column single-row form (SELECT without FROM)
-        if node.outputs:
-            raise NotImplementedError("general VALUES is not supported yet")
-        mask = np.zeros(8, dtype=np.bool_)
-        mask[: len(node.rows)] = True
+        from trino_tpu.exec.stage import pad_capacity
+        from trino_tpu.page import StringDictionary
+
+        n = len(node.rows)
+        cap = pad_capacity(max(n, 8))
+        mask = np.zeros(cap, dtype=np.bool_)
+        mask[:n] = True
+        names, cols = [], []
+        for i, (sym, t) in enumerate(node.outputs.items()):
+            vals = [r[i] for r in node.rows]
+            nulls = np.asarray([v is None for v in vals], dtype=np.bool_)
+            filled = [0 if v is None else v for v in vals]
+            dictionary = None
+            if isinstance(t, T.VarcharType):
+                dictionary, codes = StringDictionary.from_strings(
+                    np.asarray(
+                        ["" if nulls[j] else str(v)
+                         for j, v in enumerate(filled)] or [""],
+                        dtype=object,
+                    )
+                )
+                data = np.zeros(cap, dtype=np.int32)
+                data[:n] = codes[:n]
+            elif isinstance(t, T.DecimalType) and t.is_long:
+                data = np.zeros((cap, 2), dtype=np.int64)
+                iv = np.asarray(filled, dtype=np.int64)
+                data[:n, 0] = iv >> 32
+                data[:n, 1] = iv & 0xFFFFFFFF
+            else:
+                data = np.zeros(cap, dtype=t.np_dtype)
+                data[:n] = np.asarray(filled, dtype=t.np_dtype)
+            valid = None
+            if nulls.any():
+                v = np.ones(cap, dtype=np.bool_)
+                v[:n] = ~nulls
+                valid = jnp.asarray(v)
+            names.append(sym)
+            cols.append(Column(t, jnp.asarray(data), valid, dictionary))
         return Page(
-            [], [], jnp.asarray(mask),
-            known_rows=len(node.rows), packed=True,
+            names, cols, jnp.asarray(mask),
+            known_rows=n, packed=True,
         )
 
     # ---- row-level nodes -------------------------------------------------

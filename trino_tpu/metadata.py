@@ -23,6 +23,8 @@ class Session:
     schema: str | None = None
     properties: dict = field(default_factory=dict)
     user: str = "user"
+    #: PREPARE name -> statement AST (PARSER/tree/Prepare.java:25)
+    prepared: dict = field(default_factory=dict)
 
 
 @dataclass(frozen=True)

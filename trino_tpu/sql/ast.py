@@ -355,6 +355,41 @@ class SetOp(Node):
 # ---- other statements ----------------------------------------------------
 
 @dataclass
+class ValuesQuery(Node):
+    """VALUES (r1c1, r1c2), (r2c1, r2c2) as a query body
+    (PARSER/tree/Values.java:25)."""
+
+    rows: list[list[Expr]] = field(default_factory=list)
+
+
+@dataclass
+class Parameter(Expr):
+    """Positional ? placeholder bound at EXECUTE (reference:
+    PARSER/tree/Parameter.java)."""
+
+    index: int = 0
+
+
+@dataclass
+class Prepare(Statement):
+    """PREPARE name FROM statement (PARSER/tree/Prepare.java:25)."""
+
+    name: str = ""
+    statement: Statement = None  # type: ignore[assignment]
+
+
+@dataclass
+class ExecutePrepared(Statement):
+    name: str = ""
+    args: list[Expr] = field(default_factory=list)
+
+
+@dataclass
+class Deallocate(Statement):
+    name: str = ""
+
+
+@dataclass
 class Explain(Statement):
     statement: Statement
     analyze: bool = False

@@ -28,6 +28,7 @@ class _Parser:
     def __init__(self, tokens: list[Token]):
         self.tokens = tokens
         self.i = 0
+        self._param_count = 0  # positional ? placeholders seen
 
     # ---- token helpers ---------------------------------------------------
     def peek(self, ahead: int = 0) -> Token:
@@ -86,6 +87,24 @@ class _Parser:
 
     # ---- statements ------------------------------------------------------
     def statement(self) -> ast.Statement:
+        if self._at_ident("prepare"):
+            self.next()
+            name = self.ident()
+            self.expect_kw("from")
+            return ast.Prepare(name, self.statement())
+        if self._at_ident("execute") and self.peek(1).kind == "IDENT":
+            self.next()
+            name = self.ident()
+            args: list[ast.Expr] = []
+            if self.accept_kw("using"):
+                args.append(self.expr())
+                while self.accept_op(","):
+                    args.append(self.expr())
+            return ast.ExecutePrepared(name, args)
+        if self._at_ident("deallocate"):
+            self.next()
+            self._at_ident("prepare") and self.next()
+            return ast.Deallocate(self.ident())
         if self.accept_kw("explain"):
             analyze = self.accept_kw("analyze")
             return ast.Explain(self.statement(), analyze=analyze)
@@ -302,9 +321,21 @@ class _Parser:
             q = self.query_body()
             self.expect_op(")")
             return q
-        if self.at_kw("values"):
-            raise SqlSyntaxError("VALUES is not supported yet")
+        if self.accept_kw("values"):
+            rows = [self._values_row()]
+            while self.accept_op(","):
+                rows.append(self._values_row())
+            return ast.ValuesQuery(rows)
         return self.select()
+
+    def _values_row(self) -> list[ast.Expr]:
+        if self.accept_op("("):
+            row = [self.expr()]
+            while self.accept_op(","):
+                row.append(self.expr())
+            self.expect_op(")")
+            return row
+        return [self.expr()]
 
     def select(self) -> ast.Select:
         self.expect_kw("select")
@@ -464,7 +495,7 @@ class _Parser:
                 k += 1
             starts_query = self.peek(k).kind == "KEYWORD" and self.peek(
                 k
-            ).text in ("select", "with")
+            ).text in ("select", "with", "values")
             if starts_query:
                 q = self.query()
                 self.expect_op(")")
@@ -601,6 +632,10 @@ class _Parser:
 
     def primary(self) -> ast.Expr:
         t = self.peek()
+        if t.kind == "OP" and t.text == "?":
+            self.next()
+            self._param_count += 1
+            return ast.Parameter(self._param_count - 1)
         if t.kind == "NUMBER":
             self.next()
             return _number(t.text)
