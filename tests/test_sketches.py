@@ -198,3 +198,23 @@ def test_approx_distinct_nulls_and_filter():
         "select approx_distinct(v) from t where g = 99"
     ).rows[0]
     assert z == 0
+
+
+def test_approx_percentile_wide_decimal(local, dist):
+    """decimal(38) values: exact limb-ordered rank locally, float64
+    summary through the distributed/budgeted combine."""
+    sql = (
+        "select approx_percentile(s, 0.5) from "
+        "(select o_custkey, sum(o_totalprice) s from orders "
+        "group by o_custkey)"
+    )
+    lo = local.execute(sql).rows[0][0]
+    dd = dist.execute(sql).rows[0][0]
+    assert abs(float(lo) - float(dd)) <= 0.05 * float(lo)
+
+    from trino_tpu.engine import QueryRunner
+
+    rb = QueryRunner.tpch("tiny")
+    rb.session.properties["hbm_budget_bytes"] = 1 << 20
+    bu = rb.execute(sql).rows[0][0]
+    assert abs(float(lo) - float(bu)) <= 0.05 * float(lo)

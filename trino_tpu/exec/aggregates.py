@@ -233,8 +233,12 @@ def compute_aggregate(
     if name == "approx_percentile_partial":
         (vd, vv), _q = arg
         if jnp.ndim(vd) == 2:
-            raise NotImplementedError(
-                "approx_percentile over decimal(38) values"
+            # two-limb decimal values flatten to float64 for the
+            # summary (the sketch is approximate by contract; float64
+            # carries ~15-16 significant digits)
+            vd = (
+                vd[:, 0].astype(jnp.float64) * 4294967296.0
+                + vd[:, 1].astype(jnp.float64)
             )
         eff = contrib if vv is None else (contrib & vv)
         k = out_type.lanes - 1
@@ -254,15 +258,18 @@ def compute_aggregate(
         # class): rows re-sort (group, contributing-first, value) and
         # each group reads index round(q * (cnt-1)) of its run.
         (vd, vv), (qd, _qv) = arg
-        if jnp.ndim(vd) == 2:
-            raise NotImplementedError(
-                "approx_percentile over decimal(38) values"
-            )
         eff = contrib if vv is None else (contrib & vv)
         q = qd.reshape(-1)[0].astype(jnp.float64)
-        vbits = K.order_bits(vd)
         n = vd.shape[0]
-        p = jnp.argsort(vbits, stable=True).astype(jnp.int32)
+        if jnp.ndim(vd) == 2:
+            # two-limb decimal: numeric order is lexicographic
+            # (hi signed, lo canonical) — stable two-pass sort; the
+            # rank gather below then returns the exact limb row
+            p = jnp.argsort(K.order_bits(vd[:, 1]), stable=True)
+            p = p[jnp.argsort(K.order_bits(vd[p, 0]), stable=True)]
+            p = p.astype(jnp.int32)
+        else:
+            p = jnp.argsort(K.order_bits(vd), stable=True).astype(jnp.int32)
         p = p[jnp.argsort((~eff)[p], stable=True)]
         er = _Reducer(info, capacity, eff, share)
         cnt2 = er.count()
@@ -591,6 +598,8 @@ def _quant_merge(states, q, contrib, valid, info, capacity, out_type):
     has = total > 0
     if isinstance(out_type, (T.DoubleType, T.RealType)):
         return out.astype(out_type.np_dtype.type), has
+    if isinstance(out_type, T.DecimalType) and out_type.is_long:
+        return _limb_encode(jnp.round(out).astype(jnp.int64)), has
     return jnp.round(out).astype(jnp.int64).astype(out_type.np_dtype.type), has
 
 
