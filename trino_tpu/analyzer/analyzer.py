@@ -1235,7 +1235,11 @@ class Analyzer:
                         agg_result_type(name, args[0].type),
                     )
                 else:
-                    rt = agg_result_type(name, args[0].type if args else None)
+                    rt = agg_result_type(
+                        name,
+                        args[0].type if args else None,
+                        args[1].type if len(args) > 1 else None,
+                    )
                     call = AggCall(name, args, rt, distinct=fc.distinct)
             sym = self.symbols.new(name, call.type)
             aggs[sym] = call
@@ -1635,7 +1639,27 @@ class ExprAnalyzer:
         return Literal(T.TIMESTAMP, e.text)
 
     def _Ident(self, e: ast.Ident):
-        f, outer = self.scope.resolve(e.parts)
+        try:
+            f, outer = self.scope.resolve(e.parts)
+        except AnalysisError:
+            # row-field dereference: a.b where a resolves to a
+            # ROW-typed column with named field b (the reference's
+            # DereferenceExpression resolution order,
+            # MAIN/sql/analyzer/ExpressionAnalyzer)
+            if len(e.parts) < 2:
+                raise
+            base = self._Ident(ast.Ident(e.parts[:-1]))
+            if not isinstance(base.type, T.RowType):
+                raise
+            fi = base.type.field_index(e.parts[-1])
+            if fi is None:
+                raise AnalysisError(
+                    f"row type {base.type} has no field {e.parts[-1]!r}"
+                )
+            return Call(
+                base.type.fields[fi][1], "row_field",
+                (base, Literal(T.INTEGER, fi)),
+            )
         if outer:
             self.outer_refs.add(f.symbol)
         elif self.restrict_to is not None and f.symbol not in self.restrict_to:
@@ -1988,11 +2012,70 @@ class ExprAnalyzer:
             for a in e.args[1:]:
                 out = self._concat(ast.Binary("||", AnalyzedExpr(out), a))
             return out
+        if name == "map":
+            return self._map_constructor(e)
+        if name == "row":
+            return self._row_constructor(e)
         if name not in SCALAR_FNS:
             raise AnalysisError(f"unknown function {name}")
         ir_name, rt_fn = SCALAR_FNS[name]
         args = tuple(self.analyze(a) for a in e.args)
         return Call(rt_fn([a.type for a in args]), ir_name, args)
+
+    def _map_constructor(self, e: "ast.FnCall"):
+        """MAP(ARRAY[keys], ARRAY[values]) of constants -> a typed map
+        Literal whose value is a tuple of (key, value) pairs in STORAGE
+        form (the MapConstructor analog, constants-only like ARRAY[])."""
+        if len(e.args) == 0:
+            return Literal(T.MapType(T.UNKNOWN, T.UNKNOWN), ())
+        if len(e.args) != 2:
+            raise AnalysisError("map() takes (ARRAY, ARRAY)")
+        k = self.analyze(e.args[0])
+        v = self.analyze(e.args[1])
+        if not (isinstance(k, Literal) and isinstance(k.type, T.ArrayType)
+                and isinstance(v, Literal)
+                and isinstance(v.type, T.ArrayType)):
+            raise AnalysisError(
+                "map() arguments must be constant arrays in this context"
+            )
+        if len(k.value) != len(v.value):
+            raise AnalysisError("map() key and value arrays differ in length")
+        if len(set(k.value)) != len(k.value):
+            # reference: MapConstructor raises on duplicate keys
+            raise AnalysisError("Duplicate map keys are not allowed")
+        return Literal(
+            T.MapType(k.type.element, v.type.element),
+            tuple(zip(k.value, v.value)),
+        )
+
+    def _row_constructor(self, e: "ast.FnCall"):
+        """ROW(e1, ...) of constants -> a typed row Literal (tuple in
+        STORAGE form; anonymous fields)."""
+        from trino_tpu.expr.compiler import _literal_device_value
+
+        irs = [self.analyze(a) for a in e.args]
+        vals = []
+        for ir in irs:
+            base = ir.arg if isinstance(ir, Cast) else ir
+            if not isinstance(base, Literal):
+                raise AnalysisError(
+                    "ROW fields must be constants in this context"
+                )
+            if base.value is None:
+                vals.append(None)
+            elif base.type == ir.type:
+                vals.append(_literal_device_value(base))
+            else:
+                # apply the cast before storage conversion (a Cast
+                # wrapper changes the storage form: dates parse,
+                # decimals rescale)
+                vals.append(
+                    _literal_device_value(Literal(ir.type, base.value))
+                )
+        return Literal(
+            T.RowType(tuple((None, ir.type) for ir in irs)),
+            tuple(vals),
+        )
 
     def _ArrayLit(self, e: "ast.ArrayLit"):
         """ARRAY[...] of constants -> a typed array Literal whose value
@@ -2029,9 +2112,28 @@ class ExprAnalyzer:
     def _Subscript(self, e: "ast.Subscript"):
         base = self.analyze(e.base)
         idx = self.analyze(e.index)
+        if isinstance(base.type, T.MapType):
+            # map[key] (MapSubscriptOperator; like element_at, absent
+            # keys yield NULL rather than raising — the device LUT has
+            # no raise path)
+            return Call(base.type.value, "subscript", (base, idx))
+        if isinstance(base.type, T.RowType):
+            # row[ordinal], 1-based (SubscriptExpression over RowType)
+            if not isinstance(idx, Literal) or idx.value is None:
+                raise AnalysisError("ROW subscript must be a constant")
+            k = int(idx.value)
+            if not (1 <= k <= len(base.type.fields)):
+                raise AnalysisError(
+                    f"ROW subscript {k} out of range "
+                    f"(1..{len(base.type.fields)})"
+                )
+            return Call(
+                base.type.fields[k - 1][1], "row_field",
+                (base, Literal(T.INTEGER, k - 1)),
+            )
         if not isinstance(base.type, T.ArrayType):
             raise AnalysisError(
-                f"cannot subscript {base.type} (ARRAY expected)"
+                f"cannot subscript {base.type} (ARRAY/MAP/ROW expected)"
             )
         return Call(base.type.element, "subscript", (base, idx))
 

@@ -123,10 +123,18 @@ def arith_result_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
     raise AnalysisError(f"unknown arithmetic {op}")
 
 
-def agg_result_type(name: str, arg_type: T.DataType | None) -> T.DataType:
+def agg_result_type(
+    name: str,
+    arg_type: T.DataType | None,
+    arg2_type: T.DataType | None = None,
+) -> T.DataType:
     """Aggregate result types (reference: MAIN/operator/aggregation)."""
     if name in ("count", "count_all"):
         return T.BIGINT
+    if name == "map_agg":
+        if arg_type is None or arg2_type is None:
+            raise AnalysisError("map_agg takes (key, value) arguments")
+        return T.MapType(arg_type, arg2_type)
     if arg_type is None:
         raise AnalysisError(f"aggregate {name} needs an argument")
     if name == "sum":
@@ -165,14 +173,29 @@ AGG_FNS = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "count_if", "approx_distinct",
     "approx_percentile",
-    "max_by", "min_by", "array_agg",
+    "max_by", "min_by", "array_agg", "map_agg",
 }
 
 #: scalar fn name -> (ir_name, result_type fn(arg_types))
 def _array_elem(ts):
+    if ts and isinstance(ts[0], T.MapType):
+        # element_at(map, key) -> value type (ElementAt over MapBlock)
+        return ts[0].value
     if not ts or not isinstance(ts[0], T.ArrayType):
-        raise AnalysisError("argument must be an ARRAY")
+        raise AnalysisError("argument must be an ARRAY or MAP")
     return ts[0].element
+
+
+def _map_keys_type(ts):
+    if not ts or not isinstance(ts[0], T.MapType):
+        raise AnalysisError("argument must be a MAP")
+    return T.ArrayType(ts[0].key)
+
+
+def _map_values_type(ts):
+    if not ts or not isinstance(ts[0], T.MapType):
+        raise AnalysisError("argument must be a MAP")
+    return T.ArrayType(ts[0].value)
 
 
 SCALAR_FNS = {
@@ -182,6 +205,10 @@ SCALAR_FNS = {
     "cardinality": ("cardinality", lambda ts: T.BIGINT),
     "contains": ("contains", lambda ts: T.BOOLEAN),
     "element_at": ("subscript", lambda ts: _array_elem(ts)),
+    # maps (reference: MAIN/operator/scalar/MapKeys/MapValues/
+    # MapCardinalityFunction/MapSubscriptOperator)
+    "map_keys": ("map_keys", _map_keys_type),
+    "map_values": ("map_values", _map_values_type),
     "sqrt": ("sqrt", lambda ts: T.DOUBLE),
     "floor": ("floor", lambda ts: ts[0]),
     "ceil": ("ceil", lambda ts: ts[0]),

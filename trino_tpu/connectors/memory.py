@@ -42,8 +42,10 @@ class _Table:
 
 
 def _storage_dtype(t: T.DataType):
-    if isinstance(t, (T.VarcharType, T.ArrayType)):
-        return object  # arrays store python lists (None = NULL)
+    if isinstance(t, (T.VarcharType, T.ArrayType, T.MapType, T.RowType)):
+        # variable-width values store python objects (lists / pair
+        # lists / tuples; None = NULL)
+        return object
     return t.np_dtype
 
 
@@ -113,7 +115,15 @@ class MemoryConnector(Connector):
                 valid = None
                 if isinstance(vals, tuple):
                     vals, valid = vals
-                vals = np.asarray(vals, dtype=t.columns[c].dtype)
+                if t.columns[c].dtype == object:
+                    # element-wise fill: np.asarray would collapse
+                    # same-length nested lists into a 2-D array
+                    arr = np.empty(len(vals), dtype=object)
+                    for i, v in enumerate(vals):
+                        arr[i] = v
+                    vals = arr
+                else:
+                    vals = np.asarray(vals, dtype=t.columns[c].dtype)
                 n_new = len(vals) if n_new is None else n_new
                 t.columns[c] = np.concatenate([t.columns[c], vals])
                 old_valid = t.valid[c]

@@ -562,7 +562,8 @@ def _fmt_bytes(n: int) -> str:
 def _has_arrays(plan: P.PlanNode) -> bool:
     from trino_tpu import types as T
 
-    if any(isinstance(t, T.ArrayType) for t in plan.outputs.values()):
+    pooled = (T.ArrayType, T.MapType, T.RowType)
+    if any(isinstance(t, pooled) for t in plan.outputs.values()):
         return True
     return any(_has_arrays(s) for s in plan.sources)
 
@@ -611,6 +612,23 @@ def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:
                 vals[j] = None if v is None else [
                     _elem_storage(x, t.element) for x in v
                 ]
+        elif isinstance(t, T.MapType):
+            vals = np.empty(len(raw), dtype=object)
+            for j, v in enumerate(raw):
+                vals[j] = None if v is None else [
+                    (_elem_storage(k, t.key),
+                     None if x is None else _elem_storage(x, t.value))
+                    for k, x in (
+                        v.items() if isinstance(v, dict) else v
+                    )
+                ]
+        elif isinstance(t, T.RowType):
+            vals = np.empty(len(raw), dtype=object)
+            for j, v in enumerate(raw):
+                vals[j] = None if v is None else tuple(
+                    None if x is None else _elem_storage(x, ft)
+                    for x, (_fn, ft) in zip(v, t.fields)
+                )
         elif isinstance(t, T.VarcharType):
             vals = np.array(
                 ["" if v is None else str(v) for v in raw], dtype=object
@@ -711,6 +729,32 @@ def _literal_value(e: ast.Expr, t):
 
         elem = t.element if isinstance(t, T.ArrayType) else None
         return [_literal_value(x, elem) for x in e.items]
+    if isinstance(e, ast.FnCall) and e.name.lower() == "map":
+        from trino_tpu import types as T
+
+        if not (
+            isinstance(t, T.MapType)
+            and len(e.args) == 2
+            and all(isinstance(a, ast.ArrayLit) for a in e.args)
+        ):
+            raise NotImplementedError(
+                "INSERT map() takes (ARRAY[...], ARRAY[...])"
+            )
+        ks = [_literal_value(x, t.key) for x in e.args[0].items]
+        vs = [_literal_value(x, t.value) for x in e.args[1].items]
+        if len(ks) != len(vs):
+            raise ValueError("map() key/value arrays differ in length")
+        return list(zip(ks, vs))
+    if isinstance(e, ast.FnCall) and e.name.lower() == "row":
+        from trino_tpu import types as T
+
+        if not isinstance(t, T.RowType) or len(e.args) != len(t.fields):
+            raise NotImplementedError(
+                "INSERT row() arity must match the ROW type"
+            )
+        return tuple(
+            _literal_value(x, ft) for x, (_fn, ft) in zip(e.args, t.fields)
+        )
     raise NotImplementedError(
         f"INSERT VALUES supports literals only, got {type(e).__name__}"
     )

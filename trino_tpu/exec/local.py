@@ -12,6 +12,8 @@ reference's build-side barriers.
 
 from __future__ import annotations
 
+from dataclasses import replace as dc_replace
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -219,7 +221,8 @@ class LocalExecutor:
                 i for i, n in enumerate(chain)
                 if isinstance(n, P.Aggregate)
                 and any(
-                    c.name == "array_agg" for c in n.aggregates.values()
+                    c.name in ("array_agg", "map_agg")
+                    for c in n.aggregates.values()
                 )
             ),
             None,
@@ -351,17 +354,51 @@ class LocalExecutor:
 
         nd = chain[agg_i]
         for call in nd.aggregates.values():
-            if call.name != "array_agg":
+            if call.name not in ("array_agg", "map_agg"):
                 raise NotImplementedError(
-                    "array_agg cannot combine with other aggregates "
-                    "in one GROUP BY yet"
+                    "array_agg/map_agg cannot combine with other "
+                    "aggregates in one GROUP BY yet"
                 )
-            if not (len(call.args) == 1 and isinstance(call.args[0], InputRef)):
+            if len(call.args) != (2 if call.name == "map_agg" else 1):
                 raise NotImplementedError(
-                    "array_agg argument must be a plain column"
+                    f"{call.name} argument count"
                 )
-        if chain[:agg_i]:
-            page = self._run_chain(chain[:agg_i], page)
+        pre = list(chain[:agg_i])
+        # computed arguments materialize through an inserted Project so
+        # the host group-by below reads plain columns
+        if any(
+            not isinstance(a, InputRef)
+            for call in nd.aggregates.values() for a in call.args
+        ):
+            src_outputs = (
+                pre[-1].outputs if pre
+                else {
+                    nm: c.type for nm, c in zip(page.names, page.columns)
+                }
+            )
+            assigns = {
+                s: InputRef(t, s) for s, t in src_outputs.items()
+            }
+            new_aggs = {}
+            for sym, call in nd.aggregates.items():
+                new_args = []
+                for j, a in enumerate(call.args):
+                    if isinstance(a, InputRef):
+                        new_args.append(a)
+                    else:
+                        tmp = f"{sym}__arg{j}"
+                        assigns[tmp] = a
+                        new_args.append(InputRef(a.type, tmp))
+                new_aggs[sym] = dc_replace(call, args=tuple(new_args))
+            proj = P.Project(
+                {s: e.type for s, e in assigns.items()},
+                source=nd.sources[0] if nd.sources else None,
+                assignments=assigns,
+            )
+            pre.append(proj)
+            nd = dc_replace(nd, aggregates=new_aggs)
+        if pre:
+            page = self._run_chain(pre, page)
         payload = page_to_host(self._compact(page))
         col_of = dict(zip(payload["names"], payload["cols"]))
         type_of = dict(zip(payload["names"], payload["types"]))
@@ -409,6 +446,24 @@ class LocalExecutor:
             kval = None if valid is None else valid[firsts]
             out_named[k] = (type_of[k], kv, kval)
         for sym, call in nd.aggregates.items():
+            if call.name == "map_agg":
+                # one (key, value) entry per row with a non-NULL key
+                # (MapAggAggregationFunction semantics)
+                kv, kvalid = col_of[call.args[0].name]
+                vv, vvalid = col_of[call.args[1].name]
+                maps = np.empty(len(groups), dtype=object)
+                for gi, g in enumerate(groups):
+                    maps[gi] = [
+                        (
+                            kv[i],
+                            None if (vvalid is not None and not vvalid[i])
+                            else vv[i],
+                        )
+                        for i in g
+                        if kvalid is None or kvalid[i]
+                    ]
+                out_named[sym] = (nd.outputs[sym], maps, None)
+                continue
             src = call.args[0].name
             v, valid = col_of[src]
             lists = np.empty(len(groups), dtype=object)
@@ -706,6 +761,12 @@ class LocalExecutor:
         names, cols = [], []
         for i, (sym, t) in enumerate(node.outputs.items()):
             vals = [r[i] for r in node.rows]
+            if isinstance(t, (T.ArrayType, T.MapType, T.RowType)):
+                # pool-backed literals: from_numpy builds the pool and
+                # the NULL mask from the None entries
+                names.append(sym)
+                cols.append(Column.from_numpy(t, vals, capacity=cap))
+                continue
             nulls = np.asarray([v is None for v in vals], dtype=np.bool_)
             filled = [0 if v is None else v for v in vals]
             dictionary = None

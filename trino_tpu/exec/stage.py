@@ -216,9 +216,15 @@ def _project_step(nd: P.Project, layout: ChainLayout):
             if isinstance(e, _Ref) and layout.pools.get(e.name) is not None
         },
         arrays={
-            s: layout.arrays.get(e.name)
+            s: (
+                compiled[s].pool
+                if compiled[s].pool is not None
+                else layout.arrays.get(e.name)
+                if isinstance(e, _Ref) else None
+            )
             for s, e in nd.assignments.items()
-            if isinstance(e, _Ref) and layout.arrays.get(e.name) is not None
+            if compiled[s].pool is not None
+            or (isinstance(e, _Ref) and layout.arrays.get(e.name) is not None)
         },
     )
 

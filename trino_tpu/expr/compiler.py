@@ -61,6 +61,9 @@ class CompiledExpr:
     type: T.DataType
     dictionary: StringDictionary | None = None  # set when type is varchar
     is_literal: bool = False
+    #: set when the result is a pool-backed handle lane (map_keys /
+    #: map_values emit a derived ArrayPool over the map pool's buffers)
+    pool: object | None = None
 
 
 def compile_expr(expr: RowExpression, layout: ColumnLayout) -> CompiledExpr:
@@ -103,17 +106,44 @@ class _Compiler:
         the reference lowered to the pool+handle design)."""
         name = expr.name
         arr = expr.args[0]
-        if not isinstance(arr, InputRef):
+        from trino_tpu.page import ArrayPool, MapPool, RowPool
+
+        if isinstance(arr, InputRef):
+            pool = self.layout.array_pools.get(arr.name)
+            if pool is None:
+                raise NotImplementedError(
+                    f"{name}: column {arr.name!r} has no array pool"
+                )
+            a = self.compile(arr)
+        elif isinstance(arr, Literal) and arr.value is not None:
+            # constant ARRAY[]/MAP()/ROW() literal: _literal builds the
+            # one-entry pool + constant handle 0
+            a = self.compile(arr)
+            pool = a.pool
+        else:
             raise NotImplementedError(
                 f"{name} over a computed array expression"
             )
-        pool = self.layout.array_pools.get(arr.name)
-        if pool is None:
-            raise NotImplementedError(
-                f"{name}: column {arr.name!r} has no array pool"
-            )
-        a = self.compile(arr)
         n = max(len(pool), 1)
+
+        if isinstance(pool, RowPool) or name == "row_field":
+            return self._row_field(expr, a, pool, n)
+        if isinstance(pool, MapPool):
+            if name in ("map_keys", "map_values"):
+                # a derived ArrayPool sharing the map pool's offsets
+                # and one of its flat buffers; handles pass through
+                buf = pool.keys if name == "map_keys" else pool.values
+                et = (
+                    pool.key_type if name == "map_keys"
+                    else pool.value_type
+                )
+                derived = ArrayPool(pool.offsets, buf, et)
+                return CompiledExpr(
+                    a.fn, T.ArrayType(et), pool=derived
+                )
+            if name == "subscript":
+                return self._map_subscript(expr, a, pool, n)
+            # cardinality falls through to the shared lengths path
         lens = pool.lengths()
         if name == "cardinality":
             table = jnp.asarray(
@@ -163,12 +193,13 @@ class _Compiler:
             )
         want = _literal_device_value(needle)
         if len(pool.values) and len(lens):
-            # vectorized segmented any: one equality pass + reduceat
-            # over the offsets (no per-row python loop)
+            # vectorized segmented any: one equality pass + scatter-or
+            # by array id (reduceat would mis-segment when trailing
+            # arrays are empty: offsets[:-1] may equal len(values))
             eq = pool.values == want
-            starts = np.minimum(pool.offsets[:-1], len(eq) - 1)
-            hit = np.logical_or.reduceat(eq, starts)
-            hit = np.where(lens > 0, hit, False)
+            seg_id = np.repeat(np.arange(len(lens)), lens)
+            hit = np.zeros(len(lens), dtype=np.bool_)
+            np.logical_or.at(hit, seg_id, eq)
         else:
             hit = np.zeros(len(lens), dtype=np.bool_)
         ht = jnp.asarray(np.pad(hit, (0, n - len(lens))))
@@ -178,6 +209,93 @@ class _Compiler:
             return ht[jnp.clip(h, 0, n - 1)], v
 
         return CompiledExpr(ev_contains, T.BOOLEAN)
+
+    def _map_subscript(self, expr: Call, a, pool, n: int) -> CompiledExpr:
+        """map[key] / element_at(map, key) with a constant key: a host
+        LUT (value-at-key per map, presence mask) + one device gather
+        (MapSubscriptOperator / MapElementAt lowered to pool+handle;
+        absent keys yield NULL)."""
+        key = expr.args[1]
+        if not isinstance(key, Literal) or key.value is None:
+            raise NotImplementedError("map key must be a constant")
+        want = _literal_device_value(key)
+        lens = pool.lengths()
+        m = len(lens)
+        if len(pool.keys) and m:
+            eq = pool.keys == want
+            # scatter-min by map id (reduceat would mis-segment when
+            # trailing maps are empty: offsets[:-1] may equal len(keys))
+            map_id = np.repeat(np.arange(m), lens)
+            pos = np.where(eq, np.arange(len(eq)), len(eq))
+            first = np.full(m, len(eq), dtype=np.int64)
+            np.minimum.at(first, map_id, pos)
+            ok_h = first < len(eq)
+            at = np.where(ok_h, first, 0)
+            vals = pool.values[at]
+        else:
+            ok_h = np.zeros(m, dtype=np.bool_)
+            vals = np.zeros(m, dtype=np.int64)
+        et = expr.type
+        out_dict = None
+        if vals.dtype == object:
+            # NULL map values ride object buffers: clear validity and
+            # fill with the type's zero so the fixed-width cast succeeds
+            nn = np.asarray([v is not None for v in vals], dtype=np.bool_)
+            ok_h = ok_h & nn
+            fill = "" if isinstance(et, T.VarcharType) else 0
+            vals = np.asarray(
+                [fill if v is None else v for v in vals], dtype=object
+            )
+        if isinstance(et, T.VarcharType):
+            out_dict, codes = StringDictionary.from_strings(
+                vals.astype(str) if len(vals) else np.asarray([], str)
+            )
+            vals = codes
+        tbl = jnp.asarray(np.pad(
+            np.asarray(vals, dtype=et.np_dtype), (0, n - m)
+        ))
+        okt = jnp.asarray(np.pad(ok_h, (0, n - m)))
+
+        def ev(env):
+            h, v = a.fn(env)
+            hc = jnp.clip(h, 0, n - 1)
+            ok = okt[hc] if v is None else (okt[hc] & v)
+            return tbl[hc], ok
+
+        return CompiledExpr(ev, et, out_dict)
+
+    def _row_field(self, expr: Call, a, pool, n: int) -> CompiledExpr:
+        """row[ordinal] / row.name: the field's pool column is itself
+        the LUT — one device gather by handle (RowBlock field access)."""
+        idx = expr.args[1]
+        if not isinstance(idx, Literal) or idx.value is None:
+            raise NotImplementedError("row field index must be constant")
+        fi = int(idx.value)
+        vals, fvalid = pool.fields[fi]
+        et = expr.type
+        out_dict = None
+        if isinstance(et, T.VarcharType):
+            out_dict, codes = StringDictionary.from_strings(
+                vals.astype(str) if len(vals) else np.asarray([], str)
+            )
+            vals = codes
+        m = len(vals)
+        tbl = jnp.asarray(np.pad(
+            np.asarray(vals, dtype=et.np_dtype), (0, n - m)
+        ))
+        okt = None
+        if fvalid is not None:
+            okt = jnp.asarray(np.pad(fvalid, (0, n - m)))
+
+        def ev(env):
+            h, v = a.fn(env)
+            hc = jnp.clip(h, 0, n - 1)
+            ok = v
+            if okt is not None:
+                ok = okt[hc] if v is None else (okt[hc] & v)
+            return tbl[hc], ok
+
+        return CompiledExpr(ev, et, out_dict)
 
     # ---- literals --------------------------------------------------------
     def _literal(self, expr: Literal) -> CompiledExpr:
@@ -191,10 +309,25 @@ class _Compiler:
                 expr.type,
                 is_literal=True,
             )
-        if isinstance(expr.type, T.ArrayType):
-            raise NotImplementedError(
-                "ARRAY literals evaluate in INSERT VALUES and UNNEST "
-                "only (pool-backed columns come from tables)"
+        if isinstance(expr.type, (T.ArrayType, T.MapType, T.RowType)):
+            # a one-entry pool + constant handle 0 (the ValuesNode
+            # single-row constant form of pool-backed columns)
+            from trino_tpu.page import ArrayPool, MapPool, RowPool
+
+            t = expr.type
+            if isinstance(t, T.MapType):
+                pool, _h = MapPool.from_pymaps(
+                    [list(expr.value)], t.key, t.value
+                )
+            elif isinstance(t, T.RowType):
+                pool, _h = RowPool.from_pytuples([expr.value], t)
+            else:
+                pool, _h = ArrayPool.from_pylists(
+                    [list(expr.value)], t.element
+                )
+            return CompiledExpr(
+                lambda env: (jnp.zeros((), dtype=jnp.int32), None),
+                t, is_literal=True, pool=pool,
             )
         if isinstance(expr.type, T.VarcharType):
             d = StringDictionary(np.asarray([str(expr.value)]))
@@ -417,7 +550,10 @@ class _Compiler:
             return self._coalesce(expr)
         if name == "in":
             return self._in(expr)
-        if name in ("cardinality", "subscript", "contains"):
+        if name in (
+            "cardinality", "subscript", "contains",
+            "map_keys", "map_values", "row_field",
+        ):
             return self._array_fn(expr)
         if name in _STRING_PREDICATES:
             return self._string_predicate(expr)

@@ -32,6 +32,7 @@ from trino_tpu import types as T
 
 __all__ = [
     "StringDictionary", "HashStringPool", "HashCollision", "ArrayPool",
+    "MapPool", "RowPool",
     "Column", "Page", "pad_capacity", "content_hash64",
 ]
 
@@ -277,6 +278,144 @@ class ArrayPool:
         return out
 
 
+def _storage_buffer(flat: list, element) -> np.ndarray:
+    if isinstance(
+        element, (T.VarcharType, T.MapType, T.RowType, T.ArrayType)
+    ) or any(v is None for v in flat):
+        # NULL entries keep the buffer in object form so decode
+        # round-trips None (fixed-width functions over such a pool
+        # reject at compile time)
+        return np.asarray(flat, dtype=object)
+    return np.asarray(flat if flat else [], dtype=element.np_dtype)
+
+
+class MapPool:
+    """Host-side store for MAP columns (SPI/type/MapType.java:58 /
+    SPI/block/MapBlock.java analog): one offsets array, two parallel
+    flat buffers (keys, values) in STORAGE form. Device columns carry
+    int32 handles; map functions compile host LUTs over the pool and
+    gather by handle — the ArrayPool design with a second buffer."""
+
+    __slots__ = ("offsets", "keys", "values", "key_type", "value_type", "token")
+
+    def __init__(self, offsets, keys, values, key_type, value_type):
+        self.offsets = offsets
+        self.keys = keys
+        self.values = values
+        self.key_type = key_type
+        self.value_type = value_type
+        self.token = next(_POOL_TOKENS)
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    @staticmethod
+    def from_pymaps(maps, key_type, value_type) -> tuple["MapPool", np.ndarray]:
+        """Build from python dicts / (k, v)-pair sequences; returns
+        (pool, handles). None entries produce an empty map with the
+        caller masking validity."""
+        offsets = np.zeros(len(maps) + 1, dtype=np.int64)
+        ks, vs = [], []
+        for i, m in enumerate(maps):
+            if m is None:
+                offsets[i + 1] = offsets[i]
+                continue
+            pairs = list(m.items()) if isinstance(m, dict) else list(m)
+            # keep-FIRST dedup so get() and the subscript LUT (which
+            # takes the first match) agree; the map() constructor
+            # rejects explicit duplicates before reaching here
+            seen = set()
+            n_kept = 0
+            for k, v in pairs:
+                if k in seen:
+                    continue
+                seen.add(k)
+                ks.append(k)
+                vs.append(v)
+                n_kept += 1
+            offsets[i + 1] = offsets[i] + n_kept
+        return (
+            MapPool(
+                offsets,
+                _storage_buffer(ks, key_type),
+                _storage_buffer(vs, value_type),
+                key_type,
+                value_type,
+            ),
+            np.arange(len(maps), dtype=np.int32),
+        )
+
+    def lengths(self) -> np.ndarray:
+        return np.diff(self.offsets).astype(np.int64)
+
+    def get(self, handle: int) -> dict:
+        lo, hi = self.offsets[handle], self.offsets[handle + 1]
+        return dict(zip(self.keys[lo:hi], self.values[lo:hi]))
+
+    def decode(self, handles: np.ndarray) -> np.ndarray:
+        out = np.empty(len(handles), dtype=object)
+        for i, h in enumerate(handles):
+            out[i] = self.get(int(h))
+        return out
+
+
+class RowPool:
+    """Host-side store for ROW columns (SPI/type/RowType.java:67 /
+    SPI/block/RowBlock.java analog): one storage-form column (+ null
+    mask) per field; device columns carry int32 handles. Field access
+    is a host LUT over the field column + one device gather."""
+
+    __slots__ = ("fields", "type", "token")
+
+    def __init__(self, fields, type_):
+        #: list[(np.ndarray values, np.ndarray | None valid)] per field
+        self.fields = fields
+        self.type = type_
+        self.token = next(_POOL_TOKENS)
+
+    def __len__(self) -> int:
+        return len(self.fields[0][0]) if self.fields else 0
+
+    @staticmethod
+    def from_pytuples(tuples, type_) -> tuple["RowPool", np.ndarray]:
+        n = len(tuples)
+        fields = []
+        for fi, (_fn, ft) in enumerate(type_.fields):
+            raw = [
+                None if t is None else t[fi] for t in tuples
+            ]
+            valid = np.asarray([v is not None for v in raw], dtype=np.bool_)
+            # storage: nulls become the type's zero value; the mask
+            # carries the truth
+            filled = [
+                ("" if isinstance(ft, T.VarcharType) else 0)
+                if v is None else v
+                for v in raw
+            ]
+            vals = _storage_buffer(filled, ft)
+            fields.append((vals, None if valid.all() else valid))
+        return (
+            RowPool(fields, type_),
+            np.arange(n, dtype=np.int32),
+        )
+
+    def get(self, handle: int):
+        out = []
+        for vals, valid in self.fields:
+            if valid is not None and not valid[handle]:
+                out.append(None)
+            else:
+                v = vals[handle]
+                out.append(v.item() if isinstance(v, np.generic) else v)
+        return tuple(out)
+
+    def decode(self, handles: np.ndarray) -> np.ndarray:
+        out = np.empty(len(handles), dtype=object)
+        for i, h in enumerate(handles):
+            out[i] = self.get(int(h))
+        return out
+
+
 class HashCollision(RuntimeError):
     """Two distinct strings share a hash64 — astronomically rare; the
     caller rebuilds the column with a sorted dictionary."""
@@ -300,9 +439,11 @@ class Column:
     valid: jnp.ndarray | None = None  # None => all valid
     dictionary: StringDictionary | None = None
     hash_pool: HashStringPool | None = None
-    #: ARRAY columns: host offsets+values store indexed by the int32
-    #: handle lanes in ``data``
-    array_pool: "ArrayPool | None" = None
+    #: ARRAY/MAP/ROW columns: host variable-width store indexed by the
+    #: int32 handle lanes in ``data`` (ArrayPool | MapPool | RowPool —
+    #: all expose get()/decode() so downstream code treats them
+    #: uniformly)
+    array_pool: "ArrayPool | MapPool | RowPool | None" = None
 
     @property
     def capacity(self) -> int:
@@ -321,8 +462,15 @@ class Column:
     ) -> "Column":
         n = len(values)
         cap = capacity or pad_capacity(n)
-        if isinstance(type_, T.ArrayType):
-            pool, handles = ArrayPool.from_pylists(values, type_.element)
+        if isinstance(type_, (T.ArrayType, T.MapType, T.RowType)):
+            if isinstance(type_, T.ArrayType):
+                pool, handles = ArrayPool.from_pylists(values, type_.element)
+            elif isinstance(type_, T.MapType):
+                pool, handles = MapPool.from_pymaps(
+                    values, type_.key, type_.value
+                )
+            else:
+                pool, handles = RowPool.from_pytuples(values, type_)
             data = np.zeros(cap, dtype=np.int32)
             data[:n] = handles
             col_valid = None
@@ -489,6 +637,16 @@ class Page:
 def _pyvalue(type_: T.DataType, v):
     if isinstance(type_, T.ArrayType):
         return [_pyvalue(type_.element, x) for x in v]
+    if isinstance(type_, T.MapType):
+        return {
+            _pyvalue(type_.key, k): _pyvalue(type_.value, x)
+            for k, x in v.items()
+        }
+    if isinstance(type_, T.RowType):
+        return tuple(
+            None if x is None else _pyvalue(ft, x)
+            for (_fn, ft), x in zip(type_.fields, v)
+        )
     if isinstance(type_, T.BooleanType):
         return bool(v)
     if isinstance(type_, T.DecimalType):

@@ -837,11 +837,18 @@ class _Parser:
         return base
 
     def _type_param(self) -> str:
-        """One type parameter: a number or a nested (possibly
-        parametric) type name — array(decimal(5,1)) nests."""
+        """One type parameter: a number, a nested (possibly
+        parametric) type name — array(decimal(5,1)) nests — or a
+        ROW field "name type" pair (row(x bigint, y double))."""
         if self.peek().kind == "NUMBER":
             return self.next().text
-        return self._type_name()
+        first = self._type_name()
+        if self.peek().kind in ("IDENT", "KEYWORD") and not self.at_op(
+            ",", ")"
+        ):
+            # "name type": first was the field name
+            return f"{first} {self._type_name()}"
+        return first
 
 
 #: keywords that may be used as identifiers / function names

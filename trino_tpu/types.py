@@ -36,6 +36,8 @@ __all__ = [
     "DateType",
     "TimestampType",
     "UnknownType",
+    "MapType",
+    "RowType",
     "BOOLEAN",
     "TINYINT",
     "SMALLINT",
@@ -289,6 +291,58 @@ class ArrayType(DataType):
 
 
 @dataclass(frozen=True, eq=False, repr=False)
+class MapType(DataType):
+    """MAP(key, value) (SPI/type/MapType.java:58 analog). Device data
+    is an int32 HANDLE lane indexing a host-side MapPool holding the
+    offsets + flat key/value buffers — the same pool+handle design as
+    ARRAY (page.MapPool), with two parallel element buffers sharing
+    one offsets array."""
+
+    key: DataType = None  # type: ignore[assignment]
+    value: DataType = None  # type: ignore[assignment]
+
+    np_dtype = np.dtype(np.int32)
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"map({self.key.name},{self.value.name})"
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True, eq=False, repr=False)
+class RowType(DataType):
+    """ROW(f1 t1, ...) (SPI/type/RowType.java:67 analog). Device data
+    is an int32 HANDLE lane indexing a host-side RowPool holding one
+    storage-form column (+ null mask) per field. ``fields`` is a tuple
+    of (name | None, DataType); anonymous fields address by 1-based
+    ordinal subscript, named fields also by dotted dereference."""
+
+    fields: tuple = ()  # tuple[(str | None, DataType), ...]
+
+    np_dtype = np.dtype(np.int32)
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        parts = [
+            (f"{n} {t.name}" if n else t.name) for n, t in self.fields
+        ]
+        return f"row({','.join(parts)})"
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    def field_index(self, name: str) -> int | None:
+        for i, (n, _t) in enumerate(self.fields):
+            if n is not None and n.lower() == name.lower():
+                return i
+        return None
+
+
+@dataclass(frozen=True, eq=False, repr=False)
 class SketchType(DataType):
     """Internal multi-lane aggregation state: HLL registers or quantile
     summaries (the analog of the reference's HyperLogLog / QDigest
@@ -342,6 +396,22 @@ _BY_NAME = {
 }
 
 
+def _split_params(inner: str) -> list[str]:
+    """Split a type parameter list on top-level commas only —
+    map(bigint,array(map(int,int))) nests."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(inner):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(inner[start:i])
+            start = i + 1
+    parts.append(inner[start:])
+    return [p.strip() for p in parts]
+
+
 def type_from_name(name: str) -> DataType:
     base = name.strip().lower()
     if base.startswith("decimal"):
@@ -359,6 +429,23 @@ def type_from_name(name: str) -> DataType:
         return SketchType(kind.strip(), int(lanes))
     if base.startswith("array(") and base.endswith(")"):
         return ArrayType(type_from_name(base[6:-1]))
+    if base.startswith("map(") and base.endswith(")"):
+        k, v = _split_params(base[4:-1])
+        return MapType(type_from_name(k), type_from_name(v))
+    if base.startswith("row(") and base.endswith(")"):
+        fields = []
+        for part in _split_params(base[4:-1]):
+            # "name type" or bare "type": a field name is a single
+            # identifier token before a space that starts a known type
+            if " " in part:
+                fn, ft = part.split(" ", 1)
+                try:
+                    fields.append((fn, type_from_name(ft)))
+                    continue
+                except ValueError:
+                    pass
+            fields.append((None, type_from_name(part)))
+        return RowType(tuple(fields))
     if base.startswith("char("):
         return CharType(int(base[5:-1]))
     if base in _BY_NAME:
