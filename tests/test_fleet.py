@@ -150,6 +150,143 @@ def test_fleet_task_retry_after_injected_failure(fleet, oracle):
     )
 
 
+def test_fleet_overlapping_stage_dag(fleet, oracle):
+    """Independent stages interleave across the pool: with a
+    partitioned join, BOTH child scan stages must have tasks posted
+    before EITHER completes (no strict wave barrier between
+    independent subtrees — the PipelinedQueryScheduler direction)."""
+    fleet.session.properties["join_distribution_type"] = "PARTITIONED"
+    fleet.session.properties["fleet_task_delay_ms"] = 150
+    log: list[tuple[str, str]] = []  # ("post"|"done", stage_id)
+    fleet.post_hook = lambda sid, tid, w: log.append(("post", sid))
+    fleet.stage_hook = lambda sid: log.append(("done", sid))
+    check(
+        fleet, oracle,
+        "select c_mktsegment, count(*) from customer, orders "
+        "where c_custkey = o_custkey group by c_mktsegment order by 1",
+    )
+    # tasks from >= 2 distinct stages must be posted BEFORE any stage
+    # completes (the old wave barrier would finish stage A entirely
+    # before posting anything of stage B)
+    stages_posted_before_first_done = set()
+    for ev, sid in log:
+        if ev == "done":
+            break
+        stages_posted_before_first_done.add(sid)
+    assert len(stages_posted_before_first_done) >= 2, (
+        f"no overlap: {log}"
+    )
+
+
+def test_fleet_worker_graceful_drain(workers, spool_root, oracle):
+    """POST /v1/drain mid-query: the drained worker finishes its
+    in-flight task (its output counts), receives nothing new, and the
+    query completes on the survivors
+    (GracefulShutdownHandler analog)."""
+    victim_port = BASE_PORT + 8
+    victim = _spawn_worker(victim_port)
+    victim_uri = f"http://127.0.0.1:{victim_port}"
+    try:
+        md = Metadata()
+        md.register_catalog("tpch", TpchConnector())
+        fleet = FleetRunner(
+            [victim_uri] + list(workers),
+            md, Session(catalog="tpch", schema="tiny"),
+            spool_root=spool_root, n_partitions=4,
+        )
+        fleet.session.properties["fleet_task_delay_ms"] = 200
+        state = {"drained": False, "posts_after_drain": 0}
+
+        def post_hook(stage_id, task_id, w):
+            if state["drained"] and victim_uri in w.uri:
+                state["posts_after_drain"] += 1
+            if not state["drained"] and victim_uri in w.uri:
+                # drain while its first task is still in flight
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"{victim_uri}/v1/drain", data=b"", method="POST"
+                    ),
+                    timeout=5,
+                ).read()
+                state["drained"] = True
+
+        fleet.post_hook = post_hook
+        sql = (
+            "select o_orderpriority, count(*) from orders "
+            "group by o_orderpriority order by 1"
+        )
+        result = fleet.execute(sql)
+        assert state["drained"], "victim never received a task"
+        assert state["posts_after_drain"] == 0, (
+            "a drained worker must not receive new tasks"
+        )
+        expected = oracle.execute(to_sqlite(sql)).fetchall()
+        assert_rows_match(
+            result.rows, expected, ordered=result.ordered, abs_tol=1e-9
+        )
+        # its in-flight work done, the worker reports DRAINED
+        with urllib.request.urlopen(
+            f"{victim_uri}/v1/info", timeout=5
+        ) as r:
+            info = json.loads(r.read())
+        assert info["state"] in ("DRAINING", "DRAINED")
+        mark = [w for w in fleet.workers if victim_uri in w.uri][0]
+        assert mark.alive and mark.draining
+    finally:
+        victim.kill()
+
+
+def test_fleet_recovers_from_hung_worker_sigstop(workers, spool_root, oracle):
+    """SIGSTOP a worker holding an in-flight task: it keeps its
+    sockets open but answers nothing — consecutive short poll
+    timeouts must declare it dead and reschedule WITHOUT waiting a
+    full long RPC timeout (HeartbeatFailureDetector analog)."""
+    victim_port = BASE_PORT + 9
+    victim = _spawn_worker(victim_port)
+    victim_uri = f"http://127.0.0.1:{victim_port}"
+    try:
+        md = Metadata()
+        md.register_catalog("tpch", TpchConnector())
+        fleet = FleetRunner(
+            [victim_uri] + list(workers),
+            md, Session(catalog="tpch", schema="tiny"),
+            spool_root=spool_root, n_partitions=4,
+            rpc_timeout_s=2.0, max_poll_fails=3,
+        )
+        fleet.session.properties["fleet_task_delay_ms"] = 200
+        state = {"stopped": False}
+
+        def post_hook(stage_id, task_id, w):
+            if not state["stopped"] and victim_uri in w.uri:
+                os.kill(victim.pid, signal.SIGSTOP)
+                state["stopped"] = True
+
+        fleet.post_hook = post_hook
+        sql = (
+            "select o_orderpriority, count(*) from orders "
+            "group by o_orderpriority order by 1"
+        )
+        t0 = time.monotonic()
+        result = fleet.execute(sql)
+        elapsed = time.monotonic() - t0
+        assert state["stopped"], "victim never received a task"
+        # detection budget: ~max_poll_fails * rpc_timeout_s (+ run
+        # time), nowhere near a 30 s single-RPC timeout
+        assert elapsed < 25, f"hung-worker detection took {elapsed:.1f}s"
+        expected = oracle.execute(to_sqlite(sql)).fetchall()
+        assert_rows_match(
+            result.rows, expected, ordered=result.ordered, abs_tol=1e-9
+        )
+        dead = [w for w in fleet.workers if victim_uri in w.uri][0]
+        assert not dead.alive
+    finally:
+        try:
+            os.kill(victim.pid, signal.SIGCONT)
+        except OSError:
+            pass
+        victim.kill()
+
+
 def test_fleet_survives_worker_kill9(workers, spool_root, oracle):
     """kill -9 a worker while it owns an in-flight task: the
     coordinator must detect the death, exclude the worker, re-run the

@@ -70,3 +70,85 @@ def test_admin_unrestricted(setup):
     admin.execute("update t set id = id + 1 where id = 3")
     admin.execute("delete from t where id = 4")
     assert admin.execute("select count(*) from t").rows == [(2,)]
+
+
+# ---- row filters / column masks (SPI ViewExpression analog) --------------
+
+@pytest.fixture()
+def policy_md():
+    from trino_tpu.connectors.tpch.connector import TpchConnector
+
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    md.access_control = RuleBasedAccessControl(rules=[
+        Rule(
+            user="analyst", catalog="tpch", table="orders",
+            row_filter="o_orderstatus = 'F'",
+            column_masks={"o_clerk": "'masked'"},
+        ),
+        Rule(user="analyst"),
+        Rule(user="admin"),
+    ])
+    return md
+
+
+def test_row_filter_limits_visible_rows(policy_md):
+    analyst = QueryRunner(
+        policy_md, Session(catalog="tpch", schema="tiny", user="analyst")
+    )
+    admin = QueryRunner(
+        policy_md, Session(catalog="tpch", schema="tiny", user="admin")
+    )
+    a = analyst.execute("select count(*) from orders").rows[0][0]
+    b = admin.execute("select count(*) from orders").rows[0][0]
+    assert 0 < a < b
+    assert analyst.execute(
+        "select distinct o_orderstatus from orders"
+    ).rows == [("F",)]
+
+
+def test_row_filter_applies_through_joins(policy_md):
+    analyst = QueryRunner(
+        policy_md, Session(catalog="tpch", schema="tiny", user="analyst")
+    )
+    rows = analyst.execute(
+        "select distinct o_orderstatus from customer, orders "
+        "where c_custkey = o_custkey"
+    ).rows
+    assert rows == [("F",)]
+
+
+def test_column_mask_replaces_values(policy_md):
+    analyst = QueryRunner(
+        policy_md, Session(catalog="tpch", schema="tiny", user="analyst")
+    )
+    rows = analyst.execute(
+        "select min(o_clerk), max(o_clerk) from orders"
+    ).rows
+    assert rows == [("masked", "masked")]
+    # unmasked columns flow untouched
+    keys = analyst.execute(
+        "select count(distinct o_custkey) from orders"
+    ).rows[0][0]
+    assert keys > 1
+
+
+def test_filter_sees_unmasked_values(policy_md):
+    """Reference semantics: the row filter evaluates over the ORIGINAL
+    column values, before masking."""
+    policy_md.access_control = RuleBasedAccessControl(rules=[
+        Rule(
+            user="analyst", catalog="tpch", table="orders",
+            row_filter="o_clerk = 'Clerk#000000001'",
+            column_masks={"o_clerk": "'masked'"},
+        ),
+        Rule(user="analyst"),
+    ])
+    analyst = QueryRunner(
+        policy_md, Session(catalog="tpch", schema="tiny", user="analyst")
+    )
+    rows = analyst.execute(
+        "select count(*), min(o_clerk) from orders"
+    ).rows
+    n, clerk = rows[0]
+    assert n > 0 and clerk == "masked"

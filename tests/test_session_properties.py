@@ -1,0 +1,157 @@
+"""Session property registry: typed SET SESSION validation,
+SHOW SESSION, RESET SESSION, and knobs actually changing behavior
+(SystemSessionProperties analog, MAIN/SystemSessionProperties.java).
+"""
+
+import pytest
+
+from trino_tpu import session_properties as SP
+from trino_tpu.engine import QueryRunner, Session
+
+
+@pytest.fixture()
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+def test_set_session_validates_name(runner):
+    with pytest.raises(ValueError, match="unknown session property"):
+        runner.execute("set session no_such_knob = 1")
+
+
+def test_set_session_validates_type(runner):
+    with pytest.raises(ValueError, match="bigint"):
+        runner.execute("set session grace_partitions = 'many'")
+    with pytest.raises(ValueError, match="one of"):
+        runner.execute("set session join_distribution_type = 'SIDEWAYS'")
+    with pytest.raises(ValueError, match="positive"):
+        runner.execute("set session grace_partitions = 0")
+
+
+def test_set_show_reset_roundtrip(runner):
+    runner.execute("set session grace_partitions = 16")
+    rows = {r[0]: r for r in runner.execute("show session").rows}
+    assert rows["grace_partitions"][1] == "16"
+    assert rows["grace_partitions"][2] == "8"  # default
+    assert rows["grace_partitions"][3] == "bigint"
+    runner.execute("reset session grace_partitions")
+    rows = {r[0]: r for r in runner.execute("show session").rows}
+    assert rows["grace_partitions"][1] == "8"
+
+
+def test_show_session_hides_test_hooks(runner):
+    names = {r[0] for r in runner.execute("show session").rows}
+    assert "task_delay_ms" not in names
+    assert "hbm_budget_bytes" in names
+    assert "join_reordering_strategy" in names
+
+
+def test_typed_get_defaults():
+    s = Session()
+    assert SP.get(s, "dynamic_filtering_enabled") is True
+    assert SP.get(s, "retry_max_attempts") == 3
+    assert SP.get(None, "grace_partitions") == 8
+
+
+def test_boolean_coercion():
+    s = Session()
+    SP.set_property(s, "dynamic_filtering_enabled", "false")
+    assert SP.get(s, "dynamic_filtering_enabled") is False
+    SP.set_property(s, "dynamic_filtering_enabled", True)
+    assert SP.get(s, "dynamic_filtering_enabled") is True
+
+
+def test_join_reordering_strategy_changes_plan(runner):
+    """NONE keeps syntactic order: a deliberately bad syntactic order
+    (big fact first in the comma list joined last) must differ from
+    the stats-driven plan."""
+    from trino_tpu.plan import nodes as P
+
+    sql = (
+        "select count(*) from lineitem, orders, customer "
+        "where l_orderkey = o_orderkey and o_custkey = c_custkey "
+        "and c_mktsegment = 'BUILDING'"
+    )
+
+    def join_shape(plan):
+        out = []
+
+        def walk(n, d):
+            if isinstance(n, P.Join):
+                out.append(d)
+            for s in n.sources:
+                walk(s, d + 1)
+
+        walk(plan, 0)
+        return out
+
+    auto = runner.plan_sql(sql)
+    runner.execute("set session join_reordering_strategy = 'NONE'")
+    try:
+        none = runner.plan_sql(sql)
+    finally:
+        runner.execute("reset session join_reordering_strategy")
+    # both plan; results agree
+    assert join_shape(auto) and join_shape(none)
+    a = runner.execute(sql)
+    runner.execute("set session join_reordering_strategy = 'NONE'")
+    try:
+        b = runner.execute(sql)
+    finally:
+        runner.execute("reset session join_reordering_strategy")
+    assert a.rows == b.rows
+
+
+def test_dynamic_filtering_toggle_results_identical(runner):
+    sql = (
+        "select count(*) from lineitem, orders "
+        "where l_orderkey = o_orderkey and o_totalprice > 100000"
+    )
+    a = runner.execute(sql)
+    runner.execute("set session dynamic_filtering_enabled = false")
+    try:
+        b = runner.execute(sql)
+    finally:
+        runner.execute("reset session dynamic_filtering_enabled")
+    assert a.rows == b.rows
+
+
+# ---- event listeners (SPI/eventlistener analog) --------------------------
+
+def test_query_completed_events(runner):
+    from trino_tpu.events import EventListener
+
+    class Recorder(EventListener):
+        def __init__(self):
+            self.events = []
+
+        def query_completed(self, event):
+            self.events.append(event)
+
+    rec = Recorder()
+    runner.metadata.event_listeners.append(rec)
+    try:
+        runner.execute("select count(*) from nation")
+        with pytest.raises(Exception):
+            runner.execute("select no_such_column from nation")
+    finally:
+        runner.metadata.event_listeners.remove(rec)
+    assert len(rec.events) == 2
+    ok, bad = rec.events
+    assert ok.state == "FINISHED" and ok.rows == 1
+    assert ok.elapsed_ms > 0 and ok.user == runner.session.user
+    assert bad.state == "FAILED" and "no_such_column" in (bad.error or "")
+
+
+def test_broken_listener_does_not_fail_query(runner):
+    from trino_tpu.events import EventListener
+
+    class Broken(EventListener):
+        def query_completed(self, event):
+            raise RuntimeError("listener exploded")
+
+    runner.metadata.event_listeners.append(Broken())
+    try:
+        assert runner.execute("select 1").rows == [(1,)]
+    finally:
+        runner.metadata.event_listeners.clear()

@@ -615,6 +615,9 @@ class Analyzer:
                 outputs, catalog=qt.catalog, schema=qt.schema, table=qt.table,
                 assignments=assignments,
             )
+            node = self._apply_security_policies(
+                node, qt, schema, fields
+            )
             return RelationPlan(node, Scope(fields, parent=outer))
         if isinstance(rel, ast.SubqueryRel):
             sub_rp, names = self.plan_query(rel.query, outer, ctes)
@@ -627,6 +630,54 @@ class Analyzer:
         if isinstance(rel, ast.JoinRel):
             return self._plan_join(rel, outer, ctes)
         raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+    def _apply_security_policies(self, node, qt, schema, fields):
+        """Row filters and column masks (the reference applies the
+        SPI's ViewExpressions at each table reference in
+        StatementAnalyzer; same shape here: filters AND into a Filter
+        over the scan — evaluated over UNMASKED columns, reference
+        semantics — then masks rewrite columns via a Project that
+        keeps the original symbols)."""
+        ac = self.metadata.access_control
+        user = self.session.user
+        filters = ac.get_row_filters(user, qt.catalog, qt.schema, qt.table)
+        masks = {}
+        for f in fields:
+            m = ac.get_column_mask(
+                user, qt.catalog, qt.schema, qt.table, f.name, f.type
+            )
+            if m is not None:
+                masks[f.symbol] = (m, f.type)
+        if not filters and not masks:
+            return node
+        from trino_tpu.sql.parser import parse_expression
+
+        policy_scope = Scope(list(fields), parent=None)
+        for fsql in filters:
+            ir = ExprAnalyzer(self, policy_scope).analyze(
+                parse_expression(fsql)
+            )
+            if not isinstance(ir.type, T.BooleanType):
+                raise AnalysisError(
+                    f"row filter {fsql!r} must be boolean, is {ir.type}"
+                )
+            node = P.Filter(dict(node.outputs), source=node, predicate=ir)
+        if masks:
+            assignments = {}
+            for sym, t in node.outputs.items():
+                if sym in masks:
+                    msql, mt = masks[sym]
+                    mir = ExprAnalyzer(self, policy_scope).analyze(
+                        parse_expression(msql)
+                    )
+                    assignments[sym] = _cast_to(mir, mt)
+                else:
+                    assignments[sym] = InputRef(t, sym)
+            node = P.Project(
+                {s: e.type for s, e in assignments.items()},
+                source=node, assignments=assignments,
+            )
+        return node
 
     def _cross_join(self, left: RelationPlan, right: RelationPlan) -> RelationPlan:
         outputs = {**left.node.outputs, **right.node.outputs}

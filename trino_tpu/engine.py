@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 
 from trino_tpu.analyzer.analyzer import Analyzer
@@ -124,10 +125,33 @@ class QueryRunner:
     def execute(self, sql: str, cancel_event=None) -> QueryResult:
         with self._lock:
             self.executor.cancel_event = cancel_event
+            t0 = time.perf_counter()
+            error = None
+            result = None
             try:
-                return self._execute(sql)
+                result = self._execute(sql)
+                return result
+            except Exception as e:
+                error = f"{type(e).__name__}: {e}"
+                raise
             finally:
                 self.executor.cancel_event = None
+                listeners = getattr(self.metadata, "event_listeners", ())
+                if listeners:
+                    from trino_tpu.events import (
+                        QueryCompletedEvent,
+                        fire_query_completed,
+                    )
+
+                    fire_query_completed(listeners, QueryCompletedEvent(
+                        query_id=uuid.uuid4().hex[:12],
+                        user=self.session.user,
+                        sql=sql,
+                        state="FAILED" if error else "FINISHED",
+                        elapsed_ms=(time.perf_counter() - t0) * 1e3,
+                        rows=len(result.rows) if result else 0,
+                        error=error,
+                    ))
 
     def _execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
@@ -222,12 +246,30 @@ class QueryRunner:
         if isinstance(stmt, ast.Update):
             return self._update(stmt)
         if isinstance(stmt, ast.SessionSet):
+            from trino_tpu import session_properties as SP
+
             v = stmt.value
             val = getattr(v, "value", None)
             if val is None and hasattr(v, "text"):
                 val = v.text
-            self.session.properties[stmt.name] = val
+            SP.set_property(self.session, stmt.name, val)
             return QueryResult(["result"], [("SET SESSION",)])
+        if isinstance(stmt, ast.SessionReset):
+            from trino_tpu import session_properties as SP
+
+            if stmt.name not in SP.SESSION_PROPERTIES:
+                raise ValueError(
+                    f"unknown session property: {stmt.name}"
+                )
+            self.session.properties.pop(stmt.name, None)
+            return QueryResult(["result"], [("RESET SESSION",)])
+        if isinstance(stmt, ast.ShowSession):
+            from trino_tpu import session_properties as SP
+
+            return QueryResult(
+                ["name", "value", "default", "type", "description"],
+                SP.show_rows(self.session),
+            )
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.CreateTableAs):
@@ -744,6 +786,10 @@ def _literal_value(e: ast.Expr, t):
         vs = [_literal_value(x, t.value) for x in e.args[1].items]
         if len(ks) != len(vs):
             raise ValueError("map() key/value arrays differ in length")
+        if len(set(ks)) != len(ks):
+            # same rule as the analyzer's map constructor — INSERT
+            # must not silently keep-first what SELECT rejects
+            raise ValueError("Duplicate map keys are not allowed")
         return list(zip(ks, vs))
     if isinstance(e, ast.FnCall) and e.name.lower() == "row":
         from trino_tpu import types as T

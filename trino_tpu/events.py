@@ -1,0 +1,52 @@
+"""Event listener SPI: query lifecycle events for observability.
+
+The analog of the reference's EventListener SPI
+(SPI/eventlistener/EventListener.java + QueryCompletedEvent.java):
+pluggable listeners registered on the Metadata receive a
+QueryCompletedEvent after every statement — success or failure — with
+identity, timing, and io counters. Listeners must not fail the query:
+exceptions are swallowed (the reference isolates listener errors the
+same way).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["QueryCompletedEvent", "EventListener", "fire_query_completed"]
+
+
+@dataclass(frozen=True)
+class QueryCompletedEvent:
+    """One finished statement (QueryCompletedEvent analog)."""
+
+    query_id: str
+    user: str
+    sql: str
+    #: FINISHED | FAILED
+    state: str
+    elapsed_ms: float
+    #: result rows returned (0 for DDL/DML acks)
+    rows: int
+    #: error text when state == FAILED
+    error: str | None = None
+    #: wall-clock seconds since epoch at completion
+    end_time: float = field(default_factory=time.time)
+
+
+class EventListener:
+    """SPI base: override any subset."""
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+
+def fire_query_completed(listeners, event: QueryCompletedEvent) -> None:
+    """Deliver to every listener, isolating failures (a broken
+    listener must never fail the query — reference behavior)."""
+    for lst in listeners:
+        try:
+            lst.query_completed(event)
+        except Exception:
+            pass

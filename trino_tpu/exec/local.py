@@ -116,7 +116,9 @@ class LocalExecutor:
         """Device-memory budget in bytes (session ``hbm_budget_bytes``;
         0 = resident mode). Tables/joins whose working sets exceed it
         stream through exec.spill instead of materializing."""
-        return int(self.session.properties.get("hbm_budget_bytes", 0) or 0)
+        from trino_tpu import session_properties as SP
+
+        return int(SP.get(self.session, "hbm_budget_bytes"))
 
     def invalidate_scan(self, catalog: str, schema: str, table: str):
         """Drop cached device pages for a table (called after writes —
@@ -256,7 +258,9 @@ class LocalExecutor:
                 )
                 return self._run_chain(chain[1:], filtered)
 
-        chunk_rows = int(self.session.properties.get("max_chunk_rows", 0) or 0)
+        from trino_tpu import session_properties as SP
+
+        chunk_rows = int(SP.get(self.session, "max_chunk_rows"))
         if chunk_rows > 0 and page.capacity > chunk_rows:
             # only SINGLE-step aggregations chunk: the FINAL combine
             # over the partial states materializes O(distinct keys),
@@ -1021,7 +1025,9 @@ class LocalExecutor:
     def _cross_join(self, node: P.Join, left: Page, right: Page) -> Page:
         # callers (_Join) hand in already-compacted pages
         n_l, n_r = left.num_rows(), right.num_rows()
-        limit = self.CROSS_CHUNK_ROWS
+        from trino_tpu import session_properties as SP
+
+        limit = int(SP.get(self.session, "cross_join_chunk_rows"))
         budget = self.hbm_budget()
         if budget:
             from trino_tpu.exec import spill
@@ -1099,6 +1105,117 @@ class LocalExecutor:
         out.known_rows = n_l * n_r
         out.packed = True
         return out
+
+    def _nested_loop_join(self, node: P.Join, probe: Page, build: Page) -> Page:
+        """Joins WITHOUT equi criteria (`a JOIN b ON a.x < b.y`): the
+        NestedLoopJoinOperator + join-filter shape
+        (MAIN/operator/join/NestedLoopJoinOperator.java:43). Cross-
+        expand chunk-wise (bounded by CROSS_CHUNK_ROWS), evaluate the
+        filter over the pair page, and for outer kinds append the
+        unmatched rows with a NULL far side. _Join already flipped
+        RIGHT to LEFT, so kinds here are inner/left/full."""
+        from trino_tpu.exec import spill
+
+        # row-id lanes ride through the cross expansion so unmatched
+        # probe/build rows are identifiable afterwards
+        def with_ids(page: Page, idname: str) -> Page:
+            ids = Column(
+                T.BIGINT,
+                jnp.arange(page.capacity, dtype=jnp.int64),
+            )
+            return Page(
+                list(page.names) + [idname], list(page.columns) + [ids],
+                page.mask, known_rows=page.known_rows, packed=page.packed,
+            )
+
+        p2 = with_ids(probe, "__nl_pid")
+        b2 = with_ids(build, "__nl_bid")
+        cross_outputs = {
+            **{n: c.type for n, c in zip(p2.names, p2.columns)},
+            **{n: c.type for n, c in zip(b2.names, b2.columns)},
+        }
+        cross_node = P.Join(
+            cross_outputs, kind="cross", left=node.left, right=node.right
+        )
+        pairs = self._cross_join(cross_node, p2, b2)
+        if node.filter is not None:
+            ce = compile_expr(node.filter, self._layout(pairs))
+            data, valid = ce.fn(self._env(pairs))
+            keep = data if valid is None else (data & valid)
+            pairs = self._compact(
+                Page(list(pairs.names), list(pairs.columns),
+                     pairs.mask & keep)
+            )
+        out_syms = list(node.outputs)
+        matched = Page(
+            out_syms, [pairs.column(s) for s in out_syms], pairs.mask,
+            known_rows=pairs.known_rows, packed=pairs.packed,
+        )
+        if node.kind == "inner":
+            return matched
+        runs = [spill.page_to_host(matched)]
+        pid_run = spill.page_to_host(
+            Page(["__nl_pid"], [pairs.column("__nl_pid")], pairs.mask,
+                 known_rows=pairs.known_rows, packed=pairs.packed)
+        )
+        matched_pids = set(pid_run.columns[0][0].tolist())
+        runs.append(self._nl_unmatched(
+            node, probe, build, matched_pids, out_syms, probe_side=True
+        ))
+        if node.kind == "full":
+            bid_run = spill.page_to_host(
+                Page(["__nl_bid"], [pairs.column("__nl_bid")], pairs.mask,
+                     known_rows=pairs.known_rows, packed=pairs.packed)
+            )
+            matched_bids = set(bid_run.columns[0][0].tolist())
+            runs.append(self._nl_unmatched(
+                node, probe, build, matched_bids, out_syms,
+                probe_side=False,
+            ))
+        runs = [r for r in runs if r.n_rows] or [
+            spill._empty_run(dict(node.outputs))
+        ]
+        return spill.host_concat_to_page(self, runs)
+
+    def _nl_unmatched(
+        self, node: P.Join, probe: Page, build: Page, matched: set,
+        out_syms: list, probe_side: bool,
+    ):
+        """HostRun of one side's unmatched rows, far side all-NULL."""
+        from trino_tpu.exec import spill
+
+        page = probe if probe_side else build
+        run = spill.page_to_host(page)
+        keep = [
+            i for i in range(run.n_rows) if i not in matched
+        ]
+        near = set(page.names)
+        cols = []
+        types = []
+        for s in out_syms:
+            t = node.outputs[s]
+            types.append(t)
+            if s in near:
+                v, valid = run.columns[run.names.index(s)]
+                cols.append((
+                    v[keep],
+                    None if valid is None else valid[keep],
+                ))
+            else:
+                # far side: typed zeros, all invalid
+                src = (build if probe_side else probe).column(s)
+                shape = (len(keep), 2) if jnp.ndim(src.data) == 2 else (
+                    len(keep),
+                )
+                filler = (
+                    np.zeros(len(keep), dtype=object)
+                    if src.dictionary is not None or src.hash_pool is not None
+                    else np.zeros(shape, dtype=t.np_dtype)
+                )
+                if filler.dtype == object:
+                    filler[:] = ""
+                cols.append((filler, np.zeros(len(keep), dtype=bool)))
+        return spill.HostRun(out_syms, types, cols, len(keep))
 
     def _unify_join_dicts(self, probe: Page, build: Page, criteria):
         """Remap VARCHAR key pairs onto shared dictionaries (host-side
@@ -1246,6 +1363,10 @@ class LocalExecutor:
         only prunes when the build's key RANGE is narrower than the
         probe's — uniform dense builds keep ~100% and the two syncs
         are pure cost (the measured Q3 regression)."""
+        from trino_tpu import session_properties as SP
+
+        if not SP.get(self.session, "dynamic_filtering_enabled"):
+            return probe
         if node.kind != "inner" or probe.capacity < self.DF_MIN_PROBE:
             return probe
         if node.df_range_keep is None or node.df_range_keep > 0.7:
@@ -1307,7 +1428,7 @@ class LocalExecutor:
 
     def _equi_join(self, node: P.Join, probe: Page, build: Page) -> Page:
         if not node.criteria:
-            raise NotImplementedError(f"{node.kind} join without equi criteria")
+            return self._nested_loop_join(node, probe, build)
         self._unify_join_dicts(probe, build, node.criteria)
         probe = self._dynamic_filter(node, probe, build)
         order, lo, cnt, total = self._join_count(node.criteria, probe, build)

@@ -229,7 +229,27 @@ class MeshExecutor(LocalExecutor):
                 cur = cur.sources[0]
             base = self.execute_dist(cur)
             return self._run_chain_sharded(list(reversed(chain)), base)
+        if isinstance(node, P.RemoteSource):
+            # fleet x mesh seam: a spooled stage input scatters over
+            # THIS worker's device mesh; when the producing stage hash-
+            # partitioned on keys, re-exchange locally so every key
+            # owns one shard (FINAL aggregation / co-partitioned
+            # consumers assume key-disjoint shards) — the DCN partition
+            # re-partitions over ICI within the worker (SURVEY §5.8)
+            page = self._RemoteSource(node)
+            sp = self.scatter(page)
+            keys = (getattr(self, "remote_hash_keys", None) or {}).get(
+                node.source_id
+            )
+            if keys and all(k in sp.names for k in keys):
+                sp = self.hash_exchange(sp, keys)
+            return sp
         if isinstance(node, P.TableScan):
+            if node.split is not None:
+                # a fleet split-bound scan covers [start, start+count)
+                # only: run the local split scan, then shard it (the
+                # whole-table dist cache would double-count)
+                return self.scatter(self._TableScan(node))
             return self._scan_dist(node)
         if isinstance(node, P.Exchange):
             if node.partitioning == "hash":
@@ -387,9 +407,12 @@ class MeshExecutor(LocalExecutor):
         mask = self._shard_split(np.ones(n, dtype=np.bool_), n, per, cap)
         return ShardedPage(list(page.names), cols, mask, self.n_shards)
 
-    def _broadcast_page(self, node: P.Exchange) -> Page:
-        """Resolve an Exchange(broadcast) source into one local Page
-        (replicated into SPMD programs via a P() in_spec)."""
+    def _broadcast_page(self, node) -> Page:
+        """Resolve an Exchange(broadcast) — or, in a fleet fragment, a
+        RemoteSource standing for a cut broadcast exchange — into one
+        local Page (replicated into SPMD programs via a P() in_spec)."""
+        if isinstance(node, P.RemoteSource):
+            return self._compact(self._RemoteSource(node))
         if node.input_dist == "single":
             return self._compact(self.execute(node.source))
         return self.gather(self.execute_dist(node.source))
@@ -673,6 +696,36 @@ class MeshExecutor(LocalExecutor):
     def _dist_join(self, node: P.Join) -> ShardedPage:
         if node.kind == "cross":
             return self._dist_cross(node)
+        if not node.criteria:
+            # non-equi join: no key to co-partition on — gather both
+            # sides and run the local nested-loop path, then re-shard
+            # (the reference replicates the build side into
+            # NestedLoopJoinOperator; at this shape the local tier is
+            # the honest cost)
+            if node.kind == "right":
+                node = P.Join(
+                    node.outputs, kind="left", left=node.right,
+                    right=node.left, criteria=[], filter=node.filter,
+                    df_range_keep=None, df_keep_frac=None,
+                )
+
+            def page_of(n: P.PlanNode) -> Page:
+                if isinstance(n, P.RemoteSource):
+                    return self._RemoteSource(n)
+                if (
+                    isinstance(n, P.Exchange)
+                    and n.partitioning == "broadcast"
+                ):
+                    return self._broadcast_page(n)
+                return self.gather(self.execute_dist(n))
+
+            return self.scatter(
+                self._nested_loop_join(
+                    node,
+                    self._compact(page_of(node.left)),
+                    self._compact(page_of(node.right)),
+                )
+            )
         kind, criteria = node.kind, list(node.criteria)
         if node.distribution == "BROADCAST":
             probe = self.execute_dist(node.left)
@@ -738,6 +791,10 @@ class MeshExecutor(LocalExecutor):
         and uniform dense keys — where min/max never prunes — still
         drop. Multi-key criteria use the hash-combined key, so false
         positives pass through harmlessly to the real join."""
+        from trino_tpu import session_properties as SP
+
+        if not SP.get(self.session, "dynamic_filtering_enabled"):
+            return probe
         axis = self.axis
         if probe.shard_capacity * probe.n_shards < self.DF_MIN_PROBE:
             return probe

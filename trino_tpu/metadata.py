@@ -46,6 +46,9 @@ class Metadata:
         from trino_tpu.security import AllowAllAccessControl
 
         self.access_control = AllowAllAccessControl()
+        #: EventListener SPI instances (SPI/eventlistener/): notified
+        #: of query completion by every runner sharing this metadata
+        self.event_listeners: list = []
 
     def create_view(self, qualified, query, or_replace: bool = False):
         if qualified in self._views and not or_replace:

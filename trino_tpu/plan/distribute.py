@@ -56,14 +56,13 @@ class _Ctx:
     def __init__(self, metadata: Metadata, n_shards: int, session):
         self.md = metadata
         self.n_shards = max(int(n_shards), 2)
-        props = session.properties if session is not None else {}
+        from trino_tpu import session_properties as SP
+
         self.mode = str(
-            props.get("join_distribution_type", "AUTOMATIC")
+            SP.get(session, "join_distribution_type")
         ).upper()
         self.broadcast_limit = float(
-            props.get(
-                "broadcast_join_row_limit", DEFAULT_BROADCAST_ROW_LIMIT
-            )
+            SP.get(session, "broadcast_join_row_limit")
         )
         self.stats_cache: dict = {}
 

@@ -38,6 +38,10 @@ class StageInput:
     #: "all" — every task reads the producer's full output (gather /
     #: broadcast)
     mode: str
+    #: the producing stage's hash-partition keys (aligned mode): a
+    #: mesh-owning worker re-exchanges the partition locally on these
+    #: so its shards are key-disjoint (fleet x mesh composition)
+    hash_symbols: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -95,7 +99,12 @@ class _Fragmenter:
 
     def _remote(self, stage: Stage, child: Stage, outputs, mode: str):
         sid = f"rs{child.stage_id}"
-        stage.inputs.append(StageInput(sid, child.stage_id, mode))
+        stage.inputs.append(
+            StageInput(
+                sid, child.stage_id, mode,
+                hash_symbols=list(child.hash_symbols),
+            )
+        )
         return P.RemoteSource(dict(outputs), source_id=sid)
 
     def _cut(self, node: P.PlanNode, stage: Stage) -> P.PlanNode:

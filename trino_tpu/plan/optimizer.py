@@ -47,9 +47,13 @@ def optimize(plan: P.PlanNode, metadata: Metadata, session: Session) -> P.PlanNo
     plan = _rewrite_bottom_up(plan, _factor_filter_ors)
     plan = _rewrite_bottom_up(plan, lambda n: _extract_joins(n, metadata))
     plan = _push_predicates(plan, metadata)
-    plan = _reorder_inner_joins(plan, metadata)
-    # residual conjuncts hoisted by the reorder re-push onto the new tree
-    plan = _push_predicates(plan, metadata)
+    from trino_tpu import session_properties as SP
+
+    if SP.get(session, "join_reordering_strategy") != "NONE":
+        plan = _reorder_inner_joins(plan, metadata)
+        # residual conjuncts hoisted by the reorder re-push onto the
+        # new tree
+        plan = _push_predicates(plan, metadata)
     plan = _rewrite_bottom_up(plan, _push_semijoin_filters)
     plan = _choose_build_sides(plan, metadata)
     plan = _prune_columns(plan)

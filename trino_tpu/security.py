@@ -36,6 +36,26 @@ class AccessControl:
     def check_can_select(self, user: str, catalog: str, schema: str, table: str):
         pass
 
+    # -- view expressions (SPI/security/ViewExpression.java): SQL text
+    # the analyzer parses and applies at every table reference --------
+
+    def get_row_filters(
+        self, user: str, catalog: str, schema: str, table: str
+    ) -> list[str]:
+        """SQL boolean expressions over the table's columns; rows
+        failing ANY filter are invisible to this user (the analyzer
+        ANDs them into the scan, like the reference applies
+        ViewExpressions in StatementAnalyzer)."""
+        return []
+
+    def get_column_mask(
+        self, user: str, catalog: str, schema: str, table: str,
+        column: str, type_,
+    ) -> str | None:
+        """SQL expression replacing ``column`` for this user (same
+        type), or None for no mask."""
+        return None
+
     def check_can_insert(self, user: str, catalog: str, schema: str, table: str):
         pass
 
@@ -63,6 +83,12 @@ class Rule:
     schema: str = "*"
     table: str = "*"
     privileges: tuple = PRIVILEGES
+    #: SQL boolean expression over the table's columns limiting which
+    #: rows this identity sees (file-based access control's ``filter``)
+    row_filter: str | None = None
+    #: column name -> SQL masking expression (``mask`` in the file
+    #: rules; must type like the column)
+    column_masks: dict = field(default_factory=dict)
 
     def matches(self, user, catalog, schema, table) -> bool:
         return (
@@ -105,3 +131,15 @@ class RuleBasedAccessControl(AccessControl):
 
     def check_can_ddl(self, user, catalog, schema, table):
         self._check("ddl", user, catalog, schema, table)
+
+    def get_row_filters(self, user, catalog, schema, table):
+        for r in self.rules:
+            if r.matches(user, catalog, schema, table):
+                return [r.row_filter] if r.row_filter else []
+        return []
+
+    def get_column_mask(self, user, catalog, schema, table, column, type_):
+        for r in self.rules:
+            if r.matches(user, catalog, schema, table):
+                return r.column_masks.get(column)
+        return None

@@ -52,6 +52,11 @@ class WorkerServer:
         self.runner = runner
         self._tasks: dict[str, _Task] = {}
         self._lock = threading.Lock()
+        #: lifecycle: ACTIVE -> DRAINING (no new tasks, in-flight
+        #: finish) -> DRAINED (the GracefulShutdownHandler states,
+        #: MAIN/server/GracefulShutdownHandler.java:42)
+        self.state = "ACTIVE"
+        self._active_tasks = 0
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -68,7 +73,21 @@ class WorkerServer:
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(n))
+                req = json.loads(self.rfile.read(n)) if n else {}
+                if self.path == "/v1/drain":
+                    worker.drain()
+                    self._send(200, {"state": worker.lifecycle_state()})
+                    return
+                if self.path in ("/v1/task", "/v1/stagetask"):
+                    if worker.state != "ACTIVE":
+                        # draining workers accept no new work; the
+                        # coordinator reschedules elsewhere (409 =
+                        # "not dead, just leaving")
+                        self._send(409, {
+                            "error": "worker is draining",
+                            "state": worker.lifecycle_state(),
+                        })
+                        return
                 if self.path == "/v1/task":
                     task = worker.submit(req)
                     self._send(200, {"taskId": task.task_id})
@@ -112,10 +131,28 @@ class WorkerServer:
                 ):
                     self._task_status(parts[2], None)
                     return
+                if parts == ["v1", "stacks"]:
+                    # operator diagnosis: every thread's current stack
+                    # (jstack analog — TaskResource has no equivalent;
+                    # the JVM gets this from the runtime)
+                    import sys as _sys
+                    import traceback as _tb
+
+                    frames = {
+                        str(tid): _tb.format_stack(frame)
+                        for tid, frame in _sys._current_frames().items()
+                    }
+                    self._send(200, {"stacks": frames})
+                    return
                 if parts == ["v1", "info"]:
+                    mesh = worker.runner.mesh
                     self._send(200, {
-                        "state": "ACTIVE",
-                        "mesh": worker.runner.mesh is not None,
+                        "state": worker.lifecycle_state(),
+                        "activeTasks": worker._active_tasks,
+                        "mesh": mesh is not None,
+                        "devices": (
+                            1 if mesh is None else int(mesh.devices.size)
+                        ),
                     })
                     return
                 self._send(404, {"error": "not found"})
@@ -143,6 +180,29 @@ class WorkerServer:
         self._httpd.shutdown()
         self._httpd.server_close()
 
+    # ---- lifecycle (graceful drain) --------------------------------------
+
+    def drain(self) -> None:
+        """Enter DRAINING: refuse new tasks, let in-flight ones finish
+        (GracefulShutdownHandler.requestShutdown analog — without the
+        process exit, which the operator owns)."""
+        with self._lock:
+            if self.state == "ACTIVE":
+                self.state = "DRAINING"
+
+    def lifecycle_state(self) -> str:
+        if self.state == "DRAINING" and self._active_tasks == 0:
+            return "DRAINED"
+        return self.state
+
+    def _task_started(self):
+        with self._lock:
+            self._active_tasks += 1
+
+    def _task_finished(self):
+        with self._lock:
+            self._active_tasks -= 1
+
     # ---- task execution --------------------------------------------------
 
     def submit(self, req: dict) -> _Task:
@@ -164,6 +224,7 @@ class WorkerServer:
         )
 
         def run():
+            self._task_started()
             try:
                 from trino_tpu.exec.spool import page_to_host
 
@@ -179,7 +240,10 @@ class WorkerServer:
                 with self.runner._lock:
                     # session overrides apply under the execute lock and
                     # restore afterwards: concurrent tasks must not see
-                    # (or inherit) each other's settings
+                    # (or inherit) each other's settings. The host
+                    # materialization stays under the lock too — XLA
+                    # must never run from two worker threads at once
+                    # (see submit_stage)
                     saved = dict(self.runner.session.properties)
                     self.runner.session.properties.update(
                         req.get("session") or {}
@@ -188,14 +252,15 @@ class WorkerServer:
                     ex.cancel_event = task.cancel
                     try:
                         page = ex.execute(plan)
+                        # materialize ONCE to packed host columns;
+                        # batches JSON-encode windows of these arrays
+                        # on demand (the previous whole-result
+                        # json.dumps was the OOM the round-3 VERDICT
+                        # flagged, weak #4)
+                        payload = page_to_host(page)
                     finally:
                         ex.cancel_event = None
                         self.runner.session.properties = saved
-                # materialize ONCE to packed host columns; batches
-                # JSON-encode windows of these arrays on demand (the
-                # previous whole-result json.dumps was the OOM the
-                # round-3 VERDICT flagged, weak #4)
-                payload = page_to_host(page)
                 with self._lock:
                     # a DELETE that raced past the last executor cancel
                     # checkpoint must still win: never commit a result
@@ -216,6 +281,8 @@ class WorkerServer:
                     "CANCELED" if task.cancel.is_set() else "FAILED"
                 )
                 task.payload = None
+            finally:
+                self._task_finished()
 
         threading.Thread(target=run, daemon=True).start()
         return task
@@ -250,6 +317,7 @@ class WorkerServer:
             self._tasks[tkey] = task
 
         def run():
+            self._task_started()
             try:
                 if req.get("fail"):
                     raise InjectedTaskFailure(
@@ -270,35 +338,62 @@ class WorkerServer:
                 plan = plan_from_json(req["plan"])
                 root = req["spool"]
                 partition = req.get("partition")
-                pages = {}
-                for src in req["sources"]:
-                    part = partition if src["mode"] == "aligned" else None
-                    payload = spool.read_partition(
-                        root, src["stage_id"], src["task_ids"], part
-                    )
-                    pages[src["source_id"]] = spool.host_to_page(payload)
                 out = req["output"]
+                # ALL device/XLA work — input page builds, execution,
+                # output device_get — stays under the runner lock: a
+                # worker process must never drive XLA:CPU from two
+                # threads at once (a concurrent compile +
+                # deserialize_executable wedges inside the backend;
+                # observed as a permanently stuck task thread)
                 with self.runner._lock:
+                    pages = {}
+                    for src in req["sources"]:
+                        part = (
+                            partition if src["mode"] == "aligned" else None
+                        )
+                        payload = spool.read_partition(
+                            root, src["stage_id"], src["task_ids"], part
+                        )
+                        pages[src["source_id"]] = spool.host_to_page(
+                            payload
+                        )
                     saved = dict(self.runner.session.properties)
                     self.runner.session.properties.update(
                         req.get("session") or {}
                     )
                     ex = self.runner.executor
                     ex.remote_pages = pages
+                    ex.remote_hash_keys = {
+                        src["source_id"]: src.get("hash_symbols") or []
+                        for src in req["sources"]
+                    }
                     try:
-                        page = ex.execute(plan)
+                        if self.runner.mesh is not None:
+                            # fleet x mesh: the fragment runs SPMD over
+                            # this worker's device mesh (scatter inputs,
+                            # local collectives, gather to spool)
+                            try:
+                                page = ex.gather(ex.execute_dist(plan))
+                            except NotImplementedError:
+                                page = ex.execute(plan)
+                        else:
+                            page = ex.execute(plan)
+                        spool.write_task_output(
+                            root, out["stage_id"], req["task_id"],
+                            int(req["attempt"]), page,
+                            out["partitioning"], out["hash_symbols"],
+                            int(out["n_partitions"]),
+                        )
                     finally:
                         ex.remote_pages = {}
+                        ex.remote_hash_keys = {}
                         self.runner.session.properties = saved
-                spool.write_task_output(
-                    root, out["stage_id"], req["task_id"],
-                    int(req["attempt"]), page, out["partitioning"],
-                    out["hash_symbols"], int(out["n_partitions"]),
-                )
                 task.state = "FINISHED"
             except Exception as e:
                 task.error = f"{type(e).__name__}: {e}"
                 task.state = "FAILED"
+            finally:
+                self._task_finished()
 
         threading.Thread(target=run, daemon=True).start()
         return task
@@ -387,6 +482,7 @@ def _encode_batch(task: _Task, token: int, batch_rows: int) -> dict:
 
 def main():
     import argparse
+    import os
     import sys
 
     ap = argparse.ArgumentParser()
@@ -395,6 +491,27 @@ def main():
     ap.add_argument("--schema", default="tiny")
     ap.add_argument("--mesh", action="store_true")
     args = ap.parse_args()
+    if os.environ.get("JAX_PLATFORMS"):
+        # a site-installed accelerator plugin may overwrite
+        # jax_platforms at interpreter startup — re-pin to the
+        # requested platform so JAX_PLATFORMS=cpu +
+        # xla_force_host_platform_device_count=N yields an N-device
+        # virtual mesh (the DistributedQueryRunner trick, see
+        # tests/conftest.py)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # NO persistent compile cache in worker processes:
+    # backend.deserialize_executable wedges permanently (observed
+    # repeatedly) when invoked from worker task threads — even
+    # single-threaded, even against a cache directory this same
+    # process just wrote. The in-memory jit cache still amortizes
+    # compiles across a worker's lifetime; only cross-restart warmth
+    # is lost.
+    import jax as _jax
+
+    if _jax.config.jax_compilation_cache_dir:
+        _jax.config.update("jax_compilation_cache_dir", None)
     mesh = None
     if args.mesh:
         from trino_tpu.parallel.core import make_mesh

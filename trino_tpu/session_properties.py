@@ -1,0 +1,222 @@
+"""System session properties: the typed, validated, discoverable
+knob registry.
+
+The analog of the reference's SystemSessionProperties
+(MAIN/SystemSessionProperties.java, ~200 properties): every property
+has a type, a default, a description, and a validator; SET SESSION
+rejects unknown names and mistyped values at statement time instead of
+failing (or silently no-op-ing) deep inside execution, and
+SHOW SESSION lists the full surface with current values.
+
+Typed access goes through ``get(session, name)`` so call sites share
+one parse/validate path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+__all__ = [
+    "PropertyMetadata", "SESSION_PROPERTIES", "get", "set_property",
+    "show_rows",
+]
+
+
+@dataclass(frozen=True)
+class PropertyMetadata:
+    name: str
+    description: str
+    sql_type: str  # "boolean" | "bigint" | "double" | "varchar"
+    default: object
+    #: optional extra validation over the typed value
+    validate: Callable[[object], None] | None = None
+    #: internal/test properties hidden from SHOW SESSION
+    hidden: bool = False
+
+
+def _positive(name):
+    def check(v):
+        if v <= 0:
+            raise ValueError(f"{name} must be positive, got {v}")
+
+    return check
+
+
+def _non_negative(name):
+    def check(v):
+        if v < 0:
+            raise ValueError(f"{name} must be >= 0, got {v}")
+
+    return check
+
+
+def _one_of(name, allowed):
+    def check(v):
+        if str(v).upper() not in allowed:
+            raise ValueError(
+                f"{name} must be one of {sorted(allowed)}, got {v!r}"
+            )
+
+    return check
+
+
+_P = PropertyMetadata
+
+SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
+    p.name: p
+    for p in [
+        # ---- memory / spill tier (exec.spill) -------------------------
+        _P(
+            "hbm_budget_bytes",
+            "Device-memory budget; above it scans stream, aggregations "
+            "chunk, and joins take the streamed-probe/grace paths "
+            "(query_max_memory_per_node analog)",
+            "bigint", 0, _non_negative("hbm_budget_bytes"),
+        ),
+        _P(
+            "max_chunk_rows",
+            "Row cap per streamed-scan chunk in the budgeted tier",
+            "bigint", 0, _non_negative("max_chunk_rows"),
+        ),
+        _P(
+            "grace_partitions",
+            "Fan-out of grace-hash-join recursion in the budgeted tier",
+            "bigint", 8, _positive("grace_partitions"),
+        ),
+        # ---- data layout ---------------------------------------------
+        _P(
+            "varchar_hash_ndv",
+            "Distinct-count threshold above which a VARCHAR column "
+            "scans as hash codes instead of a sorted dictionary",
+            "bigint", 1_000_000, _positive("varchar_hash_ndv"),
+        ),
+        # ---- distribution planning (plan.distribute) ------------------
+        _P(
+            "join_distribution_type",
+            "AUTOMATIC (costed), BROADCAST, or PARTITIONED "
+            "(DetermineJoinDistributionType analog)",
+            "varchar", "AUTOMATIC",
+            _one_of(
+                "join_distribution_type",
+                {"AUTOMATIC", "BROADCAST", "PARTITIONED"},
+            ),
+        ),
+        _P(
+            "broadcast_join_row_limit",
+            "Never broadcast a build side estimated above this many "
+            "rows (join_max_broadcast_table_size analog)",
+            "double", 2_000_000.0, _positive("broadcast_join_row_limit"),
+        ),
+        _P(
+            "join_reordering_strategy",
+            "AUTOMATIC (stats-driven) or NONE (syntactic order; "
+            "ReorderJoins analog)",
+            "varchar", "AUTOMATIC",
+            _one_of("join_reordering_strategy", {"AUTOMATIC", "NONE"}),
+        ),
+        # ---- local execution (exec.local) -----------------------------
+        _P(
+            "cross_join_chunk_rows",
+            "Output-row bound per cross-join materialization chunk",
+            "bigint", 8_000_000, _positive("cross_join_chunk_rows"),
+        ),
+        _P(
+            "dynamic_filtering_enabled",
+            "Prune probe rows by build-side key bounds before "
+            "joins (enable_dynamic_filtering analog)",
+            "boolean", True,
+        ),
+        # ---- client/worker protocol -----------------------------------
+        _P(
+            "result_batch_rows",
+            "Rows per paged result batch over the worker protocol",
+            "bigint", 65_536, _positive("result_batch_rows"),
+        ),
+        # ---- fleet / fault tolerance ----------------------------------
+        _P(
+            "retry_max_attempts",
+            "Attempts per fleet task before the query fails "
+            "(task_retry_attempts_per_task analog)",
+            "bigint", 3, _positive("retry_max_attempts"),
+        ),
+        # ---- test/failure injection (hidden) --------------------------
+        _P(
+            "task_delay_ms",
+            "Test hook: delay before worker task execution",
+            "double", 0.0, _non_negative("task_delay_ms"), hidden=True,
+        ),
+        _P(
+            "fleet_task_delay_ms",
+            "Test hook: delay before fleet stage-task execution",
+            "double", 0.0, _non_negative("fleet_task_delay_ms"),
+            hidden=True,
+        ),
+    ]
+}
+
+
+def _coerce(meta: PropertyMetadata, value):
+    try:
+        if meta.sql_type == "bigint":
+            if isinstance(value, bool):
+                raise ValueError("boolean is not bigint")
+            return int(value)
+        if meta.sql_type == "double":
+            if isinstance(value, bool):
+                raise ValueError("boolean is not double")
+            return float(value)
+        if meta.sql_type == "boolean":
+            if isinstance(value, bool):
+                return value
+            s = str(value).strip().lower()
+            if s in ("true", "1"):
+                return True
+            if s in ("false", "0"):
+                return False
+            raise ValueError(f"not a boolean: {value!r}")
+        return str(value)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"session property {meta.name} is {meta.sql_type}: {e}"
+        ) from None
+
+
+def set_property(session, name: str, value) -> None:
+    """Validate + store one property (the SET SESSION entry point)."""
+    meta = SESSION_PROPERTIES.get(name)
+    if meta is None:
+        raise ValueError(f"unknown session property: {name}")
+    typed = _coerce(meta, value)
+    if meta.validate is not None:
+        meta.validate(typed)
+    session.properties[name] = typed
+
+
+def get(session, name: str):
+    """Typed current value (session override or registry default)."""
+    meta = SESSION_PROPERTIES[name]
+    raw = (session.properties if session is not None else {}).get(name)
+    if raw is None:
+        return meta.default
+    typed = _coerce(meta, raw)
+    if meta.validate is not None:
+        meta.validate(typed)
+    return typed
+
+
+def show_rows(session) -> list[tuple]:
+    """SHOW SESSION rows: (name, value, default, type, description)."""
+    out = []
+    for name in sorted(SESSION_PROPERTIES):
+        meta = SESSION_PROPERTIES[name]
+        if meta.hidden:
+            continue
+        out.append((
+            name,
+            str(get(session, name)),
+            str(meta.default),
+            meta.sql_type,
+            meta.description,
+        ))
+    return out

@@ -423,6 +423,16 @@ class SessionSet(Statement):
 
 
 @dataclass
+class SessionReset(Statement):
+    name: str
+
+
+@dataclass
+class ShowSession(Statement):
+    pass
+
+
+@dataclass
 class Use(Statement):
     parts: tuple[str, ...]
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 from trino_tpu.sql import ast
 from trino_tpu.sql.lexer import SqlSyntaxError, Token, tokenize
 
-__all__ = ["parse_statement", "SqlSyntaxError"]
+__all__ = ["parse_statement", "parse_expression", "SqlSyntaxError"]
 
 
 def parse_statement(sql: str) -> ast.Statement:
@@ -22,6 +22,15 @@ def parse_statement(sql: str) -> ast.Statement:
     p.accept_op(";")
     p.expect_eof()
     return stmt
+
+
+def parse_expression(sql: str) -> ast.Expr:
+    """Parse one standalone scalar expression (row filters / column
+    masks — the SPI ViewExpression surface carries SQL text)."""
+    p = _Parser(tokenize(sql))
+    e = p.expr()
+    p.expect_eof()
+    return e
 
 
 class _Parser:
@@ -109,6 +118,8 @@ class _Parser:
             analyze = self.accept_kw("analyze")
             return ast.Explain(self.statement(), analyze=analyze)
         if self.accept_kw("show"):
+            if self.accept_kw("session"):
+                return ast.ShowSession()
             if self.accept_kw("catalogs"):
                 return ast.ShowCatalogs()
             if self.accept_kw("schemas"):
@@ -125,6 +136,13 @@ class _Parser:
             return ast.DescribeTable(self.qualified_name())
         if self.accept_kw("use"):
             return ast.Use(self.qualified_name())
+        if self._at_ident("reset") or self.at_kw("reset"):
+            self.next()
+            self.expect_kw("session")
+            name_parts = [self.ident()]
+            while self.accept_op("."):
+                name_parts.append(self.ident())
+            return ast.SessionReset(".".join(name_parts))
         if self.accept_kw("set"):
             self.expect_kw("session")
             name_parts = [self.ident()]
