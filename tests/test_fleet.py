@@ -253,6 +253,10 @@ def test_fleet_recovers_from_hung_worker_sigstop(workers, spool_root, oracle):
             spool_root=spool_root, n_partitions=4,
             rpc_timeout_s=2.0, max_poll_fails=3,
         )
+        # this test exercises the DEATH-DETECTION path specifically:
+        # with speculation on, a backup attempt would win first and
+        # the hung worker would never accumulate poll failures
+        fleet.session.properties["speculation_enabled"] = False
         fleet.session.properties["fleet_task_delay_ms"] = 200
         state = {"stopped": False}
 
@@ -336,6 +340,9 @@ def test_fleet_survives_worker_kill9(workers, spool_root, oracle):
         result.rows, expected, ordered=result.ordered, abs_tol=0.006
     )
     assert not fleet.workers[0].alive  # victim excluded
+    # the orphaned task went back through the retry path, and the
+    # QueryResult reports it
+    assert result.tasks_retried >= 1
     victim.wait(timeout=10)
 
 
@@ -384,4 +391,179 @@ def test_fleet_spool_survives_producer_death(workers, spool_root, oracle):
     if state["killed"]:
         victim.wait(timeout=10)
     else:
+        victim.kill()
+
+
+def test_fleet_speculative_execution_beats_straggler(
+    workers, spool_root, oracle
+):
+    """SIGSTOP a worker holding a task while death detection is tuned
+    SLOW (15 polls x 2 s): the tail-latency hedge must kick in first —
+    a backup attempt launched on an idle worker once the task's age
+    exceeds speculation_multiplier x the stage's median runtime — and
+    the backup's commit must win the query well before the hung worker
+    would be declared dead."""
+    victim_port = BASE_PORT + 6
+    victim = _spawn_worker(victim_port)
+    victim_uri = f"http://127.0.0.1:{victim_port}"
+    try:
+        md = Metadata()
+        md.register_catalog("tpch", TpchConnector())
+        fleet = FleetRunner(
+            [victim_uri] + list(workers),
+            md, Session(catalog="tpch", schema="tiny"),
+            spool_root=spool_root, n_partitions=4,
+            rpc_timeout_s=2.0, max_poll_fails=15,
+        )
+        fleet.session.properties["fleet_task_delay_ms"] = 200
+        fleet.session.properties["speculation_multiplier"] = 1.5
+        state = {"stopped": False}
+
+        def post_hook(stage_id, task_id, w):
+            if not state["stopped"] and victim_uri in w.uri:
+                os.kill(victim.pid, signal.SIGSTOP)
+                state["stopped"] = True
+
+        fleet.post_hook = post_hook
+        sql = (
+            "select o_orderpriority, count(*) from orders "
+            "group by o_orderpriority order by 1"
+        )
+        t0 = time.monotonic()
+        result = fleet.execute(sql)
+        elapsed = time.monotonic() - t0
+        assert state["stopped"], "victim never received a task"
+        assert result.tasks_speculated >= 1
+        assert result.speculation_wins >= 1
+        # far inside the 15 * 2 s death-detection budget: the hedge,
+        # not failure detection, is what unblocked the query
+        assert elapsed < 25, f"speculation took {elapsed:.1f}s"
+        expected = oracle.execute(to_sqlite(sql)).fetchall()
+        assert_rows_match(
+            result.rows, expected, ordered=result.ordered, abs_tol=1e-9
+        )
+    finally:
+        try:
+            os.kill(victim.pid, signal.SIGCONT)
+        except OSError:
+            pass
+        victim.kill()
+
+
+def test_fleet_retry_backoff_is_jittered_and_seeded(fleet, oracle):
+    """Failed-task retries wait an exponential-backoff delay with full
+    jitter, drawn from a seedable RNG: bounded by the session knobs,
+    observable on the runner, and bit-identical across runs with the
+    same seed."""
+    fleet.inject_failures = {"0:0"}
+    fleet.session.properties["retry_backoff_seed"] = 20260805
+    fleet.session.properties["retry_initial_delay_ms"] = 40
+    fleet.session.properties["retry_max_delay_ms"] = 160
+    sql = (
+        "select o_orderpriority, count(*) from orders "
+        "group by o_orderpriority order by 1"
+    )
+    result = check(fleet, oracle, sql)
+    first = list(fleet.retry_delays)
+    assert result.tasks_retried >= 1
+    assert len(first) >= 1
+    # full jitter: uniform in [0, initial_delay] for a first failure
+    assert all(0.0 <= d <= 0.040 + 1e-9 for d in first), first
+    check(fleet, oracle, sql)
+    assert fleet.retry_delays == first, (
+        "seeded retry jitter must be deterministic across runs"
+    )
+
+
+def test_fleet_nonretryable_error_fails_fast(spool_root):
+    """A deterministic semantic error reported by a worker must fail
+    the query IMMEDIATELY — burning max_attempts on copies of the same
+    error hides the real failure and triples time-to-diagnosis."""
+    from trino_tpu.server.fleet import _retryable
+
+    assert _retryable("InjectedTaskFailure: injected failure")
+    assert _retryable(
+        "SpoolCorruptionError: corrupt spool partition "
+        "stage=0 task=s0t0 attempt=0 file=x.npz: body fails CRC32"
+    )
+    assert _retryable("worker died")
+    assert not _retryable("ValueError: bad literal")
+    assert not _retryable("NotImplementedError: ARRAY over exchange")
+    assert not _retryable("AnalysisError: column not found")
+
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    fleet = FleetRunner(
+        # nothing listens on this port: placement probes fail fast and
+        # the monkeypatched RPCs below never touch the network
+        ["http://127.0.0.1:9"],
+        md, Session(catalog="tpch", schema="tiny"),
+        spool_root=spool_root, n_partitions=2,
+    )
+    fleet._post_task = lambda *a, **k: None
+    fleet._poll_task = lambda w, tid, a: {
+        "state": "FAILED", "error": "ValueError: bad literal"
+    }
+    with pytest.raises(RuntimeError, match="non-retryable"):
+        fleet.execute("select count(*) from nation")
+    assert fleet.stats["tasks_retried"] == 0
+
+
+def test_fleet_readmits_recovered_worker(workers, spool_root, oracle):
+    """A worker evicted for unresponsiveness is not banned forever:
+    once it answers /v1/info again, a backoff-scheduled probe restores
+    it to the placement pool (the recovery half of the
+    HeartbeatFailureDetector loop). Query 1 loses the victim to
+    SIGSTOP; after SIGCONT, query 2 on the same runner must re-admit
+    it."""
+    victim_port = BASE_PORT + 5
+    victim = _spawn_worker(victim_port)
+    victim_uri = f"http://127.0.0.1:{victim_port}"
+    try:
+        md = Metadata()
+        md.register_catalog("tpch", TpchConnector())
+        fleet = FleetRunner(
+            [victim_uri] + list(workers),
+            md, Session(catalog="tpch", schema="tiny"),
+            spool_root=spool_root, n_partitions=4,
+            rpc_timeout_s=1.0, max_poll_fails=3,
+            readmit_initial_s=0.2, readmit_max_s=0.5,
+            readmit_probe_timeout_s=0.5,
+        )
+        fleet.session.properties["speculation_enabled"] = False
+        fleet.session.properties["fleet_task_delay_ms"] = 200
+        state = {"stopped": False}
+
+        def post_hook(stage_id, task_id, w):
+            if not state["stopped"] and victim_uri in w.uri:
+                os.kill(victim.pid, signal.SIGSTOP)
+                state["stopped"] = True
+
+        fleet.post_hook = post_hook
+        sql = (
+            "select o_orderpriority, count(*) from orders "
+            "group by o_orderpriority order by 1"
+        )
+        r1 = fleet.execute(sql)
+        assert state["stopped"], "victim never received a task"
+        mark = [w for w in fleet.workers if victim_uri in w.uri][0]
+        assert not mark.alive  # evicted during query 1
+        assert r1.workers_readmitted == 0
+        # the worker recovers; the NEXT query's probe must find it
+        os.kill(victim.pid, signal.SIGCONT)
+        time.sleep(max(fleet._probe_at.get(mark.uri, 0) -
+                       time.monotonic(), 0) + 0.1)
+        fleet.post_hook = None
+        r2 = fleet.execute(sql)
+        assert r2.workers_readmitted >= 1
+        assert mark.alive and not mark.draining
+        expected = oracle.execute(to_sqlite(sql)).fetchall()
+        assert_rows_match(
+            r2.rows, expected, ordered=r2.ordered, abs_tol=1e-9
+        )
+    finally:
+        try:
+            os.kill(victim.pid, signal.SIGCONT)
+        except OSError:
+            pass
         victim.kill()

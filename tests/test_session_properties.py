@@ -116,6 +116,51 @@ def test_dynamic_filtering_toggle_results_identical(runner):
     assert a.rows == b.rows
 
 
+def test_parse_data_size():
+    assert SP.parse_data_size("1GB") == 1 << 30
+    assert SP.parse_data_size("512MB") == 512 << 20
+    assert SP.parse_data_size("2.5kB") == int(2.5 * 1024)
+    assert SP.parse_data_size("1TB") == 1 << 40
+    assert SP.parse_data_size("123") == 123  # bare byte count
+    assert SP.parse_data_size(" 1 GB ") == 1 << 30
+    with pytest.raises(ValueError, match="invalid data size"):
+        SP.parse_data_size("a lot")
+    with pytest.raises(ValueError, match="invalid data size"):
+        SP.parse_data_size("GB")
+    with pytest.raises(ValueError):
+        SP.parse_data_size("-1GB")
+
+
+def test_memory_governance_properties(runner):
+    """query_max_memory / query_max_memory_per_node: validated and
+    visible (enforcement is a ROADMAP open item)."""
+    runner.execute("set session query_max_memory = '4GB'")
+    rows = {r[0]: r for r in runner.execute("show session").rows}
+    assert rows["query_max_memory"][1] == "4GB"
+    assert rows["query_max_memory"][2] == "20GB"  # default
+    assert rows["query_max_memory_per_node"][1] == "2GB"
+    with pytest.raises(ValueError, match="invalid data size"):
+        runner.execute("set session query_max_memory = 'plenty'")
+    runner.execute("reset session query_max_memory")
+
+
+def test_fault_tolerance_knobs_validated():
+    s = Session()
+    assert SP.get(s, "speculation_enabled") is True
+    assert SP.get(s, "speculation_multiplier") == 3.0
+    assert SP.get(s, "speculation_min_task_age_ms") == 500
+    assert SP.get(s, "retry_initial_delay_ms") == 100
+    assert SP.get(s, "retry_max_delay_ms") == 5000
+    with pytest.raises(ValueError, match="positive"):
+        SP.set_property(s, "speculation_multiplier", 0)
+    with pytest.raises(ValueError, match=">= 0"):
+        SP.set_property(s, "retry_initial_delay_ms", -1)
+    with pytest.raises(ValueError, match="positive"):
+        SP.set_property(s, "retry_max_delay_ms", 0)
+    SP.set_property(s, "speculation_enabled", "false")
+    assert SP.get(s, "speculation_enabled") is False
+
+
 # ---- event listeners (SPI/eventlistener analog) --------------------------
 
 def test_query_completed_events(runner):

@@ -40,6 +40,16 @@ class QueryResult:
     #: True when the query had a top-level ORDER BY (rows are ordered)
     ordered: bool = False
     plan: P.PlanNode | None = field(default=None, repr=False)
+    #: fleet fault-tolerance counters (QueryStats analog): how many
+    #: task attempts were re-queued after a failure, how many backup
+    #: attempts were hedged against stragglers, how many of those
+    #: backups committed first, and how many evicted workers rejoined.
+    #: Always 0 outside fleet mode; tests use them to prove a recovery
+    #: path actually fired rather than the query quietly sailing past
+    tasks_retried: int = 0
+    tasks_speculated: int = 0
+    speculation_wins: int = 0
+    workers_readmitted: int = 0
 
 
 class QueryRunner:

@@ -31,6 +31,15 @@ form numpy array (ints/doubles/bools/two-limb decimals as-is, VARCHAR
 decoded to strings so no dictionary crosses the wire) + optional
 validity array, with a JSON schema header — the PagesSerdeFactory
 analog (MAIN/execution/buffer/PagesSerdeFactory.java:35).
+
+Integrity: every partition file carries an 8-byte header (magic +
+CRC32 of the npz body), and the ``.done`` marker records a manifest
+of the attempt's files with whole-file checksums. ``read_partition``
+verifies both and raises :class:`SpoolCorruptionError` on any
+mismatch, truncation, or missing file — corrupted durable state is a
+detected FAULT, never silently read as data (the reference checksums
+spooled exchange pages the same way,
+plugin/trino-exchange-filesystem/.../FileSystemExchangeManager.java).
 """
 
 from __future__ import annotations
@@ -38,6 +47,8 @@ from __future__ import annotations
 import io
 import json
 import os
+import struct
+import zlib
 
 import numpy as np
 
@@ -47,7 +58,42 @@ from trino_tpu.page import Column, Page, pad_capacity
 __all__ = [
     "write_task_output", "read_partition", "partition_ids",
     "page_to_host", "host_to_page", "committed_attempt",
+    "SpoolCorruptionError", "quarantine_attempt", "next_attempt",
 ]
+
+
+#: partition-file header: magic + CRC32-of-body, little-endian
+_MAGIC = b"SPL1"
+_HEADER = struct.Struct("<4sI")
+
+
+class SpoolCorruptionError(RuntimeError):
+    """A spooled partition file failed integrity verification.
+
+    The message carries machine-parseable ``stage=`` / ``task=`` /
+    ``attempt=`` tokens (see :func:`read_partition`) so a scheduler
+    seeing the error — possibly serialized through a worker's FAILED
+    state — can map the corrupt bytes back to the PRODUCING task and
+    re-run it: FTE exchange-data-loss recovery, not just task retry.
+    """
+
+    def __init__(
+        self, detail: str, *, stage_id: str | None = None,
+        task_id: str | None = None, attempt: int | None = None,
+        path: str | None = None,
+    ):
+        self.stage_id = stage_id
+        self.task_id = task_id
+        self.attempt = attempt
+        self.path = path
+        msg = detail
+        if stage_id is not None:
+            msg = (
+                f"corrupt spool partition stage={stage_id} "
+                f"task={task_id} attempt={attempt} "
+                f"file={os.path.basename(path or '?')}: {detail}"
+            )
+        super().__init__(msg)
 
 
 # ---- deterministic row hashing --------------------------------------------
@@ -199,7 +245,9 @@ def _concat_payloads(payloads: list[dict]) -> dict:
 
 # ---- file format -----------------------------------------------------------
 
-def _save_npz(path: str, payload: dict, sel: np.ndarray) -> None:
+def _save_npz(path: str, payload: dict, sel: np.ndarray) -> int:
+    """Write one checksummed partition file; returns the CRC32 of the
+    complete on-disk file (header + body) for the commit manifest."""
     arrays = {}
     schema = []
     for i, (t, (values, valid)) in enumerate(
@@ -224,22 +272,53 @@ def _save_npz(path: str, payload: dict, sel: np.ndarray) -> None:
     )
     buf = io.BytesIO()
     np.savez(buf, **arrays)
+    body = buf.getvalue()
+    header = _HEADER.pack(_MAGIC, zlib.crc32(body))
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
+        f.write(header)
+        f.write(body)
     os.replace(tmp, path)
+    return zlib.crc32(body, zlib.crc32(header))
 
 
-def _load_npz(path: str) -> dict:
-    with np.load(path, allow_pickle=False) as z:
-        schema = json.loads(bytes(z["schema"].tobytes()).decode())
-        names, types, cols = [], [], []
-        for i, col in enumerate(schema):
-            names.append(col["name"])
-            types.append(T.type_from_name(col["type"]))
-            data = z[f"d{i}"]
-            valid = z[f"v{i}"] if col["valid"] else None
-            cols.append((data, valid))
+def _load_npz(path: str, expect_crc: int | None = None) -> dict:
+    """Load + verify one partition file. ``expect_crc`` is the
+    whole-file checksum from the commit manifest (when available);
+    the embedded header CRC is always checked. Any mismatch,
+    truncation, or unparseable payload raises SpoolCorruptionError
+    (bare — read_partition re-raises with producer coordinates)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        raise SpoolCorruptionError(f"partition file missing: {path}")
+    if expect_crc is not None and zlib.crc32(raw) != expect_crc:
+        raise SpoolCorruptionError(
+            "file checksum does not match commit manifest"
+        )
+    if len(raw) < _HEADER.size or raw[:4] != _MAGIC:
+        raise SpoolCorruptionError("bad partition-file header")
+    (_, crc) = _HEADER.unpack_from(raw)
+    body = raw[_HEADER.size:]
+    if zlib.crc32(body) != crc:
+        raise SpoolCorruptionError("partition body fails CRC32")
+    try:
+        with np.load(io.BytesIO(body), allow_pickle=False) as z:
+            schema = json.loads(bytes(z["schema"].tobytes()).decode())
+            names, types, cols = [], [], []
+            for i, col in enumerate(schema):
+                names.append(col["name"])
+                types.append(T.type_from_name(col["type"]))
+                data = z[f"d{i}"]
+                valid = z[f"v{i}"] if col["valid"] else None
+                cols.append((data, valid))
+    except SpoolCorruptionError:
+        raise
+    except Exception as e:
+        # CRC passed but the npz container is unreadable — still a
+        # durable-state fault, not an engine bug to propagate raw
+        raise SpoolCorruptionError(f"unreadable npz payload: {e}")
     return {"names": names, "types": types, "cols": cols}
 
 
@@ -266,27 +345,34 @@ def write_task_output(
     else:
         parts = np.zeros(n, dtype=np.int64)
     written = []
+    manifest: dict[str, int] = {}
     for p in np.unique(parts):
         sel = np.nonzero(parts == p)[0]
-        path = os.path.join(d, f"t{task_id}-a{attempt}-p{int(p)}.npz")
-        _save_npz(path, payload, sel)
+        name = f"t{task_id}-a{attempt}-p{int(p)}.npz"
+        manifest[name] = _save_npz(os.path.join(d, name), payload, sel)
         written.append(int(p))
     if not written:
         # empty output still ships its schema (consumers need a typed
         # zero-row page, the empty-serialized-page analog)
-        path = os.path.join(d, f"t{task_id}-a{attempt}-p0.npz")
-        _save_npz(path, payload, np.zeros(0, dtype=np.int64))
+        name = f"t{task_id}-a{attempt}-p0.npz"
+        manifest[name] = _save_npz(
+            os.path.join(d, name), payload, np.zeros(0, dtype=np.int64)
+        )
         written.append(0)
-    # commit marker last: readers ignore attempts without one
+    # commit marker last: readers ignore attempts without one. The
+    # marker doubles as the attempt's integrity manifest — file list
+    # plus whole-file CRC32s — so a reader detects a swapped,
+    # truncated, or vanished partition file, not just flipped bytes
     marker = os.path.join(d, f"t{task_id}-a{attempt}.done")
     tmp = marker + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"partitions": written}, f)
+        json.dump({"partitions": written, "files": manifest}, f)
     os.replace(tmp, marker)
 
 
 def committed_attempt(root: str, stage_id: str, task_id: str) -> int | None:
-    """Smallest committed attempt of a task, or None."""
+    """Smallest committed attempt of a task, or None. Quarantined
+    attempts (marker renamed ``.done.bad``) are not committed."""
     d = _stage_dir(root, stage_id)
     if not os.path.isdir(d):
         return None
@@ -299,6 +385,47 @@ def committed_attempt(root: str, stage_id: str, task_id: str) -> int | None:
     return best
 
 
+def next_attempt(root: str, stage_id: str, task_id: str) -> int:
+    """1 + the highest attempt number with any on-disk trace
+    (committed, quarantined, or partial) — the attempt number a
+    corruption-recovery re-run must use to avoid colliding with
+    existing files."""
+    d = _stage_dir(root, stage_id)
+    if not os.path.isdir(d):
+        return 0
+    top = -1
+    prefix = f"t{task_id}-a"
+    for f in os.listdir(d):
+        if not f.startswith(prefix):
+            continue
+        rest = f[len(prefix):]
+        digits = ""
+        for ch in rest:
+            if not ch.isdigit():
+                break
+            digits += ch
+        if digits:
+            top = max(top, int(digits))
+    return top + 1
+
+
+def quarantine_attempt(
+    root: str, stage_id: str, task_id: str, attempt: int
+) -> bool:
+    """Withdraw a corrupt attempt from the committed set by renaming
+    its ``.done`` marker to ``.done.bad`` (readers dedupe on ``.done``
+    suffix, so the attempt stops existing for them; the data files
+    stay for forensics). Idempotent: returns False when the marker is
+    already gone."""
+    d = _stage_dir(root, stage_id)
+    marker = os.path.join(d, f"t{task_id}-a{attempt}.done")
+    try:
+        os.replace(marker, marker + ".bad")
+        return True
+    except FileNotFoundError:
+        return False
+
+
 def read_partition(
     root: str, stage_id: str, task_ids: list[str],
     partition: int | None,
@@ -309,6 +436,7 @@ def read_partition(
     d = _stage_dir(root, stage_id)
     payloads = []
     empty = None
+    empty_crc = None
     for tid in task_ids:
         a = committed_attempt(root, stage_id, tid)
         if a is None:
@@ -317,20 +445,37 @@ def read_partition(
             )
         marker = os.path.join(d, f"t{tid}-a{a}.done")
         with open(marker) as f:
-            written = json.load(f)["partitions"]
+            meta = json.load(f)
+        written = meta["partitions"]
+        crcs = meta.get("files", {})
         wanted = written if partition is None else (
             [partition] if partition in written else []
         )
         for p in wanted:
-            payloads.append(
-                _load_npz(os.path.join(d, f"t{tid}-a{a}-p{p}.npz"))
-            )
+            name = f"t{tid}-a{a}-p{p}.npz"
+            try:
+                payloads.append(
+                    _load_npz(os.path.join(d, name), crcs.get(name))
+                )
+            except SpoolCorruptionError as e:
+                raise SpoolCorruptionError(
+                    str(e), stage_id=stage_id, task_id=tid, attempt=a,
+                    path=os.path.join(d, name),
+                ) from None
         if empty is None and written:
             # remember any payload's schema for the empty-result case
-            empty = os.path.join(d, f"t{tid}-a{a}-p{written[0]}.npz")
+            name = f"t{tid}-a{a}-p{written[0]}.npz"
+            empty = os.path.join(d, name)
+            empty_crc = (crcs.get(name), stage_id, tid, a)
     if not payloads:
         if empty is not None:
-            p = _load_npz(empty)
+            try:
+                p = _load_npz(empty, empty_crc[0])
+            except SpoolCorruptionError as e:
+                raise SpoolCorruptionError(
+                    str(e), stage_id=empty_crc[1], task_id=empty_crc[2],
+                    attempt=empty_crc[3], path=empty,
+                ) from None
             return {
                 "names": p["names"], "types": p["types"],
                 "cols": [

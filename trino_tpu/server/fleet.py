@@ -29,6 +29,9 @@ from __future__ import annotations
 
 import json
 import os
+import random
+import re
+import statistics
 import time
 import urllib.error
 import urllib.request
@@ -36,6 +39,7 @@ import uuid
 from collections import deque
 from dataclasses import dataclass
 
+from trino_tpu import session_properties as sp
 from trino_tpu.engine import QueryResult, QueryRunner, _has_order
 from trino_tpu.exec import spool
 from trino_tpu.metadata import Metadata, Session
@@ -44,6 +48,32 @@ from trino_tpu.plan.fragment import Stage, fragment_plan
 from trino_tpu.plan.serde import plan_to_json
 
 __all__ = ["FleetRunner", "FleetWorker"]
+
+
+#: worker-reported exception names that retrying cannot fix: the plan
+#: itself is wrong (semantic/analyzer/unsupported-feature errors are
+#: deterministic — every attempt would fail identically, so the query
+#: fails NOW instead of burning max_attempts on copies of the same
+#: error). Everything else — worker death, InjectedTaskFailure,
+#: SpoolCorruptionError, I/O flakes — is retryable (the reference's
+#: ErrorType.USER_ERROR vs INTERNAL_ERROR retry split,
+#: MAIN/spi/ErrorType.java).
+_NONRETRYABLE_ERRORS = frozenset({
+    "AnalysisError", "SqlSyntaxError", "NotImplementedError",
+    "TypeError", "ValueError", "KeyError", "AttributeError",
+    "AssertionError", "ZeroDivisionError", "IndexError",
+})
+
+#: worker-serialized SpoolCorruptionError messages carry the producing
+#: task's coordinates (exec/spool.py builds them); this maps the
+#: consumer's failure back to the upstream output that must be re-made
+_CORRUPTION_RE = re.compile(
+    r"SpoolCorruptionError.*?stage=(\S+) task=(\S+) attempt=(\d+)"
+)
+
+
+def _retryable(error: str) -> bool:
+    return error.split(":", 1)[0].strip() not in _NONRETRYABLE_ERRORS
 
 
 class _FleetParallelism:
@@ -102,6 +132,9 @@ class FleetRunner:
         max_poll_fails: int = 4,
         stage_hook=None,
         keep_spool: bool = False,
+        readmit_initial_s: float = 0.5,
+        readmit_max_s: float = 8.0,
+        readmit_probe_timeout_s: float = 1.0,
     ):
         self.workers = [FleetWorker(u.rstrip("/")) for u in worker_uris]
         self.metadata = metadata
@@ -135,6 +168,25 @@ class FleetRunner:
         #: (stage_id, task_id, worker) — deterministic point to crash
         #: the worker a task just landed on
         self.post_hook = None
+        #: dead-worker re-admission (the full HeartbeatFailureDetector
+        #: loop, MAIN/failuredetector/HeartbeatFailureDetector.java:76:
+        #: eviction AND recovery): evicted workers are probed via
+        #: /v1/info on an exponential backoff schedule and restored to
+        #: the placement pool when they answer — a bounced worker
+        #: process rejoins mid-query instead of staying banned forever
+        self.readmit_initial_s = readmit_initial_s
+        self.readmit_max_s = readmit_max_s
+        self.readmit_probe_timeout_s = readmit_probe_timeout_s
+        self._probe_at: dict[str, float] = {}
+        self._probe_delay: dict[str, float] = {}
+        #: per-query fault-tolerance counters, copied onto QueryResult
+        self.stats: dict[str, int] = {}
+        #: backoff delays (seconds) actually scheduled by the last
+        #: execute() — observability for tests asserting jitter bounds
+        self.retry_delays: list[float] = []
+        #: task_id -> (Stage, _TaskSpec) from the last _run_dag, kept
+        #: for coordinator-side corruption recovery on the root read
+        self._last_specs: dict[str, tuple[Stage, _TaskSpec]] = {}
         self._planner = QueryRunner(metadata, session)
         #: per-worker device counts from /v1/info (1 when unreachable
         #: or mesh-less); the planner's shard count is the fleet total
@@ -157,11 +209,17 @@ class FleetRunner:
     # ---- query entry -----------------------------------------------------
 
     def execute(self, sql: str) -> QueryResult:
-        self.max_attempts = int(
-            self.session.properties.get(
-                "retry_max_attempts", self._default_max_attempts
-            )
+        raw = self.session.properties.get("retry_max_attempts")
+        self.max_attempts = (
+            int(raw) if raw is not None else self._default_max_attempts
         )
+        self.stats = {
+            "tasks_retried": 0, "tasks_speculated": 0,
+            "speculation_wins": 0, "workers_readmitted": 0,
+        }
+        self.retry_delays = []
+        seed = sp.get(self.session, "retry_backoff_seed")
+        self._retry_rng = random.Random(seed or None)
         plan = self._planner.plan_sql(sql)
         stages = fragment_plan(plan)
         query_id = uuid.uuid4().hex[:12]
@@ -170,21 +228,85 @@ class FleetRunner:
         tasks_by_stage: dict[str, list[str]] = {}
         try:
             self._run_dag(stages, qroot, tasks_by_stage)
-            root = stages[-1]
-            payload = spool.read_partition(
-                qroot, root.stage_id, tasks_by_stage[root.stage_id], None
-            )
+            payload = self._read_root(stages, qroot, tasks_by_stage)
             page = spool.host_to_page(payload)
             rows = page.to_pylist()
             return QueryResult(
                 names=list(page.names), rows=rows,
                 ordered=_has_order(plan), plan=plan,
+                **self.stats,
             )
         finally:
             if not self.keep_spool:
                 import shutil
 
                 shutil.rmtree(qroot, ignore_errors=True)
+
+    def _read_root(
+        self, stages: list[Stage], qroot: str,
+        tasks_by_stage: dict[str, list[str]],
+    ) -> dict:
+        """Read the root stage's output, recovering from spool
+        corruption detected at the COORDINATOR (the window between the
+        last task commit and this read): quarantine the corrupt
+        attempt, synchronously re-run the producing task on a live
+        worker, and read again."""
+        root = stages[-1]
+        for _ in range(self.max_attempts):
+            try:
+                return spool.read_partition(
+                    qroot, root.stage_id,
+                    tasks_by_stage[root.stage_id], None,
+                )
+            except spool.SpoolCorruptionError as e:
+                spool.quarantine_attempt(
+                    qroot, e.stage_id, e.task_id, e.attempt
+                )
+                self._rerun_task(
+                    qroot, tasks_by_stage, e.stage_id, e.task_id
+                )
+        raise RuntimeError(
+            f"root stage {root.stage_id}: spool corruption persisted "
+            f"across {self.max_attempts} recovery attempts"
+        )
+
+    def _rerun_task(
+        self, qroot: str, tasks_by_stage: dict[str, list[str]],
+        stage_id: str, task_id: str,
+    ) -> None:
+        """Synchronously re-run one already-committed task whose spool
+        output was found corrupt after _run_dag returned."""
+        stage, spec = self._last_specs[task_id]
+        attempt = spool.next_attempt(qroot, stage_id, task_id)
+        last_err = "no live worker accepted the re-run"
+        deadline = time.monotonic() + self.timeout_s
+        for w in self.workers:
+            if not w.alive or w.draining:
+                continue
+            try:
+                self._post_task(
+                    w, stage, spec, attempt, qroot, tasks_by_stage
+                )
+            except Exception:
+                continue
+            self.stats["tasks_retried"] += 1
+            while time.monotonic() < deadline:
+                try:
+                    state = self._poll_task(w, spec.task_id, attempt)
+                except Exception as e:
+                    last_err = f"worker died during re-run: {e}"
+                    break
+                if state["state"] == "FINISHED":
+                    return
+                if state["state"] in ("FAILED", "CANCELED"):
+                    last_err = state.get("error", "re-run failed")
+                    break
+                time.sleep(self.poll_s)
+            else:
+                raise TimeoutError("corruption-recovery re-run timed out")
+        raise RuntimeError(
+            f"task {task_id} corruption recovery failed: {last_err}"
+        )
 
     # ---- task construction -----------------------------------------------
 
@@ -206,8 +328,8 @@ class FleetRunner:
             n_live = max(2, sum(1 for w in self.workers if w.alive))
             splits = connector.splits(scan.schema, scan.table, n_live)
             specs = []
-            for i, sp in enumerate(splits):
-                bound = _bind_split(stage.root, scan, (sp.start, sp.count))
+            for i, spl in enumerate(splits):
+                bound = _bind_split(stage.root, scan, (spl.start, spl.count))
                 specs.append(
                     _TaskSpec(
                         f"s{sid}t{i}", plan_to_json(bound), None,
@@ -228,16 +350,36 @@ class FleetRunner:
         self, stages: list[Stage], qroot: str,
         tasks_by_stage: dict[str, list[str]],
     ) -> None:
-        """Schedule ALL stages through one event loop: a stage becomes
-        READY the moment every input stage has committed (spool commits
-        are per-task and atomic), so independent subtrees — the two
-        scan stages under a partitioned join, the branches of a UNION —
-        interleave across the worker pool instead of running as strict
-        sequential waves (the PipelinedQueryScheduler direction,
-        MAIN/execution/scheduler/PipelinedQueryScheduler.java:156,
-        within the FTE stage-commit durability model)."""
+        """Schedule ALL stages through one event loop, subtree-
+        interleaved: a stage is admitted the moment EVERY input stage
+        has fully committed (spool commits are per-task and atomic),
+        so independent subtrees — the two scan stages under a
+        partitioned join, the branches of a UNION — run tasks across
+        the pool concurrently. This is coarser than true pipelining:
+        a consumer never starts while a producer stage is partially
+        committed (partition-level admission is a ROADMAP open item);
+        what overlaps is sibling subtrees, not producer/consumer pairs.
+
+        The loop also owns the fault-tolerance machinery:
+        - retry with exponential backoff + full jitter
+          (retry_initial_delay_ms/retry_max_delay_ms), failures
+          classified so deterministic semantic errors fail the query
+          immediately instead of burning attempts;
+        - speculative execution (Dean & Barroso, "The Tail at Scale"):
+          a RUNNING task older than speculation_multiplier x the
+          median completed-task runtime of its stage gets a backup
+          attempt on an idle worker; first committed attempt wins,
+          the loser is cancelled (spool attempt-dedup makes a raced
+          duplicate commit harmless);
+        - spool-corruption recovery: a consumer failing with
+          SpoolCorruptionError quarantines the corrupt attempt and
+          re-runs the PRODUCING task (exchange-data-loss recovery,
+          not just consumer retry);
+        - dead-worker re-admission: evicted workers are probed on a
+          backoff schedule and rejoin the pool when they answer."""
         by_id = {s.stage_id: s for s in stages}
         specs_of: dict[str, list[_TaskSpec]] = {}
+        spec_by_tid: dict[str, tuple[Stage, _TaskSpec]] = {}
         done_of: dict[str, set] = {s.stage_id: set() for s in stages}
         complete: set[str] = set()
         started: set[str] = set()
@@ -246,9 +388,33 @@ class FleetRunner:
         #: pool with the first stage's tasks and serialize subtrees)
         queues: dict[str, deque] = {}
         rr: deque[str] = deque()  # round-robin order over queues
-        inflight: dict[str, tuple[FleetWorker, Stage, _TaskSpec, int]] = {}
-        attempts: dict[str, int] = {}
+        #: (task_id, attempt) -> (worker, stage, spec, posted-at);
+        #: keyed per ATTEMPT so an original and its speculative backup
+        #: coexist
+        inflight: dict[
+            tuple[str, int], tuple[FleetWorker, Stage, _TaskSpec, float]
+        ] = {}
+        next_attempt_no: dict[str, int] = {}
+        failures: dict[str, int] = {}
+        #: earliest monotonic time a task may be re-dispatched (retry
+        #: backoff); absent = immediately
+        eligible_at: dict[str, float] = {}
+        #: completed-task wall-clock runtimes per stage (speculation's
+        #: straggler threshold)
+        runtimes: dict[str, list[float]] = {}
+        speculative: set[tuple[str, int]] = set()
+        speculated_tids: set[str] = set()
+        quarantined: set[tuple[str, str, int]] = set()
         deadline = time.monotonic() + self.timeout_s
+
+        retry_init_ms = float(sp.get(self.session, "retry_initial_delay_ms"))
+        retry_max_ms = float(sp.get(self.session, "retry_max_delay_ms"))
+        spec_enabled = bool(sp.get(self.session, "speculation_enabled"))
+        spec_mult = float(sp.get(self.session, "speculation_multiplier"))
+        spec_min_age_s = (
+            float(sp.get(self.session, "speculation_min_task_age_ms"))
+            / 1000.0
+        )
 
         def push(stage: Stage, spec: _TaskSpec) -> None:
             sid = stage.stage_id
@@ -260,22 +426,147 @@ class FleetRunner:
         def n_pending() -> int:
             return sum(len(q) for q in queues.values())
 
-        def take_next():
-            """Next (stage, spec) round-robin across non-empty queues."""
+        def ready(stage: Stage) -> bool:
+            return all(i.stage_id in complete for i in stage.inputs)
+
+        def take_next(now: float):
+            """Next dispatchable (stage, spec) round-robin across
+            non-empty queues, skipping tasks still in retry backoff
+            and stages whose inputs regressed (corruption recovery
+            de-completes a producer stage — its consumers hold)."""
             for _ in range(len(rr)):
                 sid = rr[0]
                 rr.rotate(-1)
                 q = queues.get(sid)
-                if q:
-                    return by_id[sid], q.popleft()
+                if not q or not ready(by_id[sid]):
+                    continue
+                for _ in range(len(q)):
+                    spec = q.popleft()
+                    if now < eligible_at.get(spec.task_id, 0.0):
+                        q.append(spec)
+                        continue
+                    return by_id[sid], spec
             return None
 
-        def ready(stage: Stage) -> bool:
-            return all(i.stage_id in complete for i in stage.inputs)
+        def mark_dead(w: FleetWorker) -> None:
+            w.alive = False
+            w.fails = 0
+            self._probe_delay[w.uri] = self.readmit_initial_s
+            self._probe_at[w.uri] = (
+                time.monotonic() + self.readmit_initial_s
+            )
+
+        def other_attempt_inflight(tid: str) -> bool:
+            return any(t == tid for (t, _) in inflight)
+
+        def record_failure(
+            stage: Stage, spec: _TaskSpec, error: str
+        ) -> None:
+            tid = spec.task_id
+            if not _retryable(error):
+                raise RuntimeError(
+                    f"task {tid} failed with non-retryable error "
+                    f"(not retried): {error}"
+                )
+            failures[tid] += 1
+            if failures[tid] >= self.max_attempts:
+                raise RuntimeError(
+                    f"task {tid} failed after {failures[tid]} "
+                    f"attempts: {error}"
+                )
+            # exponential backoff with FULL jitter (delay drawn
+            # uniformly from [0, cap]): retries of correlated failures
+            # decorrelate instead of stampeding the fleet in sync
+            cap = min(
+                retry_max_ms, retry_init_ms * (2 ** (failures[tid] - 1))
+            )
+            delay = self._retry_rng.uniform(0.0, cap) / 1000.0
+            eligible_at[tid] = time.monotonic() + delay
+            self.retry_delays.append(delay)
+            self.stats["tasks_retried"] += 1
+            push(stage, spec)
+
+        def handle_corruption(error: str) -> None:
+            """A consumer task read corrupt spooled input: the fault
+            belongs to the PRODUCING task's committed output. Withdraw
+            the corrupt attempt and re-run the producer at the next
+            attempt number; consumers retry once it recommits."""
+            m = _CORRUPTION_RE.search(error)
+            if m is None:
+                return
+            psid, ptid, pa = m.group(1), m.group(2), int(m.group(3))
+            if (psid, ptid, pa) in quarantined:
+                return
+            quarantined.add((psid, ptid, pa))
+            spool.quarantine_attempt(qroot, psid, ptid, pa)
+            if psid not in by_id or ptid not in spec_by_tid:
+                return
+            if ptid not in done_of[psid]:
+                return  # already re-queued or re-running
+            pstage, pspec = spec_by_tid[ptid]
+            done_of[psid].discard(ptid)
+            complete.discard(psid)
+            failures[ptid] += 1
+            if failures[ptid] >= self.max_attempts:
+                raise RuntimeError(
+                    f"task {ptid} output corrupt after "
+                    f"{failures[ptid]} attempts"
+                )
+            next_attempt_no[ptid] = max(
+                next_attempt_no[ptid],
+                spool.next_attempt(qroot, psid, ptid),
+            )
+            self.stats["tasks_retried"] += 1
+            push(pstage, pspec)
+
+        def cancel_attempt(
+            w: FleetWorker, tid: str, attempt: int
+        ) -> None:
+            # best-effort: a cancel that loses the race to the spool
+            # commit is harmless (attempt dedup)
+            try:
+                req = urllib.request.Request(
+                    f"{w.uri}/v1/stagetask/{tid}.{attempt}",
+                    method="DELETE",
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.rpc_timeout_s
+                ) as r:
+                    r.read()
+            except Exception:
+                pass
 
         while len(complete) < len(stages):
             if time.monotonic() > deadline:
                 raise TimeoutError("query stages timed out")
+            # re-admission probes: evicted workers that answer
+            # /v1/info again rejoin the placement pool
+            now = time.monotonic()
+            for w in self.workers:
+                if w.alive or now < self._probe_at.get(w.uri, 0.0):
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                        f"{w.uri}/v1/info",
+                        timeout=self.readmit_probe_timeout_s,
+                    ) as r:
+                        info = json.loads(r.read())
+                except Exception:
+                    d = min(
+                        self._probe_delay.get(
+                            w.uri, self.readmit_initial_s
+                        ) * 2.0,
+                        self.readmit_max_s,
+                    )
+                    self._probe_delay[w.uri] = d
+                    self._probe_at[w.uri] = time.monotonic() + d
+                    continue
+                w.alive = True
+                w.fails = 0
+                w.draining = info.get("state") != "ACTIVE"
+                self._probe_delay.pop(w.uri, None)
+                self._probe_at.pop(w.uri, None)
+                self.stats["workers_readmitted"] += 1
             # admit newly-ready stages (task construction sees current
             # worker liveness, so it happens at admission, not upfront)
             for stage in stages:
@@ -284,7 +575,9 @@ class FleetRunner:
                 specs = self._make_tasks(stage)
                 specs_of[stage.stage_id] = specs
                 for spec in specs:
-                    attempts[spec.task_id] = 0
+                    next_attempt_no[spec.task_id] = 0
+                    failures[spec.task_id] = 0
+                    spec_by_tid[spec.task_id] = (stage, spec)
                     push(stage, spec)
                 started.add(stage.stage_id)
             live = [w for w in self.workers if w.alive]
@@ -303,7 +596,7 @@ class FleetRunner:
                 # are not in `postable`; counting them would idle free
                 # workers. The `w is None` probe below is the real
                 # "no free worker" exit.
-                nxt = take_next()
+                nxt = take_next(time.monotonic())
                 if nxt is None:
                     break
                 stage, spec = nxt
@@ -313,10 +606,13 @@ class FleetRunner:
                 if w is None:
                     queues[stage.stage_id].appendleft(spec)
                     break
-                a = attempts[spec.task_id]
+                a = next_attempt_no[spec.task_id]
                 try:
                     self._post_task(w, stage, spec, a, qroot, tasks_by_stage)
-                    inflight[spec.task_id] = (w, stage, spec, a)
+                    next_attempt_no[spec.task_id] = a + 1
+                    inflight[(spec.task_id, a)] = (
+                        w, stage, spec, time.monotonic()
+                    )
                     busy.add(id(w))
                     if self.post_hook is not None:
                         self.post_hook(stage.stage_id, spec.task_id, w)
@@ -327,14 +623,18 @@ class FleetRunner:
                         w.draining = True
                         postable = [x for x in postable if x is not w]
                     else:
-                        w.alive = False
+                        mark_dead(w)
                         postable = [x for x in postable if x is not w]
                     queues[stage.stage_id].appendleft(spec)
                 except Exception:
-                    w.alive = False
+                    mark_dead(w)
                     postable = [x for x in postable if x is not w]
                     queues[stage.stage_id].appendleft(spec)
-            for tid, (w, stage, spec, a) in list(inflight.items()):
+            for key, entry in list(inflight.items()):
+                if key not in inflight:
+                    continue  # removed by a dead-worker sweep below
+                (w, stage, spec, t0) = entry
+                tid, a = key
                 try:
                     state = self._poll_task(w, tid, a)
                     w.fails = 0
@@ -351,15 +651,37 @@ class FleetRunner:
                     w.fails += 1
                     if not (refused or w.fails >= self.max_poll_fails):
                         continue  # transient: re-poll next loop
-                    w.alive = False
-                    del inflight[tid]
-                    self._bump_attempt(spec, attempts, "worker died")
-                    push(stage, spec)
+                    mark_dead(w)
+                    # sweep EVERY attempt the dead worker held; a task
+                    # whose sibling attempt survives elsewhere is not
+                    # re-queued (the sibling may still win)
+                    for k2, e2 in list(inflight.items()):
+                        if e2[0] is not w:
+                            continue
+                        del inflight[k2]
+                        st2, sp2 = e2[1], e2[2]
+                        tid2 = sp2.task_id
+                        if tid2 in done_of[st2.stage_id]:
+                            continue
+                        if other_attempt_inflight(tid2):
+                            continue
+                        record_failure(st2, sp2, "worker died")
                     continue
+                sid = stage.stage_id
                 if state["state"] == "FINISHED":
-                    sid = stage.stage_id
+                    del inflight[key]
+                    if tid in done_of[sid]:
+                        continue  # duplicate commit of a raced attempt
                     done_of[sid].add(tid)
-                    del inflight[tid]
+                    runtimes.setdefault(sid, []).append(
+                        time.monotonic() - t0
+                    )
+                    if key in speculative:
+                        self.stats["speculation_wins"] += 1
+                    # first committed attempt wins: cancel the losers
+                    for k2 in [k for k in inflight if k[0] == tid]:
+                        (w2, _, _, _) = inflight.pop(k2)
+                        cancel_attempt(w2, tid, k2[1])
                     if len(done_of[sid]) == len(specs_of[sid]):
                         tasks_by_stage[sid] = [
                             s.task_id for s in specs_of[sid]
@@ -368,22 +690,76 @@ class FleetRunner:
                         if self.stage_hook is not None:
                             self.stage_hook(sid)
                 elif state["state"] == "FAILED":
-                    del inflight[tid]
-                    self._bump_attempt(
-                        spec, attempts, state.get("error", "task failed")
+                    del inflight[key]
+                    error = state.get("error", "task failed")
+                    handle_corruption(error)
+                    if tid in done_of[sid]:
+                        continue  # a sibling attempt already committed
+                    if other_attempt_inflight(tid):
+                        continue  # a sibling attempt may still win
+                    record_failure(stage, spec, error)
+                elif state["state"] == "CANCELED":
+                    # a cancelled losing attempt we no longer track,
+                    # or a racing cancel — never a failure
+                    del inflight[key]
+            # speculation: hedge stragglers with a backup attempt on
+            # an idle worker (first committed attempt wins)
+            if spec_enabled and inflight:
+                now = time.monotonic()
+                busy = {
+                    id(w) for (w, _, _, _) in inflight.values()
+                }
+                idle = [
+                    x for x in self.workers
+                    if x.alive and not x.draining and id(x) not in busy
+                ]
+                for key, (w, stage, spec, t0) in list(inflight.items()):
+                    if not idle:
+                        break
+                    tid = spec.task_id
+                    sid = stage.stage_id
+                    if tid in speculated_tids or tid in done_of[sid]:
+                        continue
+                    rts = runtimes.get(sid)
+                    if not rts:
+                        continue  # no completed sibling to compare to
+                    threshold = max(
+                        spec_min_age_s,
+                        spec_mult * statistics.median(rts),
                     )
-                    push(stage, spec)
+                    if now - t0 < threshold:
+                        continue
+                    x = next((c for c in idle if c is not w), None)
+                    if x is None:
+                        continue
+                    a2 = next_attempt_no[tid]
+                    try:
+                        self._post_task(
+                            x, stage, spec, a2, qroot, tasks_by_stage
+                        )
+                    except urllib.error.HTTPError as e:
+                        if e.code == 409:
+                            x.draining = True
+                        else:
+                            mark_dead(x)
+                        idle.remove(x)
+                        continue
+                    except Exception:
+                        mark_dead(x)
+                        idle.remove(x)
+                        continue
+                    next_attempt_no[tid] = a2 + 1
+                    inflight[(tid, a2)] = (x, stage, spec, now)
+                    speculative.add((tid, a2))
+                    speculated_tids.add(tid)
+                    self.stats["tasks_speculated"] += 1
+                    idle.remove(x)
+                    if self.post_hook is not None:
+                        self.post_hook(sid, tid, x)
             if inflight or not n_pending():
                 time.sleep(self.poll_s)
+        self._last_specs = dict(spec_by_tid)
         assert set(tasks_by_stage) == set(by_id)
-
-    def _bump_attempt(self, spec: _TaskSpec, attempts: dict, error: str):
-        attempts[spec.task_id] += 1
-        if attempts[spec.task_id] >= self.max_attempts:
-            raise RuntimeError(
-                f"task {spec.task_id} failed after "
-                f"{attempts[spec.task_id]} attempts: {error}"
-            )
 
     # ---- worker RPC ------------------------------------------------------
 

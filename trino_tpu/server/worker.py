@@ -163,6 +163,13 @@ class WorkerServer:
                     ok = worker.cancel_task(parts[2])
                     self._send(200 if ok else 404, {"canceled": ok})
                     return
+                if len(parts) == 3 and parts[:2] == ["v1", "stagetask"]:
+                    # losing speculative attempts are cancelled here;
+                    # a cancel that loses the race to the spool commit
+                    # is harmless — readers dedupe committed attempts
+                    ok = worker.cancel_task(parts[2])
+                    self._send(200 if ok else 404, {"canceled": ok})
+                    return
                 self._send(404, {"error": "not found"})
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
@@ -335,6 +342,8 @@ class WorkerServer:
                     import time as _time
 
                     _time.sleep(delay / 1000.0)
+                if task.cancel.is_set():
+                    raise RuntimeError("task was canceled")
                 plan = plan_from_json(req["plan"])
                 root = req["spool"]
                 partition = req.get("partition")
@@ -367,6 +376,7 @@ class WorkerServer:
                         src["source_id"]: src.get("hash_symbols") or []
                         for src in req["sources"]
                     }
+                    ex.cancel_event = task.cancel
                     try:
                         if self.runner.mesh is not None:
                             # fleet x mesh: the fragment runs SPMD over
@@ -378,20 +388,30 @@ class WorkerServer:
                                 page = ex.execute(plan)
                         else:
                             page = ex.execute(plan)
-                        spool.write_task_output(
-                            root, out["stage_id"], req["task_id"],
-                            int(req["attempt"]), page,
-                            out["partitioning"], out["hash_symbols"],
-                            int(out["n_partitions"]),
-                        )
+                        # a cancelled speculative loser should not burn
+                        # spool writes; a cancel arriving after this
+                        # check commits anyway, which attempt-dedup
+                        # makes safe
+                        if not task.cancel.is_set():
+                            spool.write_task_output(
+                                root, out["stage_id"], req["task_id"],
+                                int(req["attempt"]), page,
+                                out["partitioning"], out["hash_symbols"],
+                                int(out["n_partitions"]),
+                            )
                     finally:
+                        ex.cancel_event = None
                         ex.remote_pages = {}
                         ex.remote_hash_keys = {}
                         self.runner.session.properties = saved
-                task.state = "FINISHED"
+                with self._lock:
+                    if not task.cancel.is_set():
+                        task.state = "FINISHED"
             except Exception as e:
                 task.error = f"{type(e).__name__}: {e}"
-                task.state = "FAILED"
+                task.state = (
+                    "CANCELED" if task.cancel.is_set() else "FAILED"
+                )
             finally:
                 self._task_finished()
 

@@ -19,8 +19,50 @@ from typing import Callable
 
 __all__ = [
     "PropertyMetadata", "SESSION_PROPERTIES", "get", "set_property",
-    "show_rows",
+    "show_rows", "parse_data_size",
 ]
+
+#: DataSize units (io.airlift.units.DataSize analog): decimal suffixes
+#: with binary multipliers, matching the reference's "1GB" = 2^30
+_DATA_SIZE_UNITS = {
+    "B": 1,
+    "kB": 1 << 10,
+    "MB": 1 << 20,
+    "GB": 1 << 30,
+    "TB": 1 << 40,
+    "PB": 1 << 50,
+}
+
+
+def parse_data_size(value: str) -> int:
+    """Parse a Trino data-size literal ('1GB', '512MB', '2.5kB') to
+    bytes. Raises ValueError on malformed input — SET SESSION rejects
+    a bad size at statement time, not deep inside memory accounting."""
+    s = str(value).strip()
+    for unit in sorted(_DATA_SIZE_UNITS, key=len, reverse=True):
+        if s.endswith(unit):
+            num = s[: -len(unit)].strip()
+            try:
+                n = float(num)
+            except ValueError:
+                raise ValueError(f"invalid data size: {value!r}") from None
+            if n < 0:
+                raise ValueError(f"data size must be >= 0: {value!r}")
+            return int(n * _DATA_SIZE_UNITS[unit])
+    try:
+        return int(s)  # bare byte count
+    except ValueError:
+        raise ValueError(
+            f"invalid data size: {value!r} (expected e.g. '1GB', "
+            f"'512MB', or a byte count)"
+        ) from None
+
+
+def _data_size(name):
+    def check(v):
+        parse_data_size(v)
+
+    return check
 
 
 @dataclass(frozen=True)
@@ -133,12 +175,61 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "Rows per paged result batch over the worker protocol",
             "bigint", 65_536, _positive("result_batch_rows"),
         ),
+        # ---- memory governance (registry + validation only: the
+        # ---- enforcement tier is a future PR, see ROADMAP) ------------
+        _P(
+            "query_max_memory",
+            "Cluster-wide memory cap per query, as a data size "
+            "('20GB'); validated and stored, enforcement pending "
+            "(SystemSessionProperties QUERY_MAX_MEMORY analog)",
+            "varchar", "20GB", _data_size("query_max_memory"),
+        ),
+        _P(
+            "query_max_memory_per_node",
+            "Per-worker memory cap per query, as a data size ('2GB'); "
+            "validated and stored, enforcement pending",
+            "varchar", "2GB", _data_size("query_max_memory_per_node"),
+        ),
         # ---- fleet / fault tolerance ----------------------------------
         _P(
             "retry_max_attempts",
             "Attempts per fleet task before the query fails "
             "(task_retry_attempts_per_task analog)",
             "bigint", 3, _positive("retry_max_attempts"),
+        ),
+        _P(
+            "retry_initial_delay_ms",
+            "Base delay before a failed fleet task's first retry; "
+            "doubles per failure up to retry_max_delay_ms, with full "
+            "jitter (retry_initial_delay analog)",
+            "bigint", 100, _non_negative("retry_initial_delay_ms"),
+        ),
+        _P(
+            "retry_max_delay_ms",
+            "Upper bound on the exponential retry backoff "
+            "(retry_max_delay analog)",
+            "bigint", 5_000, _positive("retry_max_delay_ms"),
+        ),
+        _P(
+            "speculation_enabled",
+            "Launch a backup attempt of a straggling fleet task on an "
+            "idle worker; first committed attempt wins "
+            "(the Tail-at-Scale hedge / MapReduce speculative "
+            "execution)",
+            "boolean", True,
+        ),
+        _P(
+            "speculation_multiplier",
+            "A RUNNING task is a straggler when its age exceeds this "
+            "multiple of the median runtime of completed tasks in its "
+            "stage",
+            "double", 3.0, _positive("speculation_multiplier"),
+        ),
+        _P(
+            "speculation_min_task_age_ms",
+            "Never speculate a task younger than this (keeps tiny "
+            "tasks from hedging on scheduling noise)",
+            "bigint", 500, _non_negative("speculation_min_task_age_ms"),
         ),
         # ---- test/failure injection (hidden) --------------------------
         _P(
@@ -150,6 +241,13 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "fleet_task_delay_ms",
             "Test hook: delay before fleet stage-task execution",
             "double", 0.0, _non_negative("fleet_task_delay_ms"),
+            hidden=True,
+        ),
+        _P(
+            "retry_backoff_seed",
+            "Test hook: seed for the retry-jitter RNG (0 = entropy); "
+            "a seeded run produces a deterministic delay sequence",
+            "bigint", 0, _non_negative("retry_backoff_seed"),
             hidden=True,
         ),
     ]
