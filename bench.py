@@ -22,8 +22,11 @@ Set BENCH_BASELINE=skip to emit vs_baseline=0 quickly.
 The long sections — TPC-DS SF1 and the bigger-than-HBM SF10 streamed
 tier (several hundred seconds cold) — run only under ``--full``; a
 plain ``python bench.py`` stays within a CI-sized time budget. The
-BENCH_TPCDS / BENCH_SF10 env vars override in either direction
-(=1 forces a section on without --full, =0 forces it off with it).
+BENCH_TPCDS / BENCH_SF10 / BENCH_MEMORY env vars override in either
+direction (=1 forces a section on without --full, =0 forces it off
+with it). Per-query peak memory (trino_tpu.memory) is always recorded
+from the warmup runs; BENCH_MEMORY adds a 256 MiB-budgeted re-run so
+resident vs revoked/streamed peaks sit side by side.
 """
 
 import argparse
@@ -88,10 +91,14 @@ def main(argv=None) -> None:
     ours = {}
     spread = {}
     rowcounts = {}
+    peaks = {}
     for q in QUERY_IDS:
         sql = QUERIES[q]
         result = runner.execute(sql)  # warmup: compile + cache
         rowcounts[q] = len(result.rows)
+        # memory governance observability: the warmup run's peak
+        # reservation (trino_tpu.memory context tree) is free to record
+        peaks[q] = result.peak_memory_bytes
         ours[q], lo, hi = timed_runs(lambda: runner.execute(sql), reps)
         spread[q] = (lo, hi)
     assert rowcounts["q01"] == 4, f"Q1 must yield 4 groups, got {rowcounts['q01']}"
@@ -149,6 +156,25 @@ def main(argv=None) -> None:
             math.prod(np_base[q] / ours[q] for q in np_base)
             ** (1 / len(np_base)), 3,
         )
+
+    detail.update({
+        f"{q}_peak_memory_bytes": int(peaks[q]) for q in QUERY_IDS
+    })
+
+    if _section_enabled("BENCH_MEMORY", args.full):
+        # memory section (long variant): the same queries re-run under
+        # a 256 MiB hbm budget so the streamed/grace tier's peak
+        # reservations sit next to the resident peaks above — the
+        # governance story in numbers (resident working set vs what
+        # revocation-into-spill actually holds concurrently)
+        rb = QueryRunner.tpch(schema)
+        rb.session.properties["hbm_budget_bytes"] = 256 << 20
+        for q in QUERY_IDS:
+            res = rb.execute(QUERIES[q])
+            detail[f"{q}_budgeted_peak_memory_bytes"] = int(
+                res.peak_memory_bytes
+            )
+        detail["memory_budget_bytes"] = 256 << 20
 
     if _section_enabled("BENCH_TPCDS", args.full) and sf == 1:
         # BASELINE config #4: deep join trees (q72) and self-join CTE +

@@ -86,6 +86,41 @@ def test_exhausted_retries_fail_query(mesh_runner):
     assert mesh_runner.execute("select count(*) from nation").rows == [(25,)]
 
 
+def test_failed_query_releases_memory_reservations():
+    """A query that dies mid-flight must not leak pool reservations:
+    the reserve that raised recorded nothing, and completed operator
+    reservations were freed batch-synchronously. Driven through the
+    memory-governance failure path (local executor) so it holds even
+    where the mesh is unavailable."""
+    from trino_tpu.memory import ExceededMemoryLimitError
+
+    runner = QueryRunner.tpch("tiny")
+    runner.execute(
+        "set session query_max_memory_per_node = '64kB'"
+    )
+    with pytest.raises(ExceededMemoryLimitError):
+        runner.execute(JOIN_SQL)
+    assert runner.executor.memory_pool.reserved_bytes == 0
+    # the executor stays usable after the kill
+    runner.execute("set session query_max_memory_per_node = '2GB'")
+    assert runner.execute(
+        "select count(*) from nation"
+    ).rows == [(25,)]
+
+
+def test_memory_limit_error_classified_nonretryable():
+    """FTE must not hedge/retry an allocation that can never fit —
+    ExceededMemoryLimitError rides the worker's `TypeName: msg` error
+    serialization into the non-retryable set."""
+    from trino_tpu.server.fleet import _retryable
+
+    assert not _retryable(
+        "ExceededMemoryLimitError: Query exceeded per-node memory "
+        "limit of 64kB [query_max_memory_per_node]"
+    )
+    assert _retryable("ConnectionError: worker went away")
+
+
 def test_injector_unit():
     inj = FailureInjector(max_attempts=3)
     inj.fail_stage("x", times=2)

@@ -1,0 +1,375 @@
+"""Memory governance: the query → task → operator context tree, the
+worker MemoryPool enforcing query_max_memory_per_node (with revocation
+into the spill tier), and the coordinator ClusterMemoryManager
+enforcing query_max_memory with the kill policy.
+
+The analog of the reference's memory-limit test tier
+(TestMemoryManager / TestClusterMemoryLeakDetector and the
+EXCEEDED_LOCAL_MEMORY_LIMIT / EXCEEDED_GLOBAL_MEMORY_LIMIT error
+paths): caps must fail typed and fast, revocable operators must
+degrade into spill instead of failing, and the peaks must surface on
+QueryResult, events, EXPLAIN ANALYZE, and system.runtime.memory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu import memory as M
+from trino_tpu.engine import QueryRunner
+from trino_tpu.exec import spill
+
+JOIN_SQL = (
+    "select l_returnflag, count(*), sum(l_extendedprice) "
+    "from lineitem, orders where l_orderkey = o_orderkey "
+    "group by l_returnflag order by 1"
+)
+
+
+@pytest.fixture()
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+# ---- unit: context tree / pool / cluster manager -------------------------
+
+def test_context_tree_rollup():
+    pool = M.MemoryPool(limit_provider=lambda: 0, node_id="n1")
+    q = pool.query_context("q1")
+    task = q.child("t0.0")
+    op = task.child("join")
+    op.reserve(100)
+    assert op.reserved_bytes == 100
+    assert task.reserved_bytes == 100
+    assert q.reserved_bytes == 100
+    assert pool.reserved_bytes == 100
+    op.reserve(50)
+    op.free(150)
+    assert pool.reserved_bytes == 0
+    assert q.reserved_bytes == 0
+    # peaks survive the frees at every level
+    assert op.peak_bytes == 150
+    assert q.peak_bytes == 150
+    assert pool.peak_bytes == 150
+    # sibling contexts roll up into the same query root
+    q.child("t0.1").child("spill").reserve(40)
+    assert q.reserved_bytes == 40 and pool.reserved_bytes == 40
+    assert q.peak_bytes == 150  # 40 < the earlier peak
+
+
+def test_pool_enforces_per_node_cap():
+    pool = M.MemoryPool(limit_provider=lambda: 1000, node_id="n1")
+    ctx = pool.query_context("q1").child("join")
+    ctx.reserve(800)
+    with pytest.raises(M.ExceededMemoryLimitError, match="per-node"):
+        ctx.reserve(300)
+    # the failed reserve recorded nothing
+    assert pool.reserved_bytes == 800
+    ctx.free(800)
+    ctx.reserve(300)  # fits again after the free
+    assert pool.reserved_bytes == 300
+
+
+def test_pool_snapshot_and_gc():
+    pool = M.MemoryPool(limit_provider=lambda: 0, node_id="n1")
+    for i in range(pool.MAX_RETAINED_QUERIES + 10):
+        pool.query_context(f"q{i}").reserve(1)
+        pool.query_context(f"q{i}").free(1)
+    snap = pool.snapshot()
+    assert len(snap["queries"]) <= pool.MAX_RETAINED_QUERIES
+    assert snap["node_id"] == "n1"
+    assert snap["peak_bytes"] == pool.peak_bytes
+    json.dumps(snap)  # must be wire-safe
+
+
+def test_cluster_manager_kill_policy():
+    cmm = M.ClusterMemoryManager()
+    cmm.observe("w1", {
+        "queries": {"small": {"peak_bytes": 100},
+                    "big": {"peak_bytes": 600}},
+    })
+    cmm.observe("w2", {"queries": {"big": {"peak_bytes": 500}}})
+    assert cmm.query_total("big") == 1100
+    assert cmm.per_worker("big") == {"w1": 600, "w2": 500}
+    cmm.enforce(2000)  # under cap: no kill
+    with pytest.raises(M.ExceededMemoryLimitError) as ei:
+        cmm.enforce(1000)
+    msg = str(ei.value)
+    # the LARGEST query is the victim, with per-worker attribution
+    assert "big" in msg and "small" not in msg
+    assert "killed by the cluster memory manager" in msg
+    assert "w1" in msg and "w2" in msg
+    # restricting the kill candidates protects finished queries
+    cmm.enforce(1000, running={"small"})  # small is under cap: no kill
+    with pytest.raises(M.ExceededMemoryLimitError):
+        cmm.enforce(50, running={"small"})
+
+
+def test_validate_session_limits():
+    from trino_tpu.metadata import Session
+
+    s = Session()
+    M.validate_session_limits(s)  # defaults are consistent
+    s.properties["query_max_memory"] = "1GB"
+    s.properties["query_max_memory_per_node"] = "4GB"
+    with pytest.raises(ValueError, match="query_max_memory"):
+        M.validate_session_limits(s)
+    s.properties["query_max_memory_per_node"] = "512MB"
+    M.validate_session_limits(s)
+    s.properties["hbm_budget_bytes"] = 1 << 30  # 1GB > 512MB per node
+    with pytest.raises(ValueError, match="hbm_budget_bytes"):
+        M.validate_session_limits(s)
+
+
+def test_format_bytes():
+    assert M.format_bytes(0) == "0B"
+    assert M.format_bytes(1 << 30) == "1GB"
+    assert M.format_bytes(512 << 20) == "512MB"
+    assert M.format_bytes(1536) == "1.5kB"
+
+
+# ---- statement-time validation -------------------------------------------
+
+def test_statement_time_cap_validation(runner):
+    runner.execute("set session query_max_memory = '1GB'")
+    runner.execute("set session query_max_memory_per_node = '4GB'")
+    with pytest.raises(ValueError, match="query_max_memory"):
+        runner.execute("select 1")
+    # SET SESSION stays allowed so the bad combination can be fixed
+    runner.execute("set session query_max_memory_per_node = '512MB'")
+    assert runner.execute("select 1").rows == [(1,)]
+
+
+def test_statement_time_hbm_vs_cap_validation(runner):
+    runner.execute("set session hbm_budget_bytes = 3221225472")  # 3GB
+    with pytest.raises(ValueError, match="hbm_budget_bytes"):
+        runner.execute("select 1")
+    runner.execute("reset session hbm_budget_bytes")
+    assert runner.execute("select 1").rows == [(1,)]
+
+
+# ---- enforcement + revocation --------------------------------------------
+
+def test_per_node_cap_exceeded_raises(runner):
+    """A join whose working set can never fit under a tiny per-node
+    cap fails with the typed error, not a generic one."""
+    runner.execute("set session query_max_memory_per_node = '64kB'")
+    with pytest.raises(M.ExceededMemoryLimitError, match="per-node"):
+        runner.execute(JOIN_SQL)
+    # nothing stays reserved after the failure
+    assert runner.executor.memory_pool.reserved_bytes == 0
+
+
+def test_revocation_degrades_into_spill_tier(monkeypatch):
+    """An over-cap hash join is revoked into the spill tier (the cap
+    standing in as the budget) instead of failing: results match the
+    resident run and the tracked working set respects the cap. The
+    query is the grace-join shape whose spill-tier working sets are
+    proven to fit a 2MB budget (tests/test_spill.py)."""
+    monkeypatch.setattr(spill, "MIN_CHUNK_ROWS", 8192)
+    cap = 2 << 20
+    sql = (
+        "select count(*) from lineitem l1, lineitem l2 "
+        "where l1.l_orderkey = l2.l_orderkey "
+        "and l1.l_linenumber = l2.l_linenumber"
+    )
+    resident = QueryRunner.tpch("tiny").execute(sql)
+    r = QueryRunner.tpch("tiny")
+    r.session.properties["query_max_memory_per_node"] = str(cap)
+    res = r.execute(sql)
+    assert res.rows == resident.rows
+    assert r.executor.memory_revocations >= 1
+    assert 0 < res.peak_memory_bytes <= cap
+    assert r.executor.tracked_bytes_hwm <= cap
+    # the revocation budget never leaks past the revoked subtree
+    assert r.executor.hbm_budget() == 0
+
+
+# ---- peak reporting surfaces ---------------------------------------------
+
+def test_peak_memory_on_query_result(runner):
+    res = runner.execute(JOIN_SQL)
+    assert res.peak_memory_bytes > 0
+    assert res.peak_memory_per_node == {
+        "local-0": res.peak_memory_bytes
+    }
+    # a second identical run peaks identically (same plan, same caps)
+    assert runner.execute(JOIN_SQL).peak_memory_bytes == \
+        res.peak_memory_bytes
+
+
+def test_system_runtime_memory_table(runner):
+    from trino_tpu.connectors.system import SystemConnector
+
+    runner.metadata.register_catalog(
+        "system", SystemConnector(runner=runner)
+    )
+    res = runner.execute(JOIN_SQL)
+    rows = runner.execute(
+        "select node_id, query_id, peak_bytes, pool_peak_bytes, "
+        "pool_limit_bytes from system.runtime.memory"
+    ).rows
+    assert rows, "memory table must not be empty"
+    peaks = [r[2] for r in rows]
+    # the TPC-H join's peak shows up, consistent with QueryResult
+    assert res.peak_memory_bytes in peaks
+    for node, _qid, peak, pool_peak, limit in rows:
+        assert node == "local-0"
+        assert pool_peak >= peak
+        assert limit == 2 << 30  # the 2GB per-node default
+
+
+def test_explain_analyze_prints_peak(runner):
+    res = runner.execute("explain analyze " + JOIN_SQL)
+    text = "\n".join(r[0] for r in res.rows)
+    assert "Peak memory:" in text
+    assert "local-0" in text
+
+
+def test_query_completed_event_carries_peaks(runner):
+    from trino_tpu.events import EventListener
+
+    class Recorder(EventListener):
+        def __init__(self):
+            self.events = []
+
+        def query_completed(self, event):
+            self.events.append(event)
+
+    rec = Recorder()
+    runner.metadata.event_listeners.append(rec)
+    try:
+        res = runner.execute(JOIN_SQL)
+    finally:
+        runner.metadata.event_listeners.remove(rec)
+    (ev,) = rec.events
+    assert ev.peak_memory_bytes == res.peak_memory_bytes > 0
+    assert ev.peak_memory_per_node == (
+        ("local-0", res.peak_memory_bytes),
+    )
+
+
+def test_broken_listener_isolated_with_peaks(runner):
+    from trino_tpu.events import EventListener
+
+    class Broken(EventListener):
+        def query_completed(self, event):
+            raise RuntimeError("listener exploded")
+
+    runner.metadata.event_listeners.append(Broken())
+    try:
+        res = runner.execute(JOIN_SQL)
+        assert res.peak_memory_bytes > 0
+    finally:
+        runner.metadata.event_listeners.clear()
+
+
+# ---- fleet integration: FTE classification + cluster kill ----------------
+
+BASE_PORT = 18990
+
+
+def _spawn_worker(port: int) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "trino_tpu.server.worker",
+            "--port", str(port),
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/info", timeout=1
+            ) as resp:
+                info = json.loads(resp.read())
+                # the heartbeat surface ships a pool snapshot too
+                assert "pool" in info
+                return proc
+        except AssertionError:
+            raise
+        except Exception:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker died: {proc.stdout.read()[:4000]}"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise TimeoutError("worker did not come up")
+            time.sleep(0.3)
+
+
+@pytest.fixture(scope="module")
+def workers():
+    procs = [_spawn_worker(BASE_PORT + i) for i in range(2)]
+    yield [f"http://127.0.0.1:{BASE_PORT + i}" for i in range(2)]
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+@pytest.fixture()
+def fleet(workers, tmp_path):
+    from trino_tpu.connectors.tpch.connector import TpchConnector
+    from trino_tpu.metadata import Metadata, Session
+    from trino_tpu.server.fleet import FleetRunner
+
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    return FleetRunner(
+        workers, md, Session(catalog="tpch", schema="tiny"),
+        spool_root=str(tmp_path), n_partitions=4,
+    )
+
+
+FLEET_JOIN_SQL = (
+    "select l_orderkey, count(*) from lineitem, orders "
+    "where l_orderkey = o_orderkey group by l_orderkey"
+)
+
+
+def test_fleet_per_node_cap_not_retried(fleet):
+    """FTE must fail fast on ExceededMemoryLimitError: the allocation
+    can never fit on a retry of the same task either."""
+    fleet.session.properties["query_max_memory"] = "1GB"
+    fleet.session.properties["query_max_memory_per_node"] = "64kB"
+    with pytest.raises(RuntimeError, match="non-retryable") as ei:
+        fleet.execute(FLEET_JOIN_SQL)
+    assert "ExceededMemoryLimitError" in str(ei.value)
+    assert fleet.stats["tasks_retried"] == 0
+    assert fleet.stats["tasks_speculated"] == 0
+
+
+def test_fleet_cluster_kill_with_attribution(fleet):
+    """query_max_memory breach across workers: the ClusterMemoryManager
+    kills the query with per-worker attribution. The cap is calibrated
+    from a measured run — above any single worker's peak (so no
+    per-node failure) but below the cluster total."""
+    fleet.session.properties["query_max_memory_per_node"] = "0"
+    r = fleet.execute(FLEET_JOIN_SQL)
+    per = r.peak_memory_per_node
+    assert r.peak_memory_bytes == sum(per.values()) > 0
+    assert len(per) == 2, "both workers must attribute reservations"
+    cap = (max(per.values()) + sum(per.values())) // 2
+    assert max(per.values()) < cap < sum(per.values())
+    fleet.session.properties["query_max_memory"] = str(cap)
+    with pytest.raises(M.ExceededMemoryLimitError) as ei:
+        fleet.execute(FLEET_JOIN_SQL)
+    msg = str(ei.value)
+    assert "killed by the cluster memory manager" in msg
+    for node in per:
+        assert node in msg

@@ -133,7 +133,8 @@ def test_parse_data_size():
 
 def test_memory_governance_properties(runner):
     """query_max_memory / query_max_memory_per_node: validated and
-    visible (enforcement is a ROADMAP open item)."""
+    visible (enforced by trino_tpu.memory — MemoryPool per node,
+    ClusterMemoryManager cluster-wide; see test_memory_governance)."""
     runner.execute("set session query_max_memory = '4GB'")
     rows = {r[0]: r for r in runner.execute("show session").rows}
     assert rows["query_max_memory"][1] == "4GB"

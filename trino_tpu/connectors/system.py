@@ -33,6 +33,19 @@ _NODES_SCHEMA = TableSchema("nodes", [
     ("state", T.VARCHAR),
 ])
 
+#: live memory-governance state (system.runtime "memory" view — the
+#: reference exposes the same via MemoryResource / JMX memory pools):
+#: one row per (node, query) reservation plus the pool line per node
+_MEMORY_SCHEMA = TableSchema("memory", [
+    ("node_id", T.VARCHAR),
+    ("query_id", T.VARCHAR),
+    ("reserved_bytes", T.BIGINT),
+    ("peak_bytes", T.BIGINT),
+    ("pool_reserved_bytes", T.BIGINT),
+    ("pool_peak_bytes", T.BIGINT),
+    ("pool_limit_bytes", T.BIGINT),
+])
+
 
 class SystemConnector(Connector):
     """Read-only views over live engine state. ``source`` is the
@@ -49,7 +62,9 @@ class SystemConnector(Connector):
         return ["runtime"]
 
     def list_tables(self, schema: str) -> list[str]:
-        return ["queries", "nodes"] if schema == "runtime" else []
+        if schema == "runtime":
+            return ["queries", "nodes", "memory"]
+        return []
 
     def table_schema(self, schema: str, table: str) -> TableSchema:
         if schema != "runtime":
@@ -58,6 +73,8 @@ class SystemConnector(Connector):
             return _QUERIES_SCHEMA
         if table == "nodes":
             return _NODES_SCHEMA
+        if table == "memory":
+            return _MEMORY_SCHEMA
         raise KeyError(f"{schema}.{table}")
 
     def _query_rows(self):
@@ -86,20 +103,59 @@ class SystemConnector(Connector):
             for i in range(runner.mesh.devices.size)
         ]
 
-    def row_count(self, schema: str, table: str) -> int:
-        rows = (
-            self._query_rows() if table == "queries" else self._node_rows()
+    def _memory_rows(self):
+        """One row per (node, query) reservation. The local pool is
+        read live; remote workers come from the coordinator's
+        ClusterMemoryManager (their latest observed snapshots)."""
+        runner = self.runner
+        if runner is None and self.coordinator is not None:
+            runner = self.coordinator.runner
+        snaps = {}
+        pool = getattr(
+            getattr(runner, "executor", None), "memory_pool", None
         )
-        return len(rows)
+        if pool is not None:
+            snaps[pool.node_id] = pool.snapshot()
+        cmm = getattr(self.coordinator, "cluster_memory", None)
+        if cmm is not None:
+            for node, snap in cmm.nodes().items():
+                snaps.setdefault(node, snap)
+        out = []
+        for node in sorted(snaps):
+            snap = snaps[node]
+            pool_row = (
+                int(snap.get("reserved_bytes", 0)),
+                int(snap.get("peak_bytes", 0)),
+                int(snap.get("limit_bytes", 0)),
+            )
+            queries = snap.get("queries") or {}
+            if not queries:
+                out.append((node, "", 0, 0) + pool_row)
+            for qid in sorted(queries):
+                q = queries[qid]
+                out.append((
+                    node, qid,
+                    int(q.get("reserved_bytes", 0)),
+                    int(q.get("peak_bytes", 0)),
+                ) + pool_row)
+        return out
+
+    def _rows(self, table: str):
+        if table == "queries":
+            return self._query_rows()
+        if table == "memory":
+            return self._memory_rows()
+        return self._node_rows()
+
+    def row_count(self, schema: str, table: str) -> int:
+        return len(self._rows(table))
 
     def scan(
         self, schema: str, table: str, columns: list[str],
         split: Split | None = None,
     ):
         ts = self.table_schema(schema, table)
-        rows = (
-            self._query_rows() if table == "queries" else self._node_rows()
-        )
+        rows = self._rows(table)
         if split is not None:
             rows = rows[split.start: split.start + split.count]
         idx = {c: i for i, c in enumerate(ts.column_names)}

@@ -50,6 +50,10 @@ class QueryResult:
     tasks_speculated: int = 0
     speculation_wins: int = 0
     workers_readmitted: int = 0
+    #: memory governance (QueryStats peakUserMemoryReservation analog):
+    #: the query's peak concurrent reservation, total and per node
+    peak_memory_bytes: int = 0
+    peak_memory_per_node: dict = field(default_factory=dict)
 
 
 class QueryRunner:
@@ -135,17 +139,31 @@ class QueryRunner:
     def execute(self, sql: str, cancel_event=None) -> QueryResult:
         with self._lock:
             self.executor.cancel_event = cancel_event
+            query_id = uuid.uuid4().hex[:12]
+            # per-query memory context: all executor reservations made
+            # by this statement attribute to this query's subtree of
+            # the pool (restored afterwards so ad-hoc executor use
+            # keeps its default context)
+            prev_ctx = self.executor.memory_ctx
+            qctx = self.executor.memory_pool.query_context(query_id)
+            self.executor.memory_ctx = qctx
             t0 = time.perf_counter()
             error = None
             result = None
             try:
                 result = self._execute(sql)
+                result.peak_memory_bytes = qctx.peak_bytes
+                if qctx.peak_bytes:
+                    result.peak_memory_per_node = {
+                        self.executor.memory_pool.node_id: qctx.peak_bytes
+                    }
                 return result
             except Exception as e:
                 error = f"{type(e).__name__}: {e}"
                 raise
             finally:
                 self.executor.cancel_event = None
+                self.executor.memory_ctx = prev_ctx
                 listeners = getattr(self.metadata, "event_listeners", ())
                 if listeners:
                     from trino_tpu.events import (
@@ -154,17 +172,29 @@ class QueryRunner:
                     )
 
                     fire_query_completed(listeners, QueryCompletedEvent(
-                        query_id=uuid.uuid4().hex[:12],
+                        query_id=query_id,
                         user=self.session.user,
                         sql=sql,
                         state="FAILED" if error else "FINISHED",
                         elapsed_ms=(time.perf_counter() - t0) * 1e3,
                         rows=len(result.rows) if result else 0,
                         error=error,
+                        peak_memory_bytes=qctx.peak_bytes,
+                        peak_memory_per_node=(
+                            (self.executor.memory_pool.node_id,
+                             qctx.peak_bytes),
+                        ) if qctx.peak_bytes else (),
                     ))
 
     def _execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
+        if not isinstance(stmt, (ast.SessionSet, ast.SessionReset)):
+            # inconsistent memory caps fail fast at statement time
+            # (SET SESSION stays allowed so a bad combination can be
+            # corrected)
+            from trino_tpu.memory import validate_session_limits
+
+            validate_session_limits(self.session)
         return self._execute_stmt(stmt)
 
     def _execute_stmt(self, stmt: ast.Statement) -> QueryResult:
@@ -543,6 +573,15 @@ class QueryRunner:
         lines = [
             f"Query: {len(rows)} rows, {total_ms:.1f} ms total",
         ]
+        peak = getattr(ex, "memory_ctx", None)
+        if peak is not None and peak.peak_bytes:
+            # per-node peak reservations (QueryStats
+            # peakUserMemoryReservation in EXPLAIN ANALYZE analog)
+            lines.append(
+                f"Peak memory: {_fmt_bytes(peak.peak_bytes)} "
+                f"({ex.memory_pool.node_id}: "
+                f"{_fmt_bytes(peak.peak_bytes)})"
+            )
         if xstats is not None and xstats["exchanges"] > x0["exchanges"]:
             # distributed exchange telemetry (the reference surfaces
             # per-stage exchange bytes in EXPLAIN ANALYZE the same way)

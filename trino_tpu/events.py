@@ -33,6 +33,13 @@ class QueryCompletedEvent:
     error: str | None = None
     #: wall-clock seconds since epoch at completion
     end_time: float = field(default_factory=time.time)
+    #: peak concurrent memory reservation (QueryStatistics
+    #: peakUserMemoryBytes analog); 0 when the statement reserved
+    #: nothing (DDL, SHOW, ...)
+    peak_memory_bytes: int = 0
+    #: per-node attribution as ((node_id, bytes), ...) — a tuple
+    #: because the event is frozen/hashable
+    peak_memory_per_node: tuple = ()
 
 
 class EventListener:

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trino_tpu import memory
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec import stage
@@ -97,9 +98,27 @@ class LocalExecutor:
         #: dynamic-filter effectiveness log (tests + EXPLAIN ANALYZE):
         #: [{rows_in, rows_kept, pairs}] per join probe this executor ran
         self.df_log: list[dict] = []
-        #: largest tracked device working set (streamed mode; tests
-        #: assert it stays within hbm_budget_bytes)
-        self.tracked_bytes_hwm = 0
+        #: worker-local memory pool: every device allocation path
+        #: reserves through a MemoryContext rooted here, and the
+        #: per-node cap (query_max_memory_per_node) is enforced at
+        #: reservation time
+        self.memory_pool = memory.MemoryPool(
+            limit_provider=self._per_node_cap
+        )
+        #: active query context — swapped per query by QueryRunner /
+        #: the worker task loop; a default exists so direct executor
+        #: use (tests, EXPLAIN) never needs getattr guards
+        self.memory_ctx = self.memory_pool.query_context("adhoc")
+        #: joins revoked into the spill tier by memory pressure
+        #: (count of MemoryRevokingScheme-analog conversions)
+        self.memory_revocations = 0
+        #: revocation budget in force while a revoked subtree runs
+        #: (makes hbm_budget() nonzero so spill paths chunk under it)
+        self._revoked_budget = 0
+        #: grace-join observability counters (exec.spill writes these;
+        #: real fields so call sites never need getattr defaults)
+        self.grace_recursion_hwm = 0
+        self.grace_hot_pairs = 0
         #: cooperative cancellation: set by the coordinator, checked at
         #: operator boundaries
         self.cancel_event = None
@@ -115,10 +134,29 @@ class LocalExecutor:
     def hbm_budget(self) -> int:
         """Device-memory budget in bytes (session ``hbm_budget_bytes``;
         0 = resident mode). Tables/joins whose working sets exceed it
-        stream through exec.spill instead of materializing."""
+        stream through exec.spill instead of materializing. While a
+        memory-revoked subtree runs, the per-node cap stands in as the
+        budget so the whole subtree degrades into the spill tier."""
         from trino_tpu import session_properties as SP
 
-        return int(SP.get(self.session, "hbm_budget_bytes"))
+        budget = int(SP.get(self.session, "hbm_budget_bytes"))
+        return budget or self._revoked_budget
+
+    def _per_node_cap(self) -> int:
+        """query_max_memory_per_node in bytes (0 = unlimited)."""
+        from trino_tpu import session_properties as SP
+
+        return SP.parse_data_size(
+            SP.get(self.session, "query_max_memory_per_node")
+        )
+
+    @property
+    def tracked_bytes_hwm(self) -> int:
+        """Largest tracked device working set this executor ever
+        reserved (lifetime pool high-water mark; the budget-tier tests
+        assert it stays within hbm_budget_bytes). Pre-governance this
+        was an ad-hoc field; it is now derived from the memory pool."""
+        return self.memory_pool.peak_bytes
 
     def invalidate_scan(self, catalog: str, schema: str, table: str):
         """Drop cached device pages for a table (called after writes —
@@ -897,6 +935,10 @@ class LocalExecutor:
             plan = self._plan_budget_join(node, budget)
             if plan is not None:
                 return plan
+        if not budget and node.kind in ("inner", "left") and node.criteria:
+            plan = self._maybe_revoke_join(node)
+            if plan is not None:
+                return plan
         if not budget:
             # prefetch trades device memory for round trips — never
             # under an HBM budget, where spill paths may stream the
@@ -906,7 +948,19 @@ class LocalExecutor:
         right = self._compact(self.execute(node.right))
         if node.kind == "cross":
             return self._cross_join(node, left, right)
-        return self._equi_join(node, left, right)
+        try:
+            return self._equi_join(node, left, right)
+        except memory.ExceededMemoryLimitError:
+            # reactive revocation: the resident working set (padded
+            # device capacities) breached the per-node cap even though
+            # the live-row estimate fit. The failed reserve recorded
+            # nothing, so re-plan the join through the spill tier.
+            if budget or node.kind not in ("inner", "left") or not node.criteria:
+                raise
+            plan = self._maybe_revoke_join(node, force=True)
+            if plan is None:
+                raise
+            return plan
 
     def _prefetch_join_chains(self, node: P.PlanNode) -> None:
         """Dispatch every aggregate-free Filter/Project chain over a
@@ -993,6 +1047,41 @@ class LocalExecutor:
             # probe rows emit exactly once)
             return spill.grace_join(self, node)
         return None
+
+    def _maybe_revoke_join(self, node: P.Join, force: bool = False) -> Page | None:
+        """Memory revocation (MemoryRevokingScheme analog): with no
+        session hbm budget, a hash join whose estimated resident
+        working set would breach query_max_memory_per_node is switched
+        into the spill tier — the cap stands in as the budget for the
+        whole subtree — instead of failing at reservation time.
+        ``force`` skips the estimate check: the reactive path in
+        ``_Join`` uses it after a resident reserve already raised
+        (padded device capacities can exceed the live-row estimate).
+        Only when even the revoked path cannot fit does the pool raise
+        ExceededMemoryLimitError."""
+        cap = self.memory_pool.limit_bytes()
+        if not cap:
+            return None
+        from trino_tpu.exec import spill
+
+        est = (
+            spill.est_output_bytes(self, node.left)
+            + spill.est_output_bytes(self, node.right)
+            + spill.est_output_bytes(self, node)
+        )
+        if not force and est <= max(
+            cap - self.memory_pool.reserved_bytes, 0
+        ):
+            return None
+        prev = self._revoked_budget
+        self._revoked_budget = cap
+        try:
+            plan = self._plan_budget_join(node, cap)
+            if plan is not None:
+                self.memory_revocations += 1
+            return plan
+        finally:
+            self._revoked_budget = prev
 
     @staticmethod
     def _streamable(node: P.PlanNode):
@@ -1433,19 +1522,23 @@ class LocalExecutor:
         probe = self._dynamic_filter(node, probe, build)
         order, lo, cnt, total = self._join_count(node.criteria, probe, build)
         out_cap = pad_capacity(max(total, 1))
-        # account the join's whole device working set (probe + build +
-        # expansion output + index arrays) against the tracked HWM —
-        # the budget tier's tests rely on this being honest
+        # reserve the join's whole device working set (probe + build +
+        # expansion output + index arrays) against the memory pool —
+        # the budget tier's tests rely on this being honest, and the
+        # per-node cap is enforced here (ExceededMemoryLimitError when
+        # even the revoked/spill path cannot fit)
         out_row = sum(
             (2 if jnp.ndim((probe if s in probe.names else build)
                            .column(s).data) == 2 else 1) * 8
             for s in node.outputs
         )
-        self.tracked_bytes_hwm = max(
-            self.tracked_bytes_hwm,
+        working_set = (
             _page_dev_bytes(probe) + _page_dev_bytes(build)
-            + out_cap * (out_row + 8),
+            + out_cap * (out_row + 8)
         )
+        ctx = self.memory_ctx.child("join")
+        ctx.reserve(working_set)
+        ctx.free(working_set)
         key = (
             "joinB", node.kind, tuple(node.criteria), tuple(node.outputs),
             repr(node.filter), out_cap,

@@ -1206,6 +1206,26 @@ class MeshExecutor(LocalExecutor):
             key_a, prelude, in_specs, p_leaves + b_leaves
         )
 
+        # reserve the per-device join working set (probe shard + build
+        # + expansion output) through the memory context — sharded
+        # joins answer to query_max_memory_per_node like local ones
+        lane_bytes = lambda cols: sum(  # noqa: E731
+            (2 if jnp.ndim(c.data) == 2 else 1) * 8 for c in cols
+        )
+        out_row = sum(
+            (2 if jnp.ndim((p_cols.get(s) or b_cols[s]).data) == 2
+             else 1) * 8
+            for s in out_syms
+        )
+        working_set = (
+            p_cap * lane_bytes(probe.columns)
+            + b_cap * lane_bytes(build.columns)
+            + out_cap * (out_row + 8)
+        )
+        ctx = self.memory_ctx.child("mesh-join")
+        ctx.reserve(working_set)
+        ctx.free(working_set)
+
         # output column metadata
         filter_c = None
         if node.filter is not None:

@@ -25,8 +25,12 @@ PartitionedLookupSourceFactory) — re-shaped for a TPU:
 Activated by the ``hbm_budget_bytes`` session property (0/absent =
 resident mode). The budget is a planning target, not an allocator: the
 executor sizes chunks and partitions so no single device working set
-exceeds it, and tracks the high-water mark (``ex.tracked_bytes_hwm``)
-that tests assert against.
+exceeds it, and every working set is reserved through the query's
+memory context (``trino_tpu.memory``) — which enforces the per-node
+cap and maintains the high-water mark (``ex.tracked_bytes_hwm``)
+that tests assert against. The memory governor also engages this tier
+through revocation: a resident join that would breach
+``query_max_memory_per_node`` re-enters here with the cap as budget.
 """
 
 from __future__ import annotations
@@ -85,7 +89,14 @@ def chunk_rows_for(budget: int, per_row: int) -> int:
 
 
 def _note(ex, nbytes: int) -> None:
-    ex.tracked_bytes_hwm = max(getattr(ex, "tracked_bytes_hwm", 0), nbytes)
+    """Account one transient streamed working set against the query's
+    memory context: the reserve enforces query_max_memory_per_node and
+    records the high-water mark; the immediate free mirrors the
+    batch-synchronous lifetime (the arrays are dead once the chain
+    program returns)."""
+    ctx = ex.memory_ctx.child("spill")
+    ctx.reserve(nbytes)
+    ctx.free(nbytes)
 
 
 def _page_bytes(page: Page) -> int:
@@ -719,7 +730,7 @@ def _grace_pair(
             if max(bucket_bytes) < (l_bytes + r_bytes):
                 # the split separated at least one key: recurse
                 ex.grace_recursion_hwm = max(
-                    getattr(ex, "grace_recursion_hwm", 0), depth + 1
+                    ex.grace_recursion_hwm, depth + 1
                 )
                 for q in range(sub):
                     _grace_pair(
@@ -757,7 +768,7 @@ def _grace_hot_pair(
     (probe chunk, build chunk) combination joins device-side — a
     blocked nested-loop over the one key's rows, the only shape that
     respects the budget when re-partitioning cannot help."""
-    ex.grace_hot_pairs = getattr(ex, "grace_hot_pairs", 0) + 1
+    ex.grace_hot_pairs += 1
     half = max(pair_budget // 2, 1)
 
     def chunks(runs, outputs):

@@ -77,6 +77,13 @@ class Coordinator:
         self.history_grace_s = 60.0
         #: admission control (InternalResourceGroupManager analog)
         self.resource_groups = resource_groups or ResourceGroupManager()
+        #: cluster-wide memory view (ClusterMemoryManager analog): in
+        #: the embedded single-node shape it observes the local pool
+        #: after every statement; a FleetRunner-backed coordinator
+        #: would feed it worker snapshots the same way
+        from trino_tpu.memory import ClusterMemoryManager
+
+        self.cluster_memory = ClusterMemoryManager()
         # system.runtime tables over live coordinator state
         # (MAIN/connector/system/ analog)
         from trino_tpu.connectors.system import SystemConnector
@@ -265,6 +272,11 @@ class Coordinator:
                     q.error_detail = traceback.format_exc()
                     q.state = "FAILED"
                     q.result = None
+                pool = getattr(self.runner.executor, "memory_pool", None)
+                if pool is not None:
+                    self.cluster_memory.observe(
+                        pool.node_id, pool.snapshot()
+                    )
                 q.finished_at = time.time()
             finally:
                 self.resource_groups.release(group)

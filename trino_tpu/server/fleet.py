@@ -39,6 +39,7 @@ import uuid
 from collections import deque
 from dataclasses import dataclass
 
+from trino_tpu import memory
 from trino_tpu import session_properties as sp
 from trino_tpu.engine import QueryResult, QueryRunner, _has_order
 from trino_tpu.exec import spool
@@ -62,6 +63,11 @@ _NONRETRYABLE_ERRORS = frozenset({
     "AnalysisError", "SqlSyntaxError", "NotImplementedError",
     "TypeError", "ValueError", "KeyError", "AttributeError",
     "AssertionError", "ZeroDivisionError", "IndexError",
+    # an allocation that breached query_max_memory_per_node can never
+    # fit on a retry of the same task either — fail fast instead of
+    # hedging/retrying (the reference's EXCEEDED_LOCAL_MEMORY_LIMIT is
+    # likewise not retryable under task-level FTE)
+    "ExceededMemoryLimitError",
 })
 
 #: worker-serialized SpoolCorruptionError messages carry the producing
@@ -187,6 +193,14 @@ class FleetRunner:
         #: task_id -> (Stage, _TaskSpec) from the last _run_dag, kept
         #: for coordinator-side corruption recovery on the root read
         self._last_specs: dict[str, tuple[Stage, _TaskSpec]] = {}
+        #: coordinator-side memory governor: aggregates the per-worker
+        #: pool snapshots shipped on task-status responses, enforces
+        #: query_max_memory, and kills the largest query on breach
+        self.cluster_memory = memory.ClusterMemoryManager()
+        #: current query id (stamped on stage-task requests so worker
+        #: pools attribute reservations to the right query)
+        self._query_id: str | None = None
+        self._cluster_cap = 0
         self._planner = QueryRunner(metadata, session)
         #: per-worker device counts from /v1/info (1 when unreachable
         #: or mesh-less); the planner's shard count is the fleet total
@@ -220,9 +234,16 @@ class FleetRunner:
         self.retry_delays = []
         seed = sp.get(self.session, "retry_backoff_seed")
         self._retry_rng = random.Random(seed or None)
+        # inconsistent memory caps fail the statement before any task
+        # is scheduled; the cluster cap governs this query's total
+        memory.validate_session_limits(self.session)
+        self._cluster_cap = sp.parse_data_size(
+            sp.get(self.session, "query_max_memory")
+        )
         plan = self._planner.plan_sql(sql)
         stages = fragment_plan(plan)
         query_id = uuid.uuid4().hex[:12]
+        self._query_id = query_id
         qroot = os.path.join(self.spool_root, query_id)
         os.makedirs(qroot, exist_ok=True)
         tasks_by_stage: dict[str, list[str]] = {}
@@ -234,6 +255,12 @@ class FleetRunner:
             return QueryResult(
                 names=list(page.names), rows=rows,
                 ordered=_has_order(plan), plan=plan,
+                peak_memory_bytes=self.cluster_memory.query_total(
+                    query_id
+                ),
+                peak_memory_per_node=self.cluster_memory.per_worker(
+                    query_id
+                ),
                 **self.stats,
             )
         finally:
@@ -638,6 +665,15 @@ class FleetRunner:
                 try:
                     state = self._poll_task(w, tid, a)
                     w.fails = 0
+                    # pool snapshots ride on every task-status response
+                    # (the heartbeat surface): aggregate them and apply
+                    # the cluster-wide cap + kill policy
+                    self.cluster_memory.observe(w.uri, state.get("pool"))
+                    self.cluster_memory.enforce(
+                        self._cluster_cap, running={self._query_id}
+                    )
+                except memory.ExceededMemoryLimitError:
+                    raise  # killed by the cluster memory manager
                 except Exception as e:
                     # crash/kill -9 refuses the connection: dead now.
                     # A hung-but-alive worker (SIGSTOP) keeps the
@@ -791,6 +827,9 @@ class FleetRunner:
             "spool": qroot,
             "session": dict(self.session.properties),
             "fail": bool(spec.fail_first and attempt == 0),
+            # worker pools attribute reservations per query; the
+            # spool directory name doubles as the query id
+            "query_id": self._query_id or os.path.basename(qroot),
         }
         body = json.dumps(req).encode()
         r = urllib.request.Request(

@@ -110,6 +110,13 @@ class WorkerServer:
                     ))
                 elif t.state in ("FAILED", "CANCELED"):
                     payload.update(error=t.error)
+                # pool snapshot on every status response: the
+                # coordinator's ClusterMemoryManager aggregates these
+                # (the heartbeat memory surface of the reference's
+                # MemoryResource/ClusterMemoryManager poll)
+                payload["pool"] = (
+                    worker.runner.executor.memory_pool.snapshot()
+                )
                 self._send(200, payload)
 
             def do_GET(self):
@@ -153,6 +160,9 @@ class WorkerServer:
                         "devices": (
                             1 if mesh is None else int(mesh.devices.size)
                         ),
+                        "pool": (
+                            worker.runner.executor.memory_pool.snapshot()
+                        ),
                     })
                     return
                 self._send(404, {"error": "not found"})
@@ -174,6 +184,12 @@ class WorkerServer:
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
+        # memory-pool snapshots attribute to this worker's address
+        # (the node_id shown in kill-policy errors and
+        # system.runtime.memory)
+        self.runner.executor.memory_pool.node_id = (
+            f"127.0.0.1:{self.port}"
+        )
         self._thread: threading.Thread | None = None
 
     def start(self) -> "WorkerServer":
@@ -257,6 +273,11 @@ class WorkerServer:
                     )
                     ex = self.runner.executor
                     ex.cancel_event = task.cancel
+                    qid = str(req.get("query_id") or task.task_id)
+                    prev_ctx = ex.memory_ctx
+                    ex.memory_ctx = ex.memory_pool.query_context(
+                        qid
+                    ).child(task.task_id)
                     try:
                         page = ex.execute(plan)
                         # materialize ONCE to packed host columns;
@@ -267,6 +288,7 @@ class WorkerServer:
                         payload = page_to_host(page)
                     finally:
                         ex.cancel_event = None
+                        ex.memory_ctx = prev_ctx
                         self.runner.session.properties = saved
                 with self._lock:
                     # a DELETE that raced past the last executor cancel
@@ -377,6 +399,14 @@ class WorkerServer:
                         for src in req["sources"]
                     }
                     ex.cancel_event = task.cancel
+                    # query -> task context: reservations made by this
+                    # fragment attribute to the owning query in the
+                    # pool snapshot the coordinator aggregates
+                    qid = str(req.get("query_id") or req["task_id"])
+                    prev_ctx = ex.memory_ctx
+                    ex.memory_ctx = ex.memory_pool.query_context(
+                        qid
+                    ).child(tkey)
                     try:
                         if self.runner.mesh is not None:
                             # fleet x mesh: the fragment runs SPMD over
@@ -403,6 +433,7 @@ class WorkerServer:
                         ex.cancel_event = None
                         ex.remote_pages = {}
                         ex.remote_hash_keys = {}
+                        ex.memory_ctx = prev_ctx
                         self.runner.session.properties = saved
                 with self._lock:
                     if not task.cancel.is_set():

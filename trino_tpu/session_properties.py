@@ -175,19 +175,22 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "Rows per paged result batch over the worker protocol",
             "bigint", 65_536, _positive("result_batch_rows"),
         ),
-        # ---- memory governance (registry + validation only: the
-        # ---- enforcement tier is a future PR, see ROADMAP) ------------
+        # ---- memory governance (trino_tpu.memory: worker pools +
+        # ---- cluster memory manager enforce these) --------------------
         _P(
             "query_max_memory",
             "Cluster-wide memory cap per query, as a data size "
-            "('20GB'); validated and stored, enforcement pending "
+            "('20GB'); the ClusterMemoryManager kills the query with "
+            "the largest total reservation when breached "
             "(SystemSessionProperties QUERY_MAX_MEMORY analog)",
             "varchar", "20GB", _data_size("query_max_memory"),
         ),
         _P(
             "query_max_memory_per_node",
             "Per-worker memory cap per query, as a data size ('2GB'); "
-            "validated and stored, enforcement pending",
+            "enforced by the worker MemoryPool: over-cap joins are "
+            "revoked into the spill tier, and reservations that still "
+            "cannot fit raise ExceededMemoryLimitError",
             "varchar", "2GB", _data_size("query_max_memory_per_node"),
         ),
         # ---- fleet / fault tolerance ----------------------------------
