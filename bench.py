@@ -27,6 +27,12 @@ direction (=1 forces a section on without --full, =0 forces it off
 with it). Per-query peak memory (trino_tpu.memory) is always recorded
 from the warmup runs; BENCH_MEMORY adds a 256 MiB-budgeted re-run so
 resident vs revoked/streamed peaks sit side by side.
+
+``--chaos`` (or BENCH_CHAOS=1) appends the seeded chaos soak: a live
+2-worker fleet on TPC-H tiny is driven through every fault-injection
+site under both retry tiers (oracle-checked throughout), and the JSON
+line records which sites fired and the retry counts each tier
+absorbed. BENCH_CHAOS_SEED picks the schedule (default 0).
 """
 
 import argparse
@@ -75,6 +81,12 @@ def main(argv=None) -> None:
         "--full", action="store_true",
         help="also run the long sections: TPC-DS SF1 and the "
         "bigger-than-HBM SF10 streamed tier (hundreds of seconds)",
+    )
+    ap.add_argument(
+        "--chaos", action="store_true",
+        help="also run the seeded chaos soak (trino_tpu.testing.chaos)"
+        " against a live 2-worker fleet and record which fault sites"
+        " fired and how many retries each tier absorbed",
     )
     args = ap.parse_args(argv)
     sf = float(os.environ.get("BENCH_SF", "1"))
@@ -216,6 +228,46 @@ def main(argv=None) -> None:
         detail["sf10_tracked_hwm_bytes"] = int(
             r10.executor.tracked_bytes_hwm
         )
+    if args.chaos or _section_enabled("BENCH_CHAOS", False):
+        # robustness gauge, not a perf number: the full seeded soak
+        # (all six fault sites, TASK + QUERY tiers, oracle-checked
+        # row-for-row inside run_chaos_soak) against a real 2-process
+        # fleet on TPC-H tiny. Ports 18980+ keep it clear of the test
+        # suites (test_fleet 18940+, test_chaos 18960+).
+        import tempfile
+
+        from trino_tpu.testing import chaos as chaos_mod
+
+        chaos_seed = int(os.environ.get("BENCH_CHAOS_SEED", "0"))
+        procs, uris = chaos_mod.spawn_workers(2, base_port=18980)
+        try:
+            with tempfile.TemporaryDirectory(
+                prefix="bench-chaos-"
+            ) as spool:
+                t0 = time.perf_counter()
+                record = chaos_mod.run_chaos_soak(
+                    uris, spool, seed=chaos_seed
+                )
+                chaos_wall = time.perf_counter() - t0
+        finally:
+            chaos_mod.stop_workers(procs)
+        runs = [
+            run for policy_runs in record["policies"].values()
+            for run in policy_runs
+        ]
+        detail["chaos_seed"] = chaos_seed
+        detail["chaos_sites_fired"] = sorted(
+            chaos_mod.fired_sites(record)
+        )
+        detail["chaos_scenarios"] = len(runs)
+        detail["chaos_tasks_retried"] = sum(
+            run["tasks_retried"] for run in runs
+        )
+        detail["chaos_query_retries"] = sum(
+            run["query_retries"] for run in runs
+        )
+        detail["chaos_wall_s"] = round(chaos_wall, 1)
+
     print(json.dumps({
         "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
         "value": round(n_rows / ours["q01"], 1),

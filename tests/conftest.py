@@ -34,6 +34,14 @@ jax.config.update("jax_enable_x64", True)
 # healthy; the persistent on-disk cache makes re-JITs cheap.
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos soaks excluded from the tier-1 run",
+    )
+
+
 _test_count = 0
 
 

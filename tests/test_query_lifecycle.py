@@ -1,0 +1,405 @@
+"""Query-level lifecycle governance: deadlines, the QueryTracker
+reaper, typed protocol error codes, and prompt queued-query
+cancellation.
+
+The analog of the reference's QueryTracker.enforceTimeLimits +
+StandardErrorCode surface (MAIN/execution/QueryTracker.java,
+SPI/StandardErrorCode.java): a client must be able to tell a reaped
+deadline (EXCEEDED_TIME_LIMIT) from an exhausted QUERY retry tier
+(QUERY_RETRIES_EXHAUSTED) from a plain cancel (USER_CANCELED) without
+parsing message prose — and a *wedged* query (one that never reaches a
+cooperative boundary check) must still be retired, by the reaper, on
+the reaper's schedule."""
+
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trino_tpu import session_properties as sp
+from trino_tpu.engine import QueryRunner
+from trino_tpu.server import Coordinator, StatementClient
+from trino_tpu.server.client import QueryError
+from trino_tpu.server.resource_groups import (
+    ResourceGroup,
+    ResourceGroupManager,
+)
+from trino_tpu.tracker import (
+    QueryDeadlineExceededError,
+    QueryRetriesExhaustedError,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+@pytest.fixture()
+def session_guard(runner):
+    saved = dict(runner.session.properties)
+    yield runner.session
+    runner.session.properties.clear()
+    runner.session.properties.update(saved)
+
+
+@pytest.fixture(scope="module")
+def coord(runner):
+    c = Coordinator(runner=runner).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def client(coord):
+    return StatementClient(coord.uri)
+
+
+def _delete(coord, q):
+    req = urllib.request.Request(
+        f"{coord.uri}/v1/statement/executing/{q.query_id}/{q.slug}/0",
+        method="DELETE",
+    )
+    urllib.request.urlopen(req, timeout=5).read()
+
+
+def _page(coord, q):
+    with urllib.request.urlopen(
+        f"{coord.uri}/v1/statement/executing/{q.query_id}/{q.slug}/0",
+        timeout=10,
+    ) as resp:
+        import json
+
+        return json.loads(resp.read())
+
+
+# ---- duration parsing ----------------------------------------------
+
+
+def test_parse_duration_units():
+    assert sp.parse_duration("250ms") == pytest.approx(0.25)
+    assert sp.parse_duration("2s") == pytest.approx(2.0)
+    assert sp.parse_duration("10m") == pytest.approx(600.0)
+    assert sp.parse_duration("1.5h") == pytest.approx(5400.0)
+    assert sp.parse_duration("100d") == pytest.approx(8640000.0)
+    assert sp.parse_duration("0s") == 0.0
+    with pytest.raises(ValueError):
+        sp.parse_duration("fast")
+    with pytest.raises(ValueError):
+        sp.parse_duration("10 parsecs")
+
+
+def test_deadline_properties_validated(runner, session_guard):
+    runner.execute("set session query_max_execution_time = '5m'")
+    assert sp.get(runner.session, "query_max_execution_time") == "5m"
+    with pytest.raises(ValueError):
+        runner.execute("set session query_max_execution_time = 'soon'")
+    with pytest.raises(ValueError):
+        runner.execute("set session retry_policy = 'MAYBE'")
+    # accepted case-insensitively (consumers normalize on read)
+    runner.execute("set session retry_policy = 'query'")
+    assert sp.get(runner.session, "retry_policy").upper() == "QUERY"
+
+
+# ---- embedded-runner deadlines -------------------------------------
+
+
+def test_embedded_execution_deadline(runner, session_guard):
+    """The cooperative boundary check inside the executor converts the
+    absolute deadline into a typed error."""
+    runner.session.properties["query_max_execution_time"] = "100ms"
+    runner.session.properties["execution_delay_ms"] = 600.0
+    with pytest.raises(QueryDeadlineExceededError) as ei:
+        runner.execute("select count(*) from lineitem")
+    assert "query_max_execution_time" in str(ei.value)
+
+
+def test_embedded_planning_deadline(runner, session_guard):
+    runner.session.properties["query_max_planning_time"] = "50ms"
+    runner.session.properties["planning_delay_ms"] = 300.0
+    with pytest.raises(QueryDeadlineExceededError) as ei:
+        runner.execute("select count(*) from nation")
+    assert "query_max_planning_time" in str(ei.value)
+
+
+def test_zero_means_unlimited(runner, session_guard):
+    runner.session.properties["query_max_execution_time"] = "0s"
+    result = runner.execute("select count(*) from nation")
+    assert [list(r) for r in result.rows] == [[25]]
+
+
+# ---- the reaper ----------------------------------------------------
+
+
+def test_wedged_query_reaped_within_two_periods(coord, session_guard):
+    """A query that sleeps straight through its deadline (never
+    reaching a boundary check) is retired BY THE REAPER within ~2x the
+    reaper period of the deadline, surfacing the typed error — not a
+    generic failure, and not whenever the wedge happens to end."""
+    limit_s = 0.25
+    session_guard.properties["query_max_execution_time"] = "250ms"
+    session_guard.properties["execution_delay_ms"] = 3000.0
+    t0 = time.time()
+    q = coord.submit("select count(*) from nation")
+    while q.state not in ("FAILED", "FINISHED"):
+        assert time.time() - t0 < 5.0, "reaper never fired"
+        time.sleep(0.005)
+    reaped_after = (q.finished_at or time.time()) - t0 - limit_s
+    period = coord.query_tracker.period_s
+    assert q.state == "FAILED"
+    assert (q.error or "").startswith("QueryDeadlineExceededError")
+    # 2x period budget (+ scheduling slop): the reaper, not the
+    # wedge's natural end at 3 s, is what retired the query
+    assert reaped_after < 2 * period + 0.15, (
+        f"reaped {reaped_after:.3f}s past the deadline"
+    )
+    assert (q.query_id, "execution") in coord.query_tracker.reaped
+
+
+def test_deadline_exceeded_http_error_code(coord, client, session_guard):
+    """EXCEEDED_TIME_LIMIT surfaces through /v1/statement with its
+    distinct code, not GENERIC_INTERNAL_ERROR."""
+    session_guard.properties["query_max_execution_time"] = "150ms"
+    session_guard.properties["execution_delay_ms"] = 2000.0
+    with pytest.raises(QueryError) as ei:
+        client.execute("select count(*) from region")
+    assert ei.value.error_code == 131
+    assert ei.value.error_name == "EXCEEDED_TIME_LIMIT"
+    assert "QueryDeadlineExceededError" in str(ei.value)
+
+
+def test_deadline_while_queued():
+    """A query stuck in the QUEUED state past query_max_queued_time is
+    reaped there — it never runs, and the client sees the typed
+    error."""
+    rg = ResourceGroupManager(
+        groups=[ResourceGroup("global", max_running=1)]
+    )
+    runner = QueryRunner.tpch("tiny")
+    c = Coordinator(runner=runner, resource_groups=rg).start()
+    try:
+        runner.session.properties["execution_delay_ms"] = 1500.0
+        runner.session.properties["query_max_queued_time"] = "150ms"
+        blocker = c.submit("select count(*) from nation")
+        queued = c.submit("select count(*) from region")
+        deadline = time.time() + 5.0
+        while queued.state != "FAILED" and time.time() < deadline:
+            time.sleep(0.01)
+        assert queued.state == "FAILED"
+        assert (queued.error or "").startswith(
+            "QueryDeadlineExceededError"
+        )
+        assert "queued" in (queued.error or "")
+        payload = _page(c, queued)
+        assert payload["error"]["errorCode"] == 131
+        assert (queued.query_id, "queued") in c.query_tracker.reaped
+        # the blocker itself was under no deadline and must finish
+        while blocker.state == "RUNNING" and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        c.stop()
+
+
+def test_cancel_while_queued_unblocks_promptly():
+    """DELETE on a QUEUED query must notify the resource-group
+    condition variable: the dispatch thread parked in acquire()
+    observes the cancel NOW (queue drains immediately), not at the
+    next 1 s wait timeout."""
+    rg = ResourceGroupManager(
+        groups=[ResourceGroup("global", max_running=1)]
+    )
+    runner = QueryRunner.tpch("tiny")
+    c = Coordinator(runner=runner, resource_groups=rg).start()
+    try:
+        runner.session.properties["execution_delay_ms"] = 1500.0
+        c.submit("select count(*) from nation")  # occupies the slot
+        time.sleep(0.05)
+        queued = c.submit("select count(*) from region")
+        assert queued.state == "QUEUED"
+        assert rg.stats()["global"]["queued"] == 1
+        t0 = time.time()
+        _delete(c, queued)
+        # the DISPATCH THREAD observing the cancel is what drains the
+        # queue — that's the wakeup path under test
+        while (
+            rg.stats()["global"]["queued"] > 0
+            and time.time() - t0 < 2.0
+        ):
+            time.sleep(0.002)
+        elapsed = time.time() - t0
+        assert rg.stats()["global"]["queued"] == 0
+        # well under the 1 s condition-wait timeout: the wakeup, not
+        # the poll tick, unblocked it
+        assert elapsed < 0.5, f"queued cancel took {elapsed:.3f}s"
+        assert queued.state == "FAILED"
+        payload = _page(c, queued)
+        assert payload["error"]["errorCode"] == 130
+        assert payload["error"]["errorName"] == "USER_CANCELED"
+    finally:
+        c.stop()
+
+
+# ---- typed protocol codes ------------------------------------------
+
+
+def test_query_retries_exhausted_http_error_code(
+    coord, client, monkeypatch
+):
+    """QUERY_RETRIES_EXHAUSTED has its own protocol code (the fleet
+    raises it for real in the chaos suite; here the protocol mapping
+    is exercised in isolation)."""
+
+    def boom(sql, cancel_event=None):
+        raise QueryRetriesExhaustedError(
+            "query failed after 3 executions (retry_policy=QUERY, "
+            "query_retry_attempts=2); last failure: RuntimeError: x"
+        )
+
+    monkeypatch.setattr(coord.runner, "execute", boom)
+    with pytest.raises(QueryError) as ei:
+        client.execute("select 1")
+    assert ei.value.error_code == 132
+    assert ei.value.error_name == "QUERY_RETRIES_EXHAUSTED"
+
+
+def test_generic_error_keeps_generic_code(coord, client):
+    with pytest.raises(QueryError) as ei:
+        client.execute("select no_such_column from nation")
+    assert ei.value.error_code == 1
+    assert ei.value.error_name == "GENERIC_INTERNAL_ERROR"
+
+
+def test_deadline_never_retried_by_either_fte_tier():
+    """Deadline/cancel failures are terminal at BOTH retry tiers: more
+    attempts cannot create more time."""
+    from trino_tpu.server.fleet import (
+        _NONRETRYABLE_ERRORS,
+        _query_tier_retryable,
+        _retryable,
+    )
+
+    assert "QueryDeadlineExceededError" in _NONRETRYABLE_ERRORS
+    assert "QueryCancelled" in _NONRETRYABLE_ERRORS
+    assert not _retryable(
+        "QueryDeadlineExceededError: Query exceeded maximum execution "
+        "time limit [query_max_execution_time]"
+    )
+    assert not _query_tier_retryable(
+        QueryDeadlineExceededError("past deadline")
+    )
+    from trino_tpu.exec.local import QueryCancelled
+
+    assert not _query_tier_retryable(QueryCancelled("canceled"))
+    # transient classes stay retryable at the query tier
+    from trino_tpu import fault
+
+    assert _query_tier_retryable(
+        fault.InjectedFault("rpc", "post:x", 0, "times")
+    )
+    assert _query_tier_retryable(RuntimeError("worker died"))
+    assert not _query_tier_retryable(
+        RuntimeError("task x failed with non-retryable error: ...")
+    )
+
+
+# ---- StatementClient transport retry -------------------------------
+
+
+class _FlakyServer:
+    """Stub coordinator: POST returns a nextUri; the first N GETs on
+    the page endpoint return 500, then the terminal page."""
+
+    def __init__(self, fail_gets: int = 1, fail_posts: int = 0):
+        self.posts = 0
+        self.gets = 0
+        self.fail_gets = fail_gets
+        self.fail_posts = fail_posts
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                outer.posts += 1
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", "0"))
+                )
+                if outer.posts <= outer.fail_posts:
+                    self._json(503, b'{"error": "warming up"}')
+                    return
+                self._json(
+                    200,
+                    b'{"id": "q1", "stats": {"state": "RUNNING"}, '
+                    b'"nextUri": "http://127.0.0.1:%d/page"}'
+                    % outer.port,
+                )
+
+            def do_GET(self):
+                outer.gets += 1
+                if outer.gets <= outer.fail_gets:
+                    self._json(500, b'{"error": "transient"}')
+                    return
+                self._json(
+                    200,
+                    b'{"id": "q1", "stats": {"state": "FINISHED"}, '
+                    b'"columns": [{"name": "x", "type": "bigint"}], '
+                    b'"data": [[42]]}',
+                )
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_client_retries_transient_get_5xx():
+    """A single 5xx on a pagination GET must not kill the statement —
+    the page is idempotent; the client retries with jittered backoff
+    and drains normally."""
+    srv = _FlakyServer(fail_gets=2)
+    try:
+        cols, rows = StatementClient(srv.uri).execute("select 42")
+        assert rows == [[42]]
+        assert srv.gets == 3  # 2 failures + 1 success
+        assert srv.posts == 1
+    finally:
+        srv.stop()
+
+
+def test_client_get_retries_bounded():
+    srv = _FlakyServer(fail_gets=100)
+    try:
+        cl = StatementClient(srv.uri)
+        with pytest.raises(QueryError, match="HTTP 500"):
+            cl.execute("select 42")
+        assert srv.gets == cl.get_retries + 1
+    finally:
+        srv.stop()
+
+
+def test_client_never_retries_post():
+    """A failed POST might have dispatched the statement server-side —
+    retrying could double-submit, so the client must fail fast."""
+    srv = _FlakyServer(fail_posts=1)
+    try:
+        with pytest.raises(QueryError, match="HTTP 503"):
+            StatementClient(srv.uri).execute("select 42")
+        assert srv.posts == 1
+    finally:
+        srv.stop()

@@ -50,6 +50,10 @@ class QueryResult:
     tasks_speculated: int = 0
     speculation_wins: int = 0
     workers_readmitted: int = 0
+    #: whole-statement re-executions under retry_policy=QUERY (each
+    #: one ran under a fresh spool epoch); 0 when the first execution
+    #: succeeded or the policy is NONE/TASK
+    query_retries: int = 0
     #: memory governance (QueryStats peakUserMemoryReservation analog):
     #: the query's peak concurrent reservation, total and per node
     peak_memory_bytes: int = 0
@@ -107,6 +111,17 @@ class QueryRunner:
     # ---- planning --------------------------------------------------------
 
     def plan_stmt(self, stmt: ast.Statement, optimized: bool = True) -> P.PlanNode:
+        from trino_tpu import fault, session_properties
+
+        t_plan = time.monotonic()
+        # chaos seam: an armed `planner` fault models a transient
+        # planning-infrastructure failure (retryable at the QUERY tier)
+        fault.check("planner", tag=type(stmt).__name__)
+        plan_delay = session_properties.get(
+            self.session, "planning_delay_ms"
+        )
+        if plan_delay:
+            time.sleep(plan_delay / 1e3)
         analyzer = Analyzer(self.metadata, self.session)
         plan = analyzer.analyze(stmt)
         if optimized:
@@ -125,6 +140,16 @@ class QueryRunner:
             from trino_tpu.plan.stats import annotate
 
             plan = annotate(plan, self.metadata, self.session)
+        max_plan_s = session_properties.parse_duration(
+            session_properties.get(self.session, "query_max_planning_time")
+        )
+        if max_plan_s > 0 and time.monotonic() - t_plan > max_plan_s:
+            from trino_tpu.tracker import QueryDeadlineExceededError
+
+            raise QueryDeadlineExceededError(
+                f"Query exceeded maximum planning time limit of "
+                f"{max_plan_s:g}s [query_max_planning_time]"
+            )
         return plan
 
     def plan_sql(self, sql: str, optimized: bool = True) -> P.PlanNode:
@@ -137,8 +162,22 @@ class QueryRunner:
         return plan, self.executor.execute(plan)
 
     def execute(self, sql: str, cancel_event=None) -> QueryResult:
+        from trino_tpu import session_properties
+
         with self._lock:
             self.executor.cancel_event = cancel_event
+            # absolute execution deadline: boundary checks inside the
+            # executor turn it into QueryDeadlineExceededError; the
+            # coordinator's QueryTracker reaps queries that wedge
+            # between boundaries
+            max_exec_s = session_properties.parse_duration(
+                session_properties.get(
+                    self.session, "query_max_execution_time"
+                )
+            )
+            self.executor.deadline = (
+                time.monotonic() + max_exec_s if max_exec_s > 0 else None
+            )
             query_id = uuid.uuid4().hex[:12]
             # per-query memory context: all executor reservations made
             # by this statement attribute to this query's subtree of
@@ -163,6 +202,7 @@ class QueryRunner:
                 raise
             finally:
                 self.executor.cancel_event = None
+                self.executor.deadline = None
                 self.executor.memory_ctx = prev_ctx
                 listeners = getattr(self.metadata, "event_listeners", ())
                 if listeners:
@@ -187,6 +227,8 @@ class QueryRunner:
                     ))
 
     def _execute(self, sql: str) -> QueryResult:
+        from trino_tpu import session_properties
+
         stmt = parse_statement(sql)
         if not isinstance(stmt, (ast.SessionSet, ast.SessionReset)):
             # inconsistent memory caps fail fast at statement time
@@ -195,6 +237,14 @@ class QueryRunner:
             from trino_tpu.memory import validate_session_limits
 
             validate_session_limits(self.session)
+            delay = session_properties.get(
+                self.session, "execution_delay_ms"
+            )
+            if delay:
+                # test wedge: a dead sleep reaches no cooperative
+                # boundary — only the QueryTracker reaper (or the
+                # post-sleep deadline check) can retire the query
+                time.sleep(delay / 1e3)
         return self._execute_stmt(stmt)
 
     def _execute_stmt(self, stmt: ast.Statement) -> QueryResult:

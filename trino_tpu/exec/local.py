@@ -12,6 +12,7 @@ reference's build-side barriers.
 
 from __future__ import annotations
 
+import time
 from dataclasses import replace as dc_replace
 
 import jax
@@ -122,6 +123,9 @@ class LocalExecutor:
         #: cooperative cancellation: set by the coordinator, checked at
         #: operator boundaries
         self.cancel_event = None
+        #: absolute monotonic deadline (query_max_execution_time): set
+        #: by the engine per statement, checked at the same boundaries
+        self.deadline = None
         #: batched chain prefetch results: id(chain top node) ->
         #: (node, Page) — populated by _prefetch_join_chains, consumed
         #: by execute(); holding the node object pins its id
@@ -174,6 +178,13 @@ class LocalExecutor:
     def _check_cancel(self):
         if self.cancel_event is not None and self.cancel_event.is_set():
             raise QueryCancelled("Query was canceled")
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            from trino_tpu.tracker import QueryDeadlineExceededError
+
+            raise QueryDeadlineExceededError(
+                "Query exceeded maximum execution time limit "
+                "[query_max_execution_time]"
+            )
 
     def execute(self, node: P.PlanNode) -> Page:
         self._check_cancel()

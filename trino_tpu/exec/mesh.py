@@ -200,12 +200,19 @@ class MeshExecutor(LocalExecutor):
         are retained device arrays, so a failed invocation simply
         re-runs against them — the spooled-stage-output durability of
         the reference comes free from XLA buffer lifetimes."""
+        from trino_tpu import fault
+
         attempt = 0
         while True:
             try:
                 self.failure_injector.check(tag, attempt)
+                # the process-global chaos injector (trino_tpu.fault)
+                # addresses mesh stages through the same task-exec
+                # site, so multi-site chaos runs compose with the
+                # executor-local injector
+                fault.check("task-exec", tag, attempt)
                 return call()
-            except InjectedFailure:
+            except fault.InjectedFault:
                 attempt += 1
                 if attempt >= self.failure_injector.max_attempts:
                     raise

@@ -333,6 +333,11 @@ def write_task_output(
     partitioning: str, key_names: list[str], n_parts: int,
 ) -> None:
     """Partition a task's output page and commit it to the spool."""
+    from trino_tpu import fault
+
+    # chaos seam: a spool-write fault fails the producing task BEFORE
+    # its commit marker lands, so no corrupt attempt becomes readable
+    fault.check("spool-write", tag=f"{stage_id}:{task_id}", attempt=attempt)
     d = _stage_dir(root, stage_id)
     os.makedirs(d, exist_ok=True)
     payload = page_to_host(page)
@@ -433,11 +438,19 @@ def read_partition(
     """Read one partition (or, when ``partition`` is None, everything)
     written by the given tasks, deduplicated to one committed attempt
     per task. Raises if any task has no committed attempt."""
+    from trino_tpu import fault
+
     d = _stage_dir(root, stage_id)
     payloads = []
     empty = None
     empty_crc = None
     for tid in task_ids:
+        # chaos seam: a spool-read fault surfaces as a task failure in
+        # a worker (retried there) or escalates from the coordinator's
+        # root read into the QUERY retry tier. The attempt defaults to
+        # the active injector's (the CONSUMER's retry level), so
+        # times-schedules let a retried read eventually succeed.
+        fault.check("spool-read", tag=f"{stage_id}:{tid}")
         a = committed_attempt(root, stage_id, tid)
         if a is None:
             raise FileNotFoundError(

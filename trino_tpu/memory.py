@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import threading
 
+from trino_tpu import fault
 from trino_tpu import session_properties as SP
 
 __all__ = [
@@ -103,6 +104,11 @@ class MemoryContext:
         be breached (nothing is recorded in that case)."""
         if nbytes <= 0:
             return
+        # chaos seam: an injected device-oom is a TRANSIENT allocation
+        # failure (a busy device), distinct from the semantic
+        # ExceededMemoryLimitError — FTE retries the former, never the
+        # latter
+        fault.check("device-oom", tag=self.name)
         self.pool._reserve(self, int(nbytes))
 
     def free(self, nbytes: int) -> None:

@@ -4,11 +4,20 @@ The analog of the reference's StatementClientV1
 (client/trino-client/.../StatementClientV1.java:68): POST the SQL,
 then follow ``nextUri`` until it disappears, accumulating data pages.
 Pure stdlib (urllib) — the server is localhost/cluster-internal.
+
+Transport-retry policy (the reference's OkHttp retry interceptor,
+client/trino-client/.../StatementClientV1.java advance()): only
+idempotent pagination GETs are retried, and only on transport faults
+(connection refused/reset, HTTP 5xx). The submitting POST is never
+retried — a retried POST could double-submit a statement — and
+semantic query failures (an ``error`` payload in a 200 response)
+always fail fast.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import time
 import urllib.error
 import urllib.request
@@ -17,15 +26,33 @@ __all__ = ["StatementClient", "QueryError"]
 
 
 class QueryError(RuntimeError):
-    pass
+    """Statement failed. Carries the coordinator's typed error code /
+    name when the failure came through the protocol's error payload
+    (``errorCode``/``errorName``), else code 0 / None for client-side
+    transport failures."""
+
+    def __init__(self, message: str, error_code: int = 0,
+                 error_name: str | None = None):
+        super().__init__(message)
+        self.error_code = error_code
+        self.error_name = error_name
 
 
 class StatementClient:
+    #: transport retries per pagination GET (jittered exponential
+    #: backoff); POSTs are never retried
+    get_retries = 3
+    #: base backoff in seconds; attempt k sleeps uniform(0, base * 2^k)
+    retry_backoff_s = 0.05
+
     def __init__(self, server: str, timeout: float = 300.0):
         self.server = server.rstrip("/")
         self.timeout = timeout
+        self._rng = random.Random()
 
-    def _request(self, method: str, url: str, body: bytes | None = None) -> dict:
+    def _request_once(
+        self, method: str, url: str, body: bytes | None = None
+    ) -> dict:
         req = urllib.request.Request(url, data=body, method=method)
         req.add_header("X-Trino-User", "user")
         try:
@@ -37,10 +64,30 @@ class StatementClient:
                 detail = e.read().decode()[:200]
             except Exception:
                 pass
-            raise QueryError(f"HTTP {e.code} from {url}: {detail}") from e
+            err = QueryError(f"HTTP {e.code} from {url}: {detail}")
+            err.http_status = e.code
+            err.retryable = e.code >= 500
+            raise err from e
         except urllib.error.URLError as e:
-            raise QueryError(f"cannot reach {url}: {e.reason}") from e
+            err = QueryError(f"cannot reach {url}: {e.reason}")
+            err.retryable = True
+            raise err from e
         return json.loads(payload) if payload else {}
+
+    def _request(
+        self, method: str, url: str, body: bytes | None = None
+    ) -> dict:
+        retries = self.get_retries if method == "GET" else 0
+        for attempt in range(retries + 1):
+            try:
+                return self._request_once(method, url, body)
+            except QueryError as e:
+                if attempt >= retries or not getattr(e, "retryable", False):
+                    raise
+                time.sleep(self._rng.uniform(
+                    0.0, self.retry_backoff_s * (2 ** attempt)
+                ))
+        raise AssertionError("unreachable")
 
     def execute(self, sql: str):
         """Run one statement; returns (columns, rows).
@@ -56,7 +103,12 @@ class StatementClient:
         deadline = time.time() + self.timeout
         while True:
             if "error" in resp:
-                raise QueryError(resp["error"].get("message", "query failed"))
+                err = resp["error"]
+                raise QueryError(
+                    err.get("message", "query failed"),
+                    error_code=int(err.get("errorCode", 0) or 0),
+                    error_name=err.get("errorName"),
+                )
             if resp.get("columns") and columns is None:
                 columns = resp["columns"]
             rows.extend(resp.get("data") or [])

@@ -32,13 +32,39 @@ from dataclasses import dataclass, field
 from decimal import Decimal
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from trino_tpu import session_properties as sp
 from trino_tpu.engine import QueryResult, QueryRunner
+from trino_tpu.tracker import QueryTracker
 
 __all__ = ["Coordinator"]
 
 #: rows per protocol page (the reference targets bytes; rows are fine
 #: for a first protocol cut)
 PAGE_ROWS = 4096
+
+#: typed failures surface through /v1/statement with DISTINCT error
+#: codes + names (the reference's StandardErrorCode registry,
+#: SPI/StandardErrorCode.java) — a client can tell a reaped deadline
+#: from an exhausted retry tier from a plain cancel without parsing
+#: message prose. Code 1 = GENERIC_INTERNAL_ERROR fallback.
+ERROR_CODES = {
+    "QueryDeadlineExceededError": (131, "EXCEEDED_TIME_LIMIT"),
+    "QueryRetriesExhaustedError": (132, "QUERY_RETRIES_EXHAUSTED"),
+    "QueryCancelled": (130, "USER_CANCELED"),
+    "ExceededMemoryLimitError": (133, "EXCEEDED_MEMORY_LIMIT"),
+}
+
+
+def error_payload(error: str | None) -> dict:
+    name = (error or "").split(":", 1)[0].strip()
+    code, error_name = ERROR_CODES.get(
+        name, (1, "GENERIC_INTERNAL_ERROR")
+    )
+    return {
+        "message": error or "unknown error",
+        "errorCode": code,
+        "errorName": error_name,
+    }
 
 
 @dataclass
@@ -53,10 +79,16 @@ class QueryState:
     error: str | None = None
     error_detail: str | None = None  # server-side traceback
     created_at: float = field(default_factory=time.time)
+    #: RUNNING transition time (execution-deadline epoch)
+    started_at: float | None = None
     finished_at: float | None = None
     cancelled: bool = False
     #: cooperative cancellation signal checked by the executor
     cancel_event: object = field(default_factory=threading.Event)
+    #: deadline limits captured from session properties at submit
+    #: (0 = unlimited); the QueryTracker reaper enforces them
+    max_queued_s: float = 0.0
+    max_exec_s: float = 0.0
 
 
 class Coordinator:
@@ -84,6 +116,10 @@ class Coordinator:
         from trino_tpu.memory import ClusterMemoryManager
 
         self.cluster_memory = ClusterMemoryManager()
+        #: deadline governance: background reaper enforcing
+        #: query_max_queued_time / query_max_execution_time
+        #: (MAIN/execution/QueryTracker.java enforceTimeLimits analog)
+        self.query_tracker = QueryTracker(self)
         # system.runtime tables over live coordinator state
         # (MAIN/connector/system/ analog)
         from trino_tpu.connectors.system import SystemConnector
@@ -169,9 +205,11 @@ class Coordinator:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        self.query_tracker.start()
         return self
 
     def stop(self):
+        self.query_tracker.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -192,6 +230,15 @@ class Coordinator:
             qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{self._seq:05d}_{uuid.uuid4().hex[:5]}"
         q = QueryState(
             query_id=qid, slug=secrets.token_hex(8), sql=sql, user=user,
+        )
+        # capture deadline limits at submit time so the reaper enforces
+        # the session the query was dispatched under, not whatever the
+        # session mutates to later
+        q.max_queued_s = sp.parse_duration(
+            sp.get(self.runner.session, "query_max_queued_time")
+        )
+        q.max_exec_s = sp.parse_duration(
+            sp.get(self.runner.session, "query_max_execution_time")
         )
         # admission (resource groups): selection + queue-full fail-fast
         # happen BEFORE the dispatch thread exists (DispatchManager ->
@@ -245,39 +292,53 @@ class Coordinator:
             if not self.resource_groups.acquire(
                 group, qid, lambda: q.cancelled, admitted=admitted
             ):
+                # the reaper (queued-deadline) and DELETE both set
+                # cancelled — keep whichever typed error got there first
                 q.state = "FAILED"
-                q.error = "Query was canceled while queued"
+                if q.error is None:
+                    q.error = "Query was canceled while queued"
                 q.finished_at = time.time()
                 return
             try:
                 if q.cancelled:
                     q.state = "FAILED"
-                    q.error = "Query was canceled while queued"
+                    if q.error is None:
+                        q.error = "Query was canceled while queued"
                     q.finished_at = time.time()
                     return
                 q.state = "RUNNING"
+                q.started_at = time.time()
                 try:
                     # cooperative cancellation: DELETE sets the event
                     # and the executor aborts at its next boundary
                     result = self.runner.execute(
                         sql, cancel_event=q.cancel_event
                     )
-                    if q.cancelled:
+                    if q.cancelled or q.state == "FAILED":
                         q.state = "FAILED"
                     else:
                         q.result = result
                         q.state = "FINISHED"
                 except Exception as e:  # surfaces through the protocol
-                    q.error = f"{type(e).__name__}: {e}"
-                    q.error_detail = traceback.format_exc()
+                    # never clobber a reaper-set typed deadline error
+                    # with the generic unwind exception it provoked
+                    if q.error is None:
+                        q.error = f"{type(e).__name__}: {e}"
+                        q.error_detail = traceback.format_exc()
                     q.state = "FAILED"
                     q.result = None
-                pool = getattr(self.runner.executor, "memory_pool", None)
+                # a FleetRunner-backed coordinator has no local
+                # executor; its pools arrive via task-status snapshots
+                pool = getattr(
+                    getattr(self.runner, "executor", None),
+                    "memory_pool", None,
+                )
                 if pool is not None:
                     self.cluster_memory.observe(
                         pool.node_id, pool.snapshot()
                     )
-                q.finished_at = time.time()
+                if q.finished_at is None:
+                    q.finished_at = time.time()
             finally:
                 self.resource_groups.release(group)
 
@@ -291,7 +352,13 @@ class Coordinator:
             q.cancel_event.set()
             if q.state in ("QUEUED", "RUNNING"):
                 q.state = "FAILED"
-                q.error = "Query was canceled"
+                if q.error is None:
+                    q.error = "QueryCancelled: Query was canceled"
+                q.finished_at = time.time()
+            # a queued query's dispatch thread is parked on the
+            # resource-group condition variable — poke it so the cancel
+            # takes effect now, not at the next poll tick
+            self.resource_groups.wakeup()
 
     def list_queries(self) -> list[dict]:
         with self._lock:
@@ -336,10 +403,7 @@ class Coordinator:
             },
         }
         if q.state == "FAILED":
-            resp["error"] = {
-                "message": q.error or "unknown error",
-                "errorCode": 1,
-            }
+            resp["error"] = error_payload(q.error)
             return resp
         if q.state in ("QUEUED", "RUNNING") or q.result is None:
             resp["nextUri"] = f"{uri}/{token}"

@@ -39,14 +39,19 @@ import uuid
 from collections import deque
 from dataclasses import dataclass
 
-from trino_tpu import memory
+from trino_tpu import fault, memory
 from trino_tpu import session_properties as sp
 from trino_tpu.engine import QueryResult, QueryRunner, _has_order
 from trino_tpu.exec import spool
+from trino_tpu.exec.local import QueryCancelled
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.plan import nodes as P
 from trino_tpu.plan.fragment import Stage, fragment_plan
 from trino_tpu.plan.serde import plan_to_json
+from trino_tpu.tracker import (
+    QueryDeadlineExceededError,
+    QueryRetriesExhaustedError,
+)
 
 __all__ = ["FleetRunner", "FleetWorker"]
 
@@ -68,6 +73,11 @@ _NONRETRYABLE_ERRORS = frozenset({
     # hedging/retrying (the reference's EXCEEDED_LOCAL_MEMORY_LIMIT is
     # likewise not retryable under task-level FTE)
     "ExceededMemoryLimitError",
+    # more attempts cannot manufacture more wall-clock: deadline and
+    # cancellation failures are terminal at BOTH FTE tiers (the
+    # reference's EXCEEDED_TIME_LIMIT / USER_CANCELED error types)
+    "QueryDeadlineExceededError",
+    "QueryCancelled",
 })
 
 #: worker-serialized SpoolCorruptionError messages carry the producing
@@ -80,6 +90,30 @@ _CORRUPTION_RE = re.compile(
 
 def _retryable(error: str) -> bool:
     return error.split(":", 1)[0].strip() not in _NONRETRYABLE_ERRORS
+
+
+def _query_tier_retryable(e: BaseException) -> bool:
+    """Should retry_policy=QUERY re-execute the statement after this
+    failure escaped the task tier? Deadlines, cancellation, memory
+    caps, and the legacy stage timeout are terminal (re-running cannot
+    change them); injected faults model transients (retryable by
+    construction); RuntimeErrors are the scheduler's own escalations —
+    retryable unless they wrap a non-retryable task error. Everything
+    else (semantic/analyzer/planner errors) is deterministic and
+    fails fast."""
+    if isinstance(
+        e,
+        (
+            QueryDeadlineExceededError, QueryCancelled,
+            memory.ExceededMemoryLimitError, TimeoutError,
+        ),
+    ):
+        return False
+    if isinstance(e, fault.InjectedFault):
+        return True
+    if isinstance(e, RuntimeError):
+        return "non-retryable" not in str(e)
+    return False
 
 
 class _FleetParallelism:
@@ -190,6 +224,10 @@ class FleetRunner:
         #: backoff delays (seconds) actually scheduled by the last
         #: execute() — observability for tests asserting jitter bounds
         self.retry_delays: list[float] = []
+        #: error strings of every retried task failure from the last
+        #: execute() — the chaos suite asserts per-site injections
+        #: actually reached the worker tier from these
+        self.failure_log: list[str] = []
         #: task_id -> (Stage, _TaskSpec) from the last _run_dag, kept
         #: for coordinator-side corruption recovery on the root read
         self._last_specs: dict[str, tuple[Stage, _TaskSpec]] = {}
@@ -200,6 +238,10 @@ class FleetRunner:
         #: current query id (stamped on stage-task requests so worker
         #: pools attribute reservations to the right query)
         self._query_id: str | None = None
+        #: absolute monotonic deadline / cooperative cancel for the
+        #: statement in flight (set per execute())
+        self._exec_deadline: float | None = None
+        self._cancel_event = None
         self._cluster_cap = 0
         self._planner = QueryRunner(metadata, session)
         #: per-worker device counts from /v1/info (1 when unreachable
@@ -222,16 +264,21 @@ class FleetRunner:
 
     # ---- query entry -----------------------------------------------------
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str, cancel_event=None) -> QueryResult:
         raw = self.session.properties.get("retry_max_attempts")
         self.max_attempts = (
             int(raw) if raw is not None else self._default_max_attempts
         )
+        policy = str(sp.get(self.session, "retry_policy")).upper()
+        if policy == "NONE":
+            # fail fast: one attempt per task, no task-tier hedging
+            self.max_attempts = 1
         self.stats = {
             "tasks_retried": 0, "tasks_speculated": 0,
             "speculation_wins": 0, "workers_readmitted": 0,
         }
         self.retry_delays = []
+        self.failure_log = []
         seed = sp.get(self.session, "retry_backoff_seed")
         self._retry_rng = random.Random(seed or None)
         # inconsistent memory caps fail the statement before any task
@@ -240,8 +287,79 @@ class FleetRunner:
         self._cluster_cap = sp.parse_data_size(
             sp.get(self.session, "query_max_memory")
         )
-        plan = self._planner.plan_sql(sql)
-        stages = fragment_plan(plan)
+        # absolute execution deadline: checked every scheduler-loop
+        # iteration (between RPC rounds) — the fleet analog of the
+        # local executor's operator-boundary checks
+        max_exec_s = sp.parse_duration(
+            sp.get(self.session, "query_max_execution_time")
+        )
+        self._exec_deadline = (
+            time.monotonic() + max_exec_s if max_exec_s > 0 else None
+        )
+        self._cancel_event = cancel_event
+        retry_init_ms = float(
+            sp.get(self.session, "retry_initial_delay_ms")
+        )
+        retry_max_ms = float(sp.get(self.session, "retry_max_delay_ms"))
+        executions = (
+            int(sp.get(self.session, "query_retry_attempts")) + 1
+            if policy == "QUERY" else 1
+        )
+        # QUERY tier: re-execute the whole statement (fresh query id =
+        # fresh spool epoch) when a RETRYABLE failure escapes the task
+        # tier — spool corruption at the coordinator root read, all
+        # workers dead, a transient planner fault. Bounded by
+        # query_retry_attempts and the remaining execution-time budget.
+        plan = None
+        stages = None
+        last_exc: BaseException | None = None
+        query_retries = 0
+        for qa in range(executions):
+            if qa:
+                if (
+                    self._exec_deadline is not None
+                    and time.monotonic() >= self._exec_deadline
+                ):
+                    raise QueryDeadlineExceededError(
+                        "Query exceeded maximum execution time limit "
+                        "during query-level retry "
+                        "[query_max_execution_time]"
+                    ) from last_exc
+                # jittered backoff between whole-statement attempts,
+                # clamped to the remaining execution budget
+                cap = min(retry_max_ms, retry_init_ms * (2 ** (qa - 1)))
+                delay = self._retry_rng.uniform(0.0, cap) / 1000.0
+                if self._exec_deadline is not None:
+                    delay = min(
+                        delay,
+                        max(0.0, self._exec_deadline - time.monotonic()),
+                    )
+                self.retry_delays.append(delay)
+                time.sleep(delay)
+                query_retries += 1
+            try:
+                if plan is None:
+                    # planning inside the loop: a transient planner
+                    # fault is query-retryable; the successful plan is
+                    # reused across attempts (it is deterministic)
+                    plan = self._planner.plan_sql(sql)
+                    stages = fragment_plan(plan)
+                return self._execute_attempt(plan, stages, query_retries)
+            except Exception as e:
+                if policy != "QUERY" or not _query_tier_retryable(e):
+                    raise
+                last_exc = e
+        raise QueryRetriesExhaustedError(
+            f"query failed after {executions} executions "
+            f"(retry_policy=QUERY, query_retry_attempts="
+            f"{executions - 1}); last failure: "
+            f"{type(last_exc).__name__}: {last_exc}"
+        ) from last_exc
+
+    def _execute_attempt(
+        self, plan: P.PlanNode, stages: list[Stage], query_retries: int
+    ) -> QueryResult:
+        """One whole-statement execution under its own spool epoch."""
         query_id = uuid.uuid4().hex[:12]
         self._query_id = query_id
         qroot = os.path.join(self.spool_root, query_id)
@@ -261,6 +379,7 @@ class FleetRunner:
                 peak_memory_per_node=self.cluster_memory.per_worker(
                     query_id
                 ),
+                query_retries=query_retries,
                 **self.stats,
             )
         finally:
@@ -279,21 +398,34 @@ class FleetRunner:
         attempt, synchronously re-run the producing task on a live
         worker, and read again."""
         root = stages[-1]
-        for _ in range(self.max_attempts):
-            try:
-                return spool.read_partition(
-                    qroot, root.stage_id,
-                    tasks_by_stage[root.stage_id], None,
-                )
-            except spool.SpoolCorruptionError as e:
-                spool.quarantine_attempt(
-                    qroot, e.stage_id, e.task_id, e.attempt
-                )
-                self._rerun_task(
-                    qroot, tasks_by_stage, e.stage_id, e.task_id
-                )
+        # the chaos injector's spool-read site also fires on this read;
+        # its attempt level is the injector's default_attempt, which we
+        # bump per retry so times-schedules let a retried read succeed
+        inj = fault.active()
+        prev_da = inj.default_attempt if inj is not None else 0
+        try:
+            for read_attempt in range(self.max_attempts):
+                if inj is not None:
+                    inj.default_attempt = read_attempt
+                try:
+                    return spool.read_partition(
+                        qroot, root.stage_id,
+                        tasks_by_stage[root.stage_id], None,
+                    )
+                except fault.InjectedFault:
+                    continue  # transient read fault: retry in place
+                except spool.SpoolCorruptionError as e:
+                    spool.quarantine_attempt(
+                        qroot, e.stage_id, e.task_id, e.attempt
+                    )
+                    self._rerun_task(
+                        qroot, tasks_by_stage, e.stage_id, e.task_id
+                    )
+        finally:
+            if inj is not None:
+                inj.default_attempt = prev_da
         raise RuntimeError(
-            f"root stage {root.stage_id}: spool corruption persisted "
+            f"root stage {root.stage_id}: spool read failure persisted "
             f"across {self.max_attempts} recovery attempts"
         )
 
@@ -436,7 +568,12 @@ class FleetRunner:
 
         retry_init_ms = float(sp.get(self.session, "retry_initial_delay_ms"))
         retry_max_ms = float(sp.get(self.session, "retry_max_delay_ms"))
-        spec_enabled = bool(sp.get(self.session, "speculation_enabled"))
+        spec_enabled = (
+            bool(sp.get(self.session, "speculation_enabled"))
+            # retry_policy=NONE (or retry_max_attempts=1) means fail
+            # fast: no hedged attempts either
+            and self.max_attempts > 1
+        )
         spec_mult = float(sp.get(self.session, "speculation_multiplier"))
         spec_min_age_s = (
             float(sp.get(self.session, "speculation_min_task_age_ms"))
@@ -496,6 +633,7 @@ class FleetRunner:
                     f"(not retried): {error}"
                 )
             failures[tid] += 1
+            self.failure_log.append(f"{tid}: {error}")
             if failures[tid] >= self.max_attempts:
                 raise RuntimeError(
                     f"task {tid} failed after {failures[tid]} "
@@ -566,6 +704,19 @@ class FleetRunner:
         while len(complete) < len(stages):
             if time.monotonic() > deadline:
                 raise TimeoutError("query stages timed out")
+            if (
+                self._exec_deadline is not None
+                and time.monotonic() > self._exec_deadline
+            ):
+                raise QueryDeadlineExceededError(
+                    "Query exceeded maximum execution time limit "
+                    "[query_max_execution_time]"
+                )
+            if (
+                self._cancel_event is not None
+                and self._cancel_event.is_set()
+            ):
+                raise QueryCancelled("Query was canceled")
             # re-admission probes: evicted workers that answer
             # /v1/info again rejoin the placement pool
             now = time.monotonic()
@@ -803,9 +954,22 @@ class FleetRunner:
         self, w: FleetWorker, stage: Stage, spec: _TaskSpec, attempt: int,
         qroot: str, tasks_by_stage: dict[str, list[str]],
     ) -> None:
+        # chaos seam: an injected rpc fault on the POST looks like a
+        # dead worker to the dispatch loop (evict -> re-admission
+        # probes restore it), exactly the failure a dropped connection
+        # produces
+        fault.check("rpc", tag=f"post:{spec.task_id}", attempt=attempt)
+        inj = fault.active()
         req = {
             "task_id": spec.task_id,
             "attempt": attempt,
+            # ship the armed chaos schedule to the worker process: it
+            # rebuilds the injector (seed-deterministic) and installs
+            # it for this task's duration, so spool/memory/task-exec
+            # sites fire there exactly as they would in-process
+            "fault_spec": (
+                inj.to_spec() if inj is not None and inj.armed else None
+            ),
             "plan": spec.plan_json,
             "partition": spec.partition,
             "sources": [
@@ -842,6 +1006,9 @@ class FleetRunner:
             json.loads(resp.read())
 
     def _poll_task(self, w: FleetWorker, task_id: str, attempt: int) -> dict:
+        # an injected poll fault counts toward the consecutive-timeout
+        # eviction threshold, like a real unresponsive worker
+        fault.check("rpc", tag=f"poll:{task_id}", attempt=attempt)
         with urllib.request.urlopen(
             f"{w.uri}/v1/stagetask/{task_id}.{attempt}",
             timeout=self.rpc_timeout_s,

@@ -121,7 +121,17 @@ class ResourceGroupManager:
                     st.running += 1
                     self._cond.notify_all()
                     return True
-                self._cond.wait(timeout=0.1)
+                # long timeout: cancellation/reaping promptness comes
+                # from wakeup(), not from busy-polling this wait
+                self._cond.wait(timeout=1.0)
+
+    def wakeup(self) -> None:
+        """Nudge every thread parked in ``acquire``. Called by the
+        coordinator when a queued query is cancelled or reaped so its
+        dispatch thread re-checks ``cancelled()`` immediately instead
+        of at the next wait timeout."""
+        with self._cond:
+            self._cond.notify_all()
 
     def release(self, group: ResourceGroup) -> None:
         with self._cond:

@@ -19,6 +19,7 @@ import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from trino_tpu import fault
 from trino_tpu.engine import QueryRunner
 from trino_tpu.plan.serde import plan_from_json
 
@@ -37,10 +38,15 @@ class _Task:
         self.cancel = threading.Event()
 
 
-class InjectedTaskFailure(RuntimeError):
+class InjectedTaskFailure(fault.InjectedFault):
     """Coordinator-requested failure (FailureInjector analog,
     MAIN/execution/FailureInjector.java:39) — exercises the fleet
-    retry path without killing the process."""
+    retry path without killing the process. A subtype of the unified
+    InjectedFault so chaos tooling classifies the legacy `fail` flag
+    and the site-addressable schedules identically."""
+
+    def __init__(self, task_id: str, attempt: int):
+        super().__init__("task-exec", task_id, attempt, "legacy-flag")
 
 
 class WorkerServer:
@@ -350,8 +356,7 @@ class WorkerServer:
             try:
                 if req.get("fail"):
                     raise InjectedTaskFailure(
-                        f"injected failure for task {req['task_id']} "
-                        f"attempt {req['attempt']}"
+                        req["task_id"], int(req["attempt"])
                     )
                 delay = float(
                     (req.get("session") or {}).get("fleet_task_delay_ms", 0)
@@ -377,64 +382,94 @@ class WorkerServer:
                 # deserialize_executable wedges inside the backend;
                 # observed as a permanently stuck task thread)
                 with self.runner._lock:
-                    pages = {}
-                    for src in req["sources"]:
-                        part = (
-                            partition if src["mode"] == "aligned" else None
+                    # install the shipped chaos schedule for this
+                    # task's duration: tasks serialize under the
+                    # runner lock, so the process-global injector
+                    # never crosses tasks. Its default attempt is the
+                    # task attempt, so times-schedules on spool sites
+                    # resolve against the task's retry level and a
+                    # retried task eventually clears them.
+                    inj = None
+                    if req.get("fault_spec"):
+                        inj = fault.FaultInjector.from_spec(
+                            req["fault_spec"],
+                            default_attempt=int(req["attempt"]),
                         )
-                        payload = spool.read_partition(
-                            root, src["stage_id"], src["task_ids"], part
-                        )
-                        pages[src["source_id"]] = spool.host_to_page(
-                            payload
-                        )
-                    saved = dict(self.runner.session.properties)
-                    self.runner.session.properties.update(
-                        req.get("session") or {}
-                    )
-                    ex = self.runner.executor
-                    ex.remote_pages = pages
-                    ex.remote_hash_keys = {
-                        src["source_id"]: src.get("hash_symbols") or []
-                        for src in req["sources"]
-                    }
-                    ex.cancel_event = task.cancel
-                    # query -> task context: reservations made by this
-                    # fragment attribute to the owning query in the
-                    # pool snapshot the coordinator aggregates
-                    qid = str(req.get("query_id") or req["task_id"])
-                    prev_ctx = ex.memory_ctx
-                    ex.memory_ctx = ex.memory_pool.query_context(
-                        qid
-                    ).child(tkey)
+                        fault.activate(inj)
                     try:
-                        if self.runner.mesh is not None:
-                            # fleet x mesh: the fragment runs SPMD over
-                            # this worker's device mesh (scatter inputs,
-                            # local collectives, gather to spool)
-                            try:
-                                page = ex.gather(ex.execute_dist(plan))
-                            except NotImplementedError:
-                                page = ex.execute(plan)
-                        else:
-                            page = ex.execute(plan)
-                        # a cancelled speculative loser should not burn
-                        # spool writes; a cancel arriving after this
-                        # check commits anyway, which attempt-dedup
-                        # makes safe
-                        if not task.cancel.is_set():
-                            spool.write_task_output(
-                                root, out["stage_id"], req["task_id"],
-                                int(req["attempt"]), page,
-                                out["partitioning"], out["hash_symbols"],
-                                int(out["n_partitions"]),
+                        fault.check(
+                            "task-exec",
+                            tag=f"{out['stage_id']}:{req['task_id']}",
+                            attempt=int(req["attempt"]),
+                        )
+                        pages = {}
+                        for src in req["sources"]:
+                            part = (
+                                partition if src["mode"] == "aligned"
+                                else None
                             )
+                            payload = spool.read_partition(
+                                root, src["stage_id"], src["task_ids"],
+                                part,
+                            )
+                            pages[src["source_id"]] = spool.host_to_page(
+                                payload
+                            )
+                        saved = dict(self.runner.session.properties)
+                        self.runner.session.properties.update(
+                            req.get("session") or {}
+                        )
+                        ex = self.runner.executor
+                        ex.remote_pages = pages
+                        ex.remote_hash_keys = {
+                            src["source_id"]: src.get("hash_symbols") or []
+                            for src in req["sources"]
+                        }
+                        ex.cancel_event = task.cancel
+                        # query -> task context: reservations made by
+                        # this fragment attribute to the owning query in
+                        # the pool snapshot the coordinator aggregates
+                        qid = str(req.get("query_id") or req["task_id"])
+                        prev_ctx = ex.memory_ctx
+                        ex.memory_ctx = ex.memory_pool.query_context(
+                            qid
+                        ).child(tkey)
+                        try:
+                            if self.runner.mesh is not None:
+                                # fleet x mesh: the fragment runs SPMD
+                                # over this worker's device mesh
+                                # (scatter inputs, local collectives,
+                                # gather to spool)
+                                try:
+                                    page = ex.gather(
+                                        ex.execute_dist(plan)
+                                    )
+                                except NotImplementedError:
+                                    page = ex.execute(plan)
+                            else:
+                                page = ex.execute(plan)
+                            # a cancelled speculative loser should not
+                            # burn spool writes; a cancel arriving after
+                            # this check commits anyway, which
+                            # attempt-dedup makes safe
+                            if not task.cancel.is_set():
+                                spool.write_task_output(
+                                    root, out["stage_id"],
+                                    req["task_id"],
+                                    int(req["attempt"]), page,
+                                    out["partitioning"],
+                                    out["hash_symbols"],
+                                    int(out["n_partitions"]),
+                                )
+                        finally:
+                            ex.cancel_event = None
+                            ex.remote_pages = {}
+                            ex.remote_hash_keys = {}
+                            ex.memory_ctx = prev_ctx
+                            self.runner.session.properties = saved
                     finally:
-                        ex.cancel_event = None
-                        ex.remote_pages = {}
-                        ex.remote_hash_keys = {}
-                        ex.memory_ctx = prev_ctx
-                        self.runner.session.properties = saved
+                        if inj is not None:
+                            fault.deactivate()
                 with self._lock:
                     if not task.cancel.is_set():
                         task.state = "FINISHED"

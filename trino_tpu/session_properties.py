@@ -19,7 +19,7 @@ from typing import Callable
 
 __all__ = [
     "PropertyMetadata", "SESSION_PROPERTIES", "get", "set_property",
-    "show_rows", "parse_data_size",
+    "show_rows", "parse_data_size", "parse_duration",
 ]
 
 #: DataSize units (io.airlift.units.DataSize analog): decimal suffixes
@@ -61,6 +61,48 @@ def parse_data_size(value: str) -> int:
 def _data_size(name):
     def check(v):
         parse_data_size(v)
+
+    return check
+
+
+#: Duration units (io.airlift.units.Duration analog); longest-suffix
+#: match first so 'ms' does not parse as minutes-of-'s'
+_DURATION_UNITS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+    "d": 86400.0,
+}
+
+
+def parse_duration(value: str) -> float:
+    """Parse a Trino duration literal ('100ms', '30s', '5m', '100d')
+    to seconds. A value of 0 (any unit) means unlimited at the call
+    sites that consume deadlines. Raises ValueError on malformed
+    input so SET SESSION rejects it at statement time."""
+    s = str(value).strip()
+    for unit in sorted(_DURATION_UNITS, key=len, reverse=True):
+        if s.endswith(unit):
+            num = s[: -len(unit)].strip()
+            try:
+                n = float(num)
+            except ValueError:
+                raise ValueError(f"invalid duration: {value!r}") from None
+            if n < 0:
+                raise ValueError(f"duration must be >= 0: {value!r}")
+            return n * _DURATION_UNITS[unit]
+    raise ValueError(
+        f"invalid duration: {value!r} (expected e.g. '100ms', '30s', "
+        f"'5m', '2h', '100d')"
+    )
+
+
+def _duration(name):
+    def check(v):
+        parse_duration(v)
 
     return check
 
@@ -193,7 +235,47 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "cannot fit raise ExceededMemoryLimitError",
             "varchar", "2GB", _data_size("query_max_memory_per_node"),
         ),
+        # ---- query lifecycle / deadline governance (tracker.py) -------
+        _P(
+            "query_max_execution_time",
+            "Wall-clock cap on query execution, as a duration "
+            "('30s'); enforced cooperatively at executor boundaries "
+            "and by the coordinator QueryTracker reaper; 0 = "
+            "unlimited (QUERY_MAX_EXECUTION_TIME analog)",
+            "varchar", "100d", _duration("query_max_execution_time"),
+        ),
+        _P(
+            "query_max_planning_time",
+            "Wall-clock cap on statement planning, as a duration "
+            "('10m'); 0 = unlimited (QUERY_MAX_PLANNING_TIME analog)",
+            "varchar", "10m", _duration("query_max_planning_time"),
+        ),
+        _P(
+            "query_max_queued_time",
+            "Cap on time a query may wait for resource-group "
+            "admission, as a duration ('5m'); enforced by the "
+            "QueryTracker reaper; 0 = unlimited "
+            "(QUERY_MAX_QUEUED_TIME analog)",
+            "varchar", "5m", _duration("query_max_queued_time"),
+        ),
         # ---- fleet / fault tolerance ----------------------------------
+        _P(
+            "retry_policy",
+            "FTE tier: NONE (fail fast), TASK (per-task retry from "
+            "spooled stage outputs), or QUERY (task tier plus "
+            "whole-statement re-execution under a fresh spool epoch "
+            "when a retryable failure escapes the task tier) "
+            "(RetryPolicy analog)",
+            "varchar", "TASK",
+            _one_of("retry_policy", {"NONE", "TASK", "QUERY"}),
+        ),
+        _P(
+            "query_retry_attempts",
+            "Whole-statement re-executions under retry_policy=QUERY "
+            "before QueryRetriesExhaustedError surfaces "
+            "(query_retry_attempts analog)",
+            "bigint", 4, _positive("query_retry_attempts"),
+        ),
         _P(
             "retry_max_attempts",
             "Attempts per fleet task before the query fails "
@@ -251,6 +333,21 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "Test hook: seed for the retry-jitter RNG (0 = entropy); "
             "a seeded run produces a deterministic delay sequence",
             "bigint", 0, _non_negative("retry_backoff_seed"),
+            hidden=True,
+        ),
+        _P(
+            "execution_delay_ms",
+            "Test hook: wedge the engine in a sleep before execution "
+            "(exercises the QueryTracker reaper against a query that "
+            "never reaches a cooperative boundary check)",
+            "double", 0.0, _non_negative("execution_delay_ms"),
+            hidden=True,
+        ),
+        _P(
+            "planning_delay_ms",
+            "Test hook: delay inside statement planning (exercises "
+            "query_max_planning_time enforcement)",
+            "double", 0.0, _non_negative("planning_delay_ms"),
             hidden=True,
         ),
     ]

@@ -1,0 +1,268 @@
+"""Seeded chaos soak: drive a real multi-process fleet through every
+fault-injection site and assert oracle-exact results.
+
+The harness behind ``tests/test_chaos.py`` and ``bench.py --chaos``.
+One *scenario* = one query executed with one armed
+:class:`trino_tpu.fault.FaultInjector`; the soak runs a fixed scenario
+list per retry policy (TASK recovers everything at the task tier;
+QUERY additionally exercises whole-statement re-execution for faults
+that escape it). Every scenario's result is checked row-for-row
+against the sqlite oracle — chaos that silently corrupts answers is a
+far worse outcome than chaos that fails queries.
+
+Determinism: the injector's decisions hash (seed, site, tag, attempt)
+— never wall-clock or call order — so the *schedule* of fired
+injections is a function of the seed alone. ``run_chaos_soak`` returns
+a canonical record (fired coordinator decisions + worker-tier injected
+failures, each sorted to strip scheduler interleaving noise); two runs
+with the same seed must produce byte-identical records, which is
+exactly what the determinism test asserts.
+
+Port discipline: chaos workers bind 18960+ (``test_fleet.py`` owns
+18940+) so the suites never collide inside one run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+from trino_tpu import fault
+from trino_tpu.connectors.tpch.connector import TpchConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.plan.fragment import fragment_plan
+from trino_tpu.server.fleet import FleetRunner
+from trino_tpu.testing.golden import (
+    assert_rows_match,
+    load_tpch_sqlite,
+    to_sqlite,
+)
+
+__all__ = [
+    "CHAOS_BASE_PORT", "spawn_workers", "stop_workers",
+    "make_fleet", "run_chaos_soak", "fired_sites",
+]
+
+CHAOS_BASE_PORT = 18960
+
+#: worker-raised injected faults announce their coordinates in the
+#: error string; the soak parses them back out for per-site evidence
+_INJECTED_RE = re.compile(
+    r"site=(\S+) tag='([^']*)' attempt=(\d+) kind=(\S+)"
+)
+
+_AGG_SQL = (
+    "select o_orderpriority, count(*) from orders "
+    "group by o_orderpriority order by 1"
+)
+_JOIN_SQL = (
+    "select c_mktsegment, count(*), sum(o_totalprice) "
+    "from customer, orders where c_custkey = o_custkey "
+    "group by c_mktsegment order by 1"
+)
+
+
+def spawn_workers(
+    n: int = 2, base_port: int = CHAOS_BASE_PORT, timeout_s: float = 120
+):
+    """Start ``n`` worker processes; returns (procs, uris)."""
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    procs, uris = [], []
+    for i in range(n):
+        port = base_port + i
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "trino_tpu.server.worker",
+             "--port", str(port)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        ))
+        uris.append(f"http://127.0.0.1:{port}")
+    deadline = time.monotonic() + timeout_s
+    for proc, uri in zip(procs, uris):
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    f"{uri}/v1/info", timeout=1
+                ) as resp:
+                    json.loads(resp.read())
+                    break
+            except Exception:
+                if proc.poll() is not None:
+                    stop_workers(procs)
+                    raise RuntimeError(
+                        f"chaos worker died: {proc.stdout.read()[:4000]}"
+                    )
+                if time.monotonic() > deadline:
+                    stop_workers(procs)
+                    raise TimeoutError("chaos worker did not come up")
+                time.sleep(0.3)
+    return procs, uris
+
+
+def stop_workers(procs) -> None:
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def make_fleet(worker_uris, spool_root: str, **kwargs) -> FleetRunner:
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    return FleetRunner(
+        list(worker_uris), md, Session(catalog="tpch", schema="tiny"),
+        spool_root=spool_root, n_partitions=4, **kwargs
+    )
+
+
+def _root_stage_id(fleet: FleetRunner, sql: str) -> str:
+    """The root (coordinator-read) stage id of ``sql``'s fragment DAG
+    — planning is deterministic, so this matches what execute() will
+    schedule. Used to scope spool-read rules to the coordinator's root
+    read (worker source reads never touch the root stage's output)."""
+    return fragment_plan(fleet._planner.plan_sql(sql))[-1].stage_id
+
+
+def _scenarios(fleet: FleetRunner, policy: str):
+    """(name, sql, arm(injector)) triples. Worker-shipped rules must be
+    attempt-sensitive (``times``/``prob``) — an ``nth`` counter resets
+    with each per-task rebuild, so it would re-fire on every retry —
+    while coordinator-resident rules may be ``nth`` (the instance, and
+    its counters, live across the whole statement)."""
+    root_agg = _root_stage_id(fleet, _AGG_SQL)
+    scenarios = [
+        # rpc post: first submission dies on the wire -> the fleet
+        # marks the worker dead, reroutes the attempt, re-admits later
+        ("rpc-post", _AGG_SQL,
+         lambda inj: inj.arm_nth("rpc", 1, tag="post:")),
+        # rpc poll: one status poll times out -> poll-failure counter,
+        # not eviction; the next poll succeeds
+        ("rpc-poll", _AGG_SQL,
+         lambda inj: inj.arm_nth("rpc", 2, tag="poll:")),
+        # every task's attempt-0 output commit fails BEFORE the commit
+        # marker -> task retry rewrites from scratch
+        ("spool-write", _AGG_SQL,
+         lambda inj: inj.arm("spool-write", times=1)),
+        # every attempt-0 spooled read fails: worker source reads fail
+        # the task (task retry), the coordinator root read retries in
+        # place at the next read attempt
+        ("spool-read", _AGG_SQL,
+         lambda inj: inj.arm("spool-read", times=1)),
+        # every task fails its attempt-0 execution outright
+        ("task-exec", _AGG_SQL,
+         lambda inj: inj.arm("task-exec", times=1)),
+        # every task's attempt-0 first memory reservation fails (a
+        # transient busy-device OOM, not the semantic cap breach);
+        # needs the join — reservations guard join working sets
+        ("device-oom", _JOIN_SQL,
+         lambda inj: inj.arm("device-oom", times=1)),
+        # multi-site probabilistic storm on a join: the composability
+        # the two legacy injectors could not provide
+        ("prob-storm", _JOIN_SQL,
+         lambda inj: (
+             inj.arm_probability("task-exec", 0.3),
+             inj.arm_probability("spool-write", 0.2),
+             inj.arm_probability("device-oom", 0.15),
+         )),
+    ]
+    if policy == "QUERY":
+        scenarios += [
+            # transient planner fault: escapes the task tier by
+            # definition (no task exists yet) -> whole-statement retry
+            ("planner", _AGG_SQL,
+             lambda inj: inj.arm_nth("planner", 1)),
+            # the coordinator's root read fails max_attempts times ->
+            # the task tier gives up -> QUERY tier re-executes under a
+            # fresh spool epoch. Stacked nth=1 rules fire the first
+            # max_attempts matching calls (a fired rule breaks the
+            # scan, so each call consumes exactly one rule); by the
+            # re-execution every counter is spent and the reads succeed
+            ("root-read-exhausted", _AGG_SQL,
+             lambda inj: [
+                 inj.arm_nth("spool-read", 1, tag=f"{root_agg}:")
+                 for _ in range(fleet.max_attempts)
+             ]),
+        ]
+    return scenarios
+
+
+def run_chaos_soak(
+    worker_uris, spool_root: str, seed: int = 0,
+    policies=("TASK", "QUERY"), oracle=None,
+) -> dict:
+    """Run the scenario matrix; assert oracle-correctness throughout;
+    return the canonical (sorted, JSON-safe) injection record."""
+    if oracle is None:
+        data = (
+            QueryRunner.tpch("tiny").metadata.connector("tpch")
+            .data("tiny")
+        )
+        oracle = load_tpch_sqlite(data)
+    record = {"seed": seed, "policies": {}}
+    for policy in policies:
+        fleet = make_fleet(worker_uris, spool_root)
+        fleet.session.properties["retry_policy"] = policy
+        # hedged duplicate attempts would add timing-dependent
+        # (site, tag, attempt) checks — keep the schedule a pure
+        # function of the seed
+        fleet.session.properties["speculation_enabled"] = False
+        fleet.session.properties["retry_backoff_seed"] = seed
+        fleet.session.properties["retry_initial_delay_ms"] = 5
+        fleet.session.properties["retry_max_delay_ms"] = 20
+        runs = []
+        for name, sql, arm in _scenarios(fleet, policy):
+            inj = fault.FaultInjector(
+                seed=seed, max_attempts=fleet.max_attempts
+            )
+            arm(inj)
+            fault.activate(inj)
+            try:
+                result = fleet.execute(sql)
+            finally:
+                fault.deactivate()
+            expected = oracle.execute(to_sqlite(sql)).fetchall()
+            assert_rows_match(
+                result.rows, expected, ordered=result.ordered,
+                abs_tol=1e-6,
+            )
+            worker_fired = sorted(
+                m.groups() for m in (
+                    _INJECTED_RE.search(line)
+                    for line in fleet.failure_log
+                ) if m
+            )
+            runs.append({
+                "scenario": name,
+                "coordinator_fired": sorted(
+                    d for d in inj.decisions if d[3] is not None
+                ),
+                "worker_fired": worker_fired,
+                "tasks_retried": result.tasks_retried,
+                "query_retries": result.query_retries,
+            })
+        record["policies"][policy] = runs
+    return record
+
+
+def fired_sites(record: dict) -> set[str]:
+    """Every site that actually injected at least once, across both
+    the coordinator-resident and the worker-shipped injectors."""
+    sites = set()
+    for runs in record["policies"].values():
+        for run in runs:
+            for site, _tag, _attempt, _kind in run["coordinator_fired"]:
+                sites.add(site)
+            for site, _tag, _attempt, _kind in run["worker_fired"]:
+                sites.add(site)
+    return sites

@@ -1,0 +1,124 @@
+"""Query deadline governance: typed lifecycle errors + the reaper.
+
+The analog of the reference's QueryTracker.enforceTimeLimits
+(MAIN/execution/QueryTracker.java): a coordinator-side daemon sweeps
+the live query set on a short period and *reaps* any query past its
+deadline — QUEUED past ``query_max_queued_time`` or RUNNING past
+``query_max_execution_time`` — marking it FAILED with a typed
+``QueryDeadlineExceededError`` and firing its cancel event. The sweep
+is what makes deadlines robust: a cooperative check inside the engine
+covers the well-behaved path, but a *wedged* query (stuck in a kernel,
+a sleep, a hung RPC) never reaches its next boundary check, and only
+an external reaper can retire it. The reaper marks the query FAILED
+immediately — the protocol surfaces the deadline error to clients even
+while the wedged thread is still unwinding.
+
+Deadline failures are terminal by definition (more attempts cannot
+create more time), so both FTE tiers classify
+``QueryDeadlineExceededError`` non-retryable, and
+``QueryRetriesExhaustedError`` marks the QUERY tier giving up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = [
+    "QueryDeadlineExceededError", "QueryRetriesExhaustedError",
+    "QueryTracker",
+]
+
+
+class QueryDeadlineExceededError(RuntimeError):
+    """Query exceeded query_max_execution_time /
+    query_max_planning_time / query_max_queued_time
+    (EXCEEDED_TIME_LIMIT analog — never retried by either FTE tier)."""
+
+
+class QueryRetriesExhaustedError(RuntimeError):
+    """The QUERY retry tier ran out of attempts (or budget) without a
+    successful execution; carries the last underlying failure."""
+
+
+class QueryTracker:
+    """Deadline reaper over a coordinator's live queries.
+
+    Reads each QueryState's ``max_queued_s`` / ``max_exec_s``
+    (captured from session properties at submit) against its
+    ``created_at`` / ``started_at`` timestamps. Reaping a query:
+    state -> FAILED with the typed error string, cancel event set (so
+    a cooperative executor aborts at its next boundary), cancelled
+    flag set, and the resource-group condition notified so a QUEUED
+    query's dispatch thread unblocks promptly instead of waiting for
+    an unrelated release.
+    """
+
+    def __init__(self, coordinator, period_s: float = 0.05):
+        self.coordinator = coordinator
+        self.period_s = period_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        #: (query_id, reason) log of reaped queries
+        self.reaped: list[tuple[str, str]] = []
+
+    def start(self) -> "QueryTracker":
+        self._thread = threading.Thread(
+            target=self._loop, name="query-tracker", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.period_s):
+            try:
+                self.sweep()
+            except Exception:
+                pass  # the reaper must outlive any one bad sweep
+
+    def sweep(self):
+        """One enforcement pass (callable directly from tests)."""
+        now = time.time()
+        with self.coordinator._lock:
+            queries = list(self.coordinator._queries.values())
+        for q in queries:
+            if q.state == "QUEUED":
+                limit = getattr(q, "max_queued_s", 0.0)
+                if limit and now - q.created_at > limit:
+                    self._reap(
+                        q,
+                        f"Query exceeded maximum queued time limit "
+                        f"of {limit:g}s",
+                        "queued",
+                    )
+            elif q.state == "RUNNING":
+                limit = getattr(q, "max_exec_s", 0.0)
+                started = getattr(q, "started_at", None) or q.created_at
+                if limit and now - started > limit:
+                    self._reap(
+                        q,
+                        f"Query exceeded maximum execution time limit "
+                        f"of {limit:g}s",
+                        "execution",
+                    )
+
+    def _reap(self, q, message: str, reason: str):
+        if q.state in ("FINISHED", "FAILED"):
+            return
+        q.error = f"QueryDeadlineExceededError: {message}"
+        q.state = "FAILED"
+        q.finished_at = time.time()
+        q.cancelled = True
+        q.cancel_event.set()
+        self.reaped.append((q.query_id, reason))
+        # a QUEUED query's dispatch thread is blocked in acquire();
+        # poke the condition so it observes cancellation now
+        wakeup = getattr(self.coordinator.resource_groups, "wakeup", None)
+        if wakeup is not None:
+            wakeup()
