@@ -88,6 +88,12 @@ def main(argv=None) -> None:
         " against a live 2-worker fleet and record which fault sites"
         " fired and how many retries each tier absorbed",
     )
+    ap.add_argument(
+        "--trace-dir", default=os.environ.get("BENCH_TRACE_DIR"),
+        help="export each warmup query's trace as Chrome trace-event "
+        "JSON (<dir>/<qid>.trace.json — load in chrome://tracing or "
+        "ui.perfetto.dev)",
+    )
     args = ap.parse_args(argv)
     sf = float(os.environ.get("BENCH_SF", "1"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
@@ -100,13 +106,46 @@ def main(argv=None) -> None:
     conn = runner.metadata.connector("tpch")
     n_rows = conn.row_count(schema, "lineitem")
 
+    from trino_tpu import telemetry
+
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+
     ours = {}
     spread = {}
     rowcounts = {}
     peaks = {}
+    compile_stats = {}
+    top_spans = {}
     for q in QUERY_IDS:
         sql = QUERIES[q]
+        c0 = telemetry.compile_snapshot()
         result = runner.execute(sql)  # warmup: compile + cache
+        c1 = telemetry.compile_snapshot()
+        # XLA cost of the cold run: backend compiles + jit-cache hits
+        # (cache-served repeats compile nothing)
+        compile_stats[q] = {
+            "compiles": int(c1["compiles"] - c0["compiles"]),
+            "compile_s": round(
+                c1["compile_seconds"] - c0["compile_seconds"], 3
+            ),
+            "cache_hits": int(c1["cache_hits"] - c0["cache_hits"]),
+        }
+        if result.trace is not None:
+            top_spans[q] = [
+                {"name": s.name, "kind": s.kind,
+                 "ms": round(s.duration_ms, 1)}
+                for s in sorted(
+                    result.trace.spans(),
+                    key=lambda s: s.duration_ms, reverse=True,
+                )[1:4]  # skip the root query span (== total)
+            ]
+            if args.trace_dir:
+                path = os.path.join(
+                    args.trace_dir, f"{q}.trace.json"
+                )
+                with open(path, "w") as f:
+                    f.write(result.trace.to_chrome_json())
         rowcounts[q] = len(result.rows)
         # memory governance observability: the warmup run's peak
         # reservation (trino_tpu.memory context tree) is free to record
@@ -172,6 +211,12 @@ def main(argv=None) -> None:
     detail.update({
         f"{q}_peak_memory_bytes": int(peaks[q]) for q in QUERY_IDS
     })
+    for q in QUERY_IDS:
+        detail[f"{q}_warmup_compiles"] = compile_stats[q]["compiles"]
+        detail[f"{q}_warmup_compile_s"] = compile_stats[q]["compile_s"]
+        detail[f"{q}_jit_cache_hits"] = compile_stats[q]["cache_hits"]
+        if q in top_spans:
+            detail[f"{q}_top_spans"] = top_spans[q]
 
     if _section_enabled("BENCH_MEMORY", args.full):
         # memory section (long variant): the same queries re-run under

@@ -40,6 +40,20 @@ def pytest_configure(config):
         "markers",
         "slow: long-running chaos soaks excluded from the tier-1 run",
     )
+    config.addinivalue_line(
+        "markers",
+        "tpcds_full: TPC-DS long tail — the smoke subset stays in "
+        "tier-1, the full sweep runs in its own (non-blocking) CI job "
+        "via -m tpcds_full",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    # tier-1 is pinned to `-m 'not slow'`, so tpcds_full must imply
+    # slow for the fast lane to actually exclude the long tail
+    for item in items:
+        if item.get_closest_marker("tpcds_full") is not None:
+            item.add_marker(pytest.mark.slow)
 
 
 _test_count = 0

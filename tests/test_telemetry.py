@@ -1,0 +1,419 @@
+"""End-to-end query telemetry: stitched trace spans, Prometheus
+metrics, per-stage/per-task stats.
+
+The analog of the reference's observability tier (io.airlift.tracing
+OpenTelemetry spans on the dispatcher/scheduler/worker paths, the JMX
+/v1/status metric surface, and QueryStats behind EXPLAIN ANALYZE +
+system.runtime.tasks): a query through a live 2-worker fleet must
+yield ONE trace whose worker-side task spans stitch under the
+coordinator's stage spans, /v1/metrics must serve Prometheus text on
+every node, and the per-stage stats must agree across EXPLAIN
+ANALYZE, QueryResult.stage_stats and system.runtime.tasks.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu import fault, telemetry
+from trino_tpu.connectors.tpch.connector import TpchConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.events import QueryCompletedEvent, StructuredLogListener
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.server.fleet import FleetRunner
+
+BASE_PORT = 19000
+
+
+# ---------------------------------------------------------------------------
+# metrics registry units
+# ---------------------------------------------------------------------------
+
+
+def test_counter_labels_and_render():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests")
+    c.inc(state="ok")
+    c.inc(2, state="ok")
+    c.inc(state="err")
+    assert c.value(state="ok") == 3
+    assert c.total() == 4
+    text = reg.render()
+    assert "# HELP t_requests_total requests" in text
+    assert "# TYPE t_requests_total counter" in text
+    assert 't_requests_total{state="ok"} 3' in text
+    assert 't_requests_total{state="err"} 1' in text
+
+
+def test_gauge_and_histogram_render():
+    reg = telemetry.MetricsRegistry()
+    g = reg.gauge("t_pool_bytes", "pool")
+    g.set(100, pool="a")
+    g.add(-25, pool="a")
+    assert g.value(pool="a") == 75
+    h = reg.histogram("t_latency_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05, op="x")
+    h.observe(0.5, op="x")
+    h.observe(5.0, op="x")
+    assert h.count(op="x") == 3
+    text = reg.render()
+    assert 't_pool_bytes{pool="a"} 75' in text
+    assert 't_latency_seconds_bucket{le="0.1",op="x"} 1' in text
+    assert 't_latency_seconds_bucket{le="+Inf",op="x"} 3' in text
+    assert 't_latency_seconds_count{op="x"} 3' in text
+
+
+def test_unused_family_renders_zero_sample():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("t_never_incremented_total", "zero")
+    assert "t_never_incremented_total 0" in reg.render()
+
+
+def test_counting_cache_hit_miss_accounting():
+    cache = telemetry.CountingCache("t_unit")
+    h0 = telemetry.JIT_CACHE_HITS.value(cache="t_unit")
+    m0 = telemetry.JIT_CACHE_MISSES.value(cache="t_unit")
+    assert cache.get("k") is None
+    cache["k"] = 1
+    assert cache.get("k") == 1
+    assert telemetry.JIT_CACHE_HITS.value(cache="t_unit") == h0 + 1
+    assert telemetry.JIT_CACHE_MISSES.value(cache="t_unit") == m0 + 1
+
+
+# ---------------------------------------------------------------------------
+# span trees
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_span_hierarchy_and_chrome_json():
+    tracer = telemetry.Tracer("q1")
+    with tracer.span("planning", "planning"):
+        pass
+    with tracer.span("execute", "execution") as ex:
+        ex.child("operator scan", "operator").finish()
+    trace = tracer.finish()
+    kinds = {s.kind for s in trace.spans()}
+    assert {"query", "planning", "execution", "operator"} <= kinds
+    root = trace.root
+    assert all(s.trace_id == root.trace_id for s in trace.spans())
+    doc = json.loads(trace.to_chrome_json())
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert any(e["ph"] == "M" for e in evs)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == len(trace.spans())
+    for e in xs:
+        assert e["ts"] > 0 and e["dur"] >= 0
+
+
+def test_attach_stitches_worker_subtree():
+    tracer = telemetry.Tracer("q2")
+    stage = tracer.start("stage 0", "stage")
+    # worker side: detached task span rooted at the shipped parent id
+    wspan = telemetry.Span(
+        name="task s0t0.0", kind="task", parent_id=stage.span_id,
+        trace_id=tracer.trace_id, node="w1",
+    )
+    wspan.child("execute", "execution").finish()
+    wspan.finish()
+    attached = tracer.attach(wspan.to_dict())
+    assert attached is not None
+    stage.finish()
+    trace = tracer.finish()
+    tasks = trace.find(kind="task")
+    assert len(tasks) == 1 and tasks[0].node == "w1"
+    assert tasks[0] in stage.children
+
+
+# ---------------------------------------------------------------------------
+# chaos + listener counters
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_injection_counter_tracks_seeded_schedule():
+    inj = fault.FaultInjector(seed=7)
+    inj.arm("spool-read", times=2)
+    fault.activate(inj)
+    try:
+        before = telemetry.CHAOS_INJECTIONS.value(site="spool-read")
+        fired = 0
+        for attempt in range(4):
+            try:
+                fault.check("spool-read", tag="t", attempt=attempt)
+            except fault.InjectedFault:
+                fired += 1
+        assert fired == 2
+        after = telemetry.CHAOS_INJECTIONS.value(site="spool-read")
+        assert after - before == fired
+    finally:
+        fault.deactivate()
+
+
+def test_structured_log_listener_and_failure_counter(tmp_path):
+    path = tmp_path / "queries.jsonl"
+    lst = StructuredLogListener(path=str(path))
+    ev = QueryCompletedEvent(
+        query_id="q9", user="u", sql="select 1", state="FINISHED",
+        elapsed_ms=4.2, rows=1, error=None, peak_memory_bytes=0,
+        planning_ms=1.0, execution_ms=3.0, tasks_retried=1,
+    )
+    lst.query_completed(ev)
+    rec = json.loads(path.read_text().splitlines()[0])
+    assert rec["query_id"] == "q9"
+    assert rec["tasks_retried"] == 1
+    assert rec["planning_ms"] == 1.0
+
+    class Exploding:
+        def query_completed(self, event):
+            raise RuntimeError("boom")
+
+    from trino_tpu.events import fire_query_completed
+
+    before = telemetry.LISTENER_FAILURES.value(listener="Exploding")
+    fire_query_completed([Exploding()], ev)  # must not raise
+    assert telemetry.LISTENER_FAILURES.value(
+        listener="Exploding"
+    ) == before + 1
+
+
+def test_structured_log_listener_requires_one_sink(tmp_path):
+    with pytest.raises(ValueError):
+        StructuredLogListener()
+    with pytest.raises(ValueError):
+        StructuredLogListener(path=str(tmp_path / "x"), stream=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# local engine: stage_stats + EXPLAIN ANALYZE + system.runtime.tasks
+# ---------------------------------------------------------------------------
+
+
+def test_local_query_result_carries_trace_and_stats():
+    runner = QueryRunner.tpch("tiny")
+    res = runner.execute("select count(*) from region")
+    assert res.trace is not None
+    kinds = {s.kind for s in res.trace.spans()}
+    assert "query" in kinds and "planning" in kinds
+    assert len(res.stage_stats) == 1
+    st = res.stage_stats[0]
+    assert st["rows_out"] == 1
+    assert res.task_stats[0]["state"] == "FINISHED"
+    assert res.planning_ms >= 0 and res.execution_ms >= 0
+
+
+def test_local_explain_analyze_agrees_with_runtime_tasks():
+    from trino_tpu.server.coordinator import Coordinator
+
+    coord = Coordinator().start()
+    try:
+
+        def run(sql):
+            q = coord.submit(sql)
+            deadline = time.monotonic() + 60
+            while q.state not in ("FINISHED", "FAILED"):
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            assert q.state == "FINISHED", q.error
+            return q.result
+
+        res = run("explain analyze select count(*) from nation")
+        text = "\n".join(r[0] for r in res.rows)
+        st = res.stage_stats[0]
+        # the rendered stage line and the machine-readable stats are
+        # the same numbers
+        assert f"out: {st['rows_out']} rows" in text
+        tasks = run(
+            "select query_id, rows_out from system.runtime.tasks"
+        ).rows
+        by_query = {r[0]: r[1] for r in tasks}
+        assert by_query[res.task_stats[0]["query_id"]] == st["rows_out"]
+    finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# live 2-worker fleet: stitching, scrapes, stats agreement
+# ---------------------------------------------------------------------------
+
+
+def _spawn_worker(port: int) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "trino_tpu.server.worker",
+            "--port", str(port),
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/info", timeout=1
+            ) as resp:
+                json.loads(resp.read())
+                return proc
+        except Exception:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker died: {proc.stdout.read()[:4000]}"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise TimeoutError("worker did not come up")
+            time.sleep(0.3)
+
+
+@pytest.fixture(scope="module")
+def workers():
+    procs = [_spawn_worker(BASE_PORT + i) for i in range(2)]
+    yield [f"http://127.0.0.1:{BASE_PORT + i}" for i in range(2)]
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+@pytest.fixture(scope="module")
+def fleet(workers, tmp_path_factory):
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    return FleetRunner(
+        workers, md, Session(catalog="tpch", schema="tiny"),
+        spool_root=str(tmp_path_factory.mktemp("spool")),
+        n_partitions=4,
+    )
+
+
+def _scrape(uri: str) -> str:
+    with urllib.request.urlopen(f"{uri}/v1/metrics", timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return r.read().decode()
+
+
+def _parse_sample(text: str, name: str) -> float:
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            metric = line.split(" ")[0]
+            if metric == name or metric.startswith(name + "{"):
+                total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def test_fleet_trace_stitches_across_workers(fleet, workers):
+    res = fleet.execute(
+        "select o_orderpriority, count(*) c from orders "
+        "group by o_orderpriority order by c desc"
+    )
+    trace = res.trace
+    assert trace is not None
+    root = trace.root
+    assert root.kind == "query"
+    stages = trace.find(kind="stage")
+    tasks = trace.find(kind="task")
+    assert stages and tasks
+    # every worker executed at least one stitched task span
+    nodes = {s.node for s in tasks}
+    assert len(nodes) == 2
+    stage_ids = {s.span_id for s in stages}
+    assert all(t.parent_id in stage_ids for t in tasks)
+    # worker spans nest spool reads/writes and execution
+    kinds = {s.kind for s in trace.spans()}
+    assert {"planning", "rpc", "spool", "execution"} <= kinds
+    # the whole tree shares one trace id
+    assert all(s.trace_id == root.trace_id for s in trace.spans())
+    # exportable as valid Chrome trace-event JSON
+    doc = json.loads(trace.to_chrome_json())
+    names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"] if e["ph"] == "M"
+    }
+    assert "coordinator" in names and len(names) == 3
+
+
+def test_fleet_stage_stats_agree_with_task_stats(fleet):
+    res = fleet.execute("select count(*) from lineitem")
+    assert res.rows[0][0] > 0
+    assert res.stage_stats and res.task_stats
+    by_stage: dict = {}
+    for t in res.task_stats:
+        if t["state"] != "FINISHED":
+            continue
+        agg = by_stage.setdefault(t["stage_id"], [0, 0])
+        agg[0] += t["rows_out"]
+        agg[1] += t["bytes_out"]
+    for st in res.stage_stats:
+        rows, bytes_ = by_stage[st["stage_id"]]
+        assert st["rows_out"] == rows
+        assert st["bytes_out"] == bytes_
+    # the root stage feeds the client result
+    assert res.stage_stats[-1]["rows_out"] == len(res.rows)
+
+
+def test_fleet_explain_analyze_renders_stage_stats(fleet):
+    res = fleet.execute(
+        "explain analyze select count(*) from orders"
+    )
+    text = "\n".join(r[0] for r in res.rows)
+    assert "ms total" in text and "rows," in text
+    for st in res.stage_stats:
+        assert f"Stage {st['stage_id']}:" in text
+        assert f"out: {st['rows_out']} rows" in text
+
+
+def test_worker_metrics_scrape_counts_tasks(fleet, workers):
+    before = [_parse_sample(
+        _scrape(w), "trino_worker_tasks_total"
+    ) for w in workers]
+    fleet.execute("select count(*) from region")
+    after = [_parse_sample(
+        _scrape(w), "trino_worker_tasks_total"
+    ) for w in workers]
+    assert sum(after) > sum(before)
+    text = _scrape(workers[0])
+    for family in (
+        "trino_worker_tasks_total",
+        "trino_spool_bytes_written_total",
+        "trino_spool_bytes_read_total",
+        "trino_exchange_rows_total",
+        "trino_xla_compile_total",
+        "trino_memory_pool_reserved_bytes",
+    ):
+        assert family in text, family
+
+
+def test_coordinator_metrics_endpoint():
+    from trino_tpu.server.coordinator import Coordinator
+
+    coord = Coordinator().start()
+    try:
+        q = coord.submit("select 1")
+        deadline = time.monotonic() + 60
+        while q.state not in ("FINISHED", "FAILED"):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        text = _scrape(f"http://127.0.0.1:{coord.port}")
+        for family in (
+            "trino_queries_total",
+            "trino_query_retries_total",
+            "trino_tasks_retried_total",
+            "trino_chaos_injections_total",
+            "trino_rpc_latency_seconds",
+            "trino_event_listener_failures_total",
+        ):
+            assert family in text, family
+        assert _parse_sample(text, "trino_queries_total") >= 1
+    finally:
+        coord.stop()

@@ -33,6 +33,24 @@ _NODES_SCHEMA = TableSchema("nodes", [
     ("state", T.VARCHAR),
 ])
 
+#: per-task runtime stats (system.runtime.tasks analog,
+#: MAIN/connector/system/TaskSystemTable.java): rows come straight
+#: from QueryResult.task_stats, the same dicts EXPLAIN ANALYZE and
+#: QueryResult.stage_stats aggregate — the three views always agree
+_TASKS_SCHEMA = TableSchema("tasks", [
+    ("query_id", T.VARCHAR),
+    ("stage_id", T.VARCHAR),
+    ("task_id", T.VARCHAR),
+    ("attempt", T.BIGINT),
+    ("state", T.VARCHAR),
+    ("worker", T.VARCHAR),
+    ("rows_in", T.BIGINT),
+    ("rows_out", T.BIGINT),
+    ("bytes_out", T.BIGINT),
+    ("elapsed_ms", T.DOUBLE),
+    ("peak_memory_bytes", T.BIGINT),
+])
+
 #: live memory-governance state (system.runtime "memory" view — the
 #: reference exposes the same via MemoryResource / JMX memory pools):
 #: one row per (node, query) reservation plus the pool line per node
@@ -63,7 +81,7 @@ class SystemConnector(Connector):
 
     def list_tables(self, schema: str) -> list[str]:
         if schema == "runtime":
-            return ["queries", "nodes", "memory"]
+            return ["queries", "nodes", "memory", "tasks"]
         return []
 
     def table_schema(self, schema: str, table: str) -> TableSchema:
@@ -75,6 +93,8 @@ class SystemConnector(Connector):
             return _NODES_SCHEMA
         if table == "memory":
             return _MEMORY_SCHEMA
+        if table == "tasks":
+            return _TASKS_SCHEMA
         raise KeyError(f"{schema}.{table}")
 
     def _query_rows(self):
@@ -140,11 +160,36 @@ class SystemConnector(Connector):
                 ) + pool_row)
         return out
 
+    def _task_rows(self):
+        if self.coordinator is None:
+            return []
+        with self.coordinator._lock:
+            states = list(self.coordinator._queries.values())
+        out = []
+        for q in states:
+            for t in getattr(q.result, "task_stats", None) or []:
+                out.append((
+                    str(t.get("query_id") or q.query_id),
+                    str(t.get("stage_id", "")),
+                    str(t.get("task_id", "")),
+                    int(t.get("attempt", 0)),
+                    str(t.get("state", "")),
+                    str(t.get("worker", "")),
+                    int(t.get("rows_in", 0)),
+                    int(t.get("rows_out", 0)),
+                    int(t.get("bytes_out", 0)),
+                    float(t.get("elapsed_ms", 0.0)),
+                    int(t.get("peak_memory_bytes", 0)),
+                ))
+        return out
+
     def _rows(self, table: str):
         if table == "queries":
             return self._query_rows()
         if table == "memory":
             return self._memory_rows()
+        if table == "tasks":
+            return self._task_rows()
         return self._node_rows()
 
     def row_count(self, schema: str, table: str) -> int:

@@ -58,6 +58,19 @@ class QueryResult:
     #: the query's peak concurrent reservation, total and per node
     peak_memory_bytes: int = 0
     peak_memory_per_node: dict = field(default_factory=dict)
+    #: stitched trace span tree (telemetry.Trace) for the whole query;
+    #: None when tracing was not active for this statement
+    trace: object = field(default=None, repr=False)
+    #: per-stage aggregates (rows/bytes in+out, elapsed, retries, peak
+    #: memory) — the single source EXPLAIN ANALYZE's stage lines render
+    #: from; one pseudo-stage for local execution
+    stage_stats: list = field(default_factory=list)
+    #: per-task rows backing system.runtime.tasks
+    task_stats: list = field(default_factory=list)
+    #: elapsed split (QueryStats analog): wall-clock in the planner vs
+    #: everything after it
+    planning_ms: float = 0.0
+    execution_ms: float = 0.0
 
 
 class QueryRunner:
@@ -111,6 +124,25 @@ class QueryRunner:
     # ---- planning --------------------------------------------------------
 
     def plan_stmt(self, stmt: ast.Statement, optimized: bool = True) -> P.PlanNode:
+        """Analyze + optimize one statement, timed into the active
+        query's planning span (when ``execute`` opened one)."""
+        tracer = getattr(self, "_tracer", None)
+        t_span = time.perf_counter()
+        try:
+            if tracer is not None:
+                with tracer.span("planning", "planning",
+                                 stmt=type(stmt).__name__):
+                    return self._plan_stmt_inner(stmt, optimized)
+            return self._plan_stmt_inner(stmt, optimized)
+        finally:
+            self._plan_ms = (
+                getattr(self, "_plan_ms", 0.0)
+                + (time.perf_counter() - t_span) * 1e3
+            )
+
+    def _plan_stmt_inner(
+        self, stmt: ast.Statement, optimized: bool = True
+    ) -> P.PlanNode:
         from trino_tpu import fault, session_properties
 
         t_plan = time.monotonic()
@@ -186,6 +218,13 @@ class QueryRunner:
             prev_ctx = self.executor.memory_ctx
             qctx = self.executor.memory_pool.query_context(query_id)
             self.executor.memory_ctx = qctx
+            from trino_tpu import telemetry
+
+            prev_tracer = getattr(self, "_tracer", None)
+            prev_plan_ms = getattr(self, "_plan_ms", 0.0)
+            tracer = telemetry.Tracer(query_id)
+            self._tracer = tracer
+            self._plan_ms = 0.0
             t0 = time.perf_counter()
             error = None
             result = None
@@ -204,6 +243,50 @@ class QueryRunner:
                 self.executor.cancel_event = None
                 self.executor.deadline = None
                 self.executor.memory_ctx = prev_ctx
+                plan_ms = self._plan_ms
+                self._tracer = prev_tracer
+                self._plan_ms = prev_plan_ms
+                elapsed_ms = (time.perf_counter() - t0) * 1e3
+                state = "FAILED" if error else "FINISHED"
+                telemetry.QUERIES_TOTAL.inc(state=state)
+                node_id = self.executor.memory_pool.node_id
+                if result is not None:
+                    result.trace = tracer.finish()
+                    result.planning_ms = plan_ms
+                    result.execution_ms = max(elapsed_ms - plan_ms, 0.0)
+                    if not result.stage_stats:
+                        # local execution is one pseudo-stage; the fleet
+                        # runner fills real per-stage aggregates instead
+                        result.stage_stats = [{
+                            "stage_id": "local",
+                            "tasks": 1,
+                            "rows_in": 0,
+                            "rows_out": len(result.rows),
+                            "bytes_out": 0,
+                            "elapsed_ms": elapsed_ms,
+                            "retries": 0,
+                            "peak_memory_bytes": qctx.peak_bytes,
+                        }]
+                    if not result.task_stats:
+                        # mirror the (possibly _explain-provided)
+                        # stage aggregate so system.runtime.tasks and
+                        # stage_stats always report the same numbers
+                        st = result.stage_stats[0]
+                        result.task_stats = [{
+                            "query_id": query_id,
+                            "stage_id": st["stage_id"],
+                            "task_id": f"{st['stage_id']}.0",
+                            "attempt": 0,
+                            "state": state,
+                            "worker": node_id,
+                            "elapsed_ms": st["elapsed_ms"],
+                            "rows_in": st["rows_in"],
+                            "rows_out": st["rows_out"],
+                            "bytes_out": st["bytes_out"],
+                            "peak_memory_bytes": st[
+                                "peak_memory_bytes"
+                            ],
+                        }]
                 listeners = getattr(self.metadata, "event_listeners", ())
                 if listeners:
                     from trino_tpu.events import (
@@ -215,15 +298,32 @@ class QueryRunner:
                         query_id=query_id,
                         user=self.session.user,
                         sql=sql,
-                        state="FAILED" if error else "FINISHED",
-                        elapsed_ms=(time.perf_counter() - t0) * 1e3,
+                        state=state,
+                        elapsed_ms=elapsed_ms,
                         rows=len(result.rows) if result else 0,
                         error=error,
                         peak_memory_bytes=qctx.peak_bytes,
                         peak_memory_per_node=(
-                            (self.executor.memory_pool.node_id,
-                             qctx.peak_bytes),
+                            (node_id, qctx.peak_bytes),
                         ) if qctx.peak_bytes else (),
+                        planning_ms=plan_ms,
+                        execution_ms=max(elapsed_ms - plan_ms, 0.0),
+                        cpu_ms=max(elapsed_ms - plan_ms, 0.0),
+                        query_retries=(
+                            result.query_retries if result else 0
+                        ),
+                        tasks_retried=(
+                            result.tasks_retried if result else 0
+                        ),
+                        tasks_speculated=(
+                            result.tasks_speculated if result else 0
+                        ),
+                        speculation_wins=(
+                            result.speculation_wins if result else 0
+                        ),
+                        workers_readmitted=(
+                            result.workers_readmitted if result else 0
+                        ),
                     ))
 
     def _execute(self, sql: str) -> QueryResult:
@@ -378,25 +478,31 @@ class QueryRunner:
             self.executor.invalidate_scan(cat, sch, tab)
             return QueryResult(["result"], [("DROP TABLE",)])
         plan = self.plan_stmt(stmt)
+        tracer = getattr(self, "_tracer", None)
+        exec_span = (
+            tracer.span("execute", "execution") if tracer is not None
+            else _NullCtx()
+        )
         self.executor._defer_ok = True
         try:
             done = False
-            for _attempt in range(8):
-                page = self.executor.execute(plan)
-                pend = getattr(page, "pending_flags", None)
-                if pend is None:
-                    rows = page.to_pylist()
-                    done = True
-                    break
-                # deferred final-chain sync: the result transfer
-                # carries the overflow flags; a tripped capacity
-                # re-runs the query with the bumped (persisted) size
-                rows, flags = page.to_pylist(extra=pend[0])
-                if not self.executor.note_deferred_overflow(
-                    (flags, pend[1], pend[2])
-                ):
-                    done = True
-                    break
+            with exec_span:
+                for _attempt in range(8):
+                    page = self.executor.execute(plan)
+                    pend = getattr(page, "pending_flags", None)
+                    if pend is None:
+                        rows = page.to_pylist()
+                        done = True
+                        break
+                    # deferred final-chain sync: the result transfer
+                    # carries the overflow flags; a tripped capacity
+                    # re-runs the query with the bumped (persisted) size
+                    rows, flags = page.to_pylist(extra=pend[0])
+                    if not self.executor.note_deferred_overflow(
+                        (flags, pend[1], pend[2])
+                    ):
+                        done = True
+                        break
             if not done:
                 # never return rows from an overflowed execution
                 raise RuntimeError(
@@ -620,17 +726,36 @@ class QueryRunner:
             total_ms = (time.perf_counter() - t0) * 1e3
         finally:
             del ex.execute
-        lines = [
-            f"Query: {len(rows)} rows, {total_ms:.1f} ms total",
-        ]
+        # fold the per-node timings into the single local pseudo-stage's
+        # aggregate: EXPLAIN ANALYZE's stage line, QueryResult.stage_stats
+        # and system.runtime.tasks all render from this one dict
+        from trino_tpu.exec.spill import row_bytes
+
         peak = getattr(ex, "memory_ctx", None)
-        if peak is not None and peak.peak_bytes:
+        peak_bytes = peak.peak_bytes if peak is not None else 0
+        rows_in = sum(
+            stats[id(n)][1]
+            for n in _walk_plan(plan)
+            if not n.sources and id(n) in stats
+        )
+        stage_stats = [{
+            "stage_id": "local",
+            "tasks": 1,
+            "rows_in": rows_in,
+            "rows_out": len(rows),
+            "bytes_out": len(rows) * row_bytes(plan.outputs),
+            "elapsed_ms": total_ms,
+            "retries": 0,
+            "peak_memory_bytes": peak_bytes,
+        }]
+        lines = [_stage_stats_line("Query", stage_stats[0])]
+        if peak_bytes:
             # per-node peak reservations (QueryStats
             # peakUserMemoryReservation in EXPLAIN ANALYZE analog)
             lines.append(
-                f"Peak memory: {_fmt_bytes(peak.peak_bytes)} "
+                f"Peak memory: {_fmt_bytes(peak_bytes)} "
                 f"({ex.memory_pool.node_id}: "
-                f"{_fmt_bytes(peak.peak_bytes)})"
+                f"{_fmt_bytes(peak_bytes)})"
             )
         if xstats is not None and xstats["exchanges"] > x0["exchanges"]:
             # distributed exchange telemetry (the reference surfaces
@@ -644,7 +769,38 @@ class QueryRunner:
                 f"{getattr(ex, 'exchange_escalations', 0) - esc0}"
             )
         lines.extend(_annotated_tree(plan, stats).splitlines())
-        return QueryResult(["Query Plan"], [(line,) for line in lines])
+        out = QueryResult(["Query Plan"], [(line,) for line in lines])
+        out.stage_stats = stage_stats
+        return out
+
+
+class _NullCtx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return None
+
+
+def _walk_plan(node: P.PlanNode):
+    yield node
+    for s in node.sources:
+        yield from _walk_plan(s)
+
+
+def _stage_stats_line(label: str, st: dict) -> str:
+    """One EXPLAIN ANALYZE stage line rendered from a stage_stats dict
+    (the single source both the local and fleet paths use)."""
+    line = (
+        f"{label}: {st['tasks']} task(s), in: {st['rows_in']} rows, "
+        f"out: {st['rows_out']} rows ({_fmt_bytes(st['bytes_out'])}), "
+        f"{st['elapsed_ms']:.1f} ms total"
+    )
+    if st.get("retries"):
+        line += f", retries: {st['retries']}"
+    if st.get("peak_memory_bytes"):
+        line += f", peak memory: {_fmt_bytes(st['peak_memory_bytes'])}"
+    return line
 
 
 def _timed_frontier_ms(node: P.PlanNode, stats) -> float:

@@ -6,15 +6,28 @@ pluggable listeners registered on the Metadata receive a
 QueryCompletedEvent after every statement — success or failure — with
 identity, timing, and io counters. Listeners must not fail the query:
 exceptions are swallowed (the reference isolates listener errors the
-same way).
+same way), but each swallow is counted in the metrics registry and
+logged at debug level so a broken listener is visible.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
+import logging
 import time
 from dataclasses import dataclass, field
 
-__all__ = ["QueryCompletedEvent", "EventListener", "fire_query_completed"]
+from trino_tpu import telemetry
+
+__all__ = [
+    "QueryCompletedEvent",
+    "EventListener",
+    "StructuredLogListener",
+    "fire_query_completed",
+]
+
+_log = logging.getLogger("trino_tpu.events")
 
 
 @dataclass(frozen=True)
@@ -40,6 +53,19 @@ class QueryCompletedEvent:
     #: per-node attribution as ((node_id, bytes), ...) — a tuple
     #: because the event is frozen/hashable
     peak_memory_per_node: tuple = ()
+    #: elapsed split (QueryStatistics queued/planning/execution/cpu
+    #: analog); queued_ms is only nonzero for coordinator-submitted
+    #: queries that waited for admission
+    queued_ms: float = 0.0
+    planning_ms: float = 0.0
+    execution_ms: float = 0.0
+    cpu_ms: float = 0.0
+    #: FTE / governance counters (mirrors of the QueryResult fields)
+    query_retries: int = 0
+    tasks_retried: int = 0
+    tasks_speculated: int = 0
+    speculation_wins: int = 0
+    workers_readmitted: int = 0
 
 
 class EventListener:
@@ -49,11 +75,43 @@ class EventListener:
         pass
 
 
+class StructuredLogListener(EventListener):
+    """Writes one JSON line per completed query — the reference's
+    http-event-listener / query-log analog, pointed at a local file
+    or any writable stream."""
+
+    def __init__(self, path: str | None = None, stream=None) -> None:
+        if (path is None) == (stream is None):
+            raise ValueError("pass exactly one of path= or stream=")
+        self._path = path
+        self._stream = stream
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        rec = dataclasses.asdict(event)
+        rec["peak_memory_per_node"] = [
+            list(kv) for kv in event.peak_memory_per_node
+        ]
+        line = json.dumps(rec, sort_keys=True, default=str)
+        if self._path is not None:
+            with open(self._path, "a") as f:
+                f.write(line + "\n")
+        else:
+            self._stream.write(line + "\n")
+
+
 def fire_query_completed(listeners, event: QueryCompletedEvent) -> None:
     """Deliver to every listener, isolating failures (a broken
-    listener must never fail the query — reference behavior)."""
+    listener must never fail the query — reference behavior). Each
+    swallowed exception increments
+    ``trino_event_listener_failures_total`` and is debug-logged."""
     for lst in listeners:
         try:
             lst.query_completed(event)
         except Exception:
-            pass
+            telemetry.LISTENER_FAILURES.inc(
+                listener=type(lst).__name__
+            )
+            _log.debug(
+                "event listener %s raised in query_completed for %s",
+                type(lst).__name__, event.query_id, exc_info=True,
+            )

@@ -30,6 +30,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trino_tpu import telemetry
+
+# this module holds the engine's jitted device kernels: make sure every
+# backend compile they trigger lands in trino_xla_compile_total before
+# the first jit call anywhere in the process
+telemetry.install_jax_compile_hook()
+
 __all__ = [
     "hash_columns",
     "searchsorted",

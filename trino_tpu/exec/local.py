@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from trino_tpu import memory
+from trino_tpu import memory, telemetry
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec import stage
@@ -92,8 +92,12 @@ class LocalExecutor:
     def __init__(self, metadata: Metadata, session: Session):
         self.metadata = metadata
         self.session = session
-        #: structural key -> (jitted fn, host metadata)
-        self._jit_cache: dict = {}
+        # feed trino_xla_compile_total/_seconds_total from jax's own
+        # compile events (idempotent process-wide hook)
+        telemetry.install_jax_compile_hook()
+        #: structural key -> (jitted fn, host metadata); hit/miss rates
+        #: surface as trino_jit_cache_{hits,misses}_total{cache="local"}
+        self._jit_cache: dict = telemetry.CountingCache("local")
         #: (catalog, schema, table) -> {column name: Column}; "" -> mask
         self._scan_cache: dict = {}
         #: dynamic-filter effectiveness log (tests + EXPLAIN ANALYZE):

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
-from trino_tpu import types as T
+from trino_tpu import telemetry, types as T
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec import stage
 from trino_tpu.exec.failure import FailureInjector, InjectedFailure
@@ -179,7 +179,7 @@ class MeshExecutor(LocalExecutor):
         self.n_shards = int(self.mesh.shape[axis])
         self._row_sharding = NamedSharding(self.mesh, PS(axis))
         self._dist_scan_cache: dict = {}
-        self._mesh_jit_cache: dict = {}
+        self._mesh_jit_cache: dict = telemetry.CountingCache("mesh")
         #: test hook: arm per-stage failures; stage programs retry
         #: (FailureInjector analog, MAIN/execution/FailureInjector.java:39)
         self.failure_injector = FailureInjector()
@@ -623,9 +623,11 @@ class MeshExecutor(LocalExecutor):
         )
         leaves, meta = _page_leaves(sp)
         self.exchange_stats["exchanges"] += 1
-        self.exchange_stats["bytes"] += sum(
+        moved = sum(
             int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves
         )
+        self.exchange_stats["bytes"] += moved
+        telemetry.EXCHANGE_BYTES.inc(moved)
         while True:
             key = (
                 "mesh-exchange",

@@ -52,7 +52,7 @@ import zlib
 
 import numpy as np
 
-from trino_tpu import types as T
+from trino_tpu import telemetry, types as T
 from trino_tpu.page import Column, Page, pad_capacity
 
 __all__ = [
@@ -279,10 +279,22 @@ def _save_npz(path: str, payload: dict, sel: np.ndarray) -> int:
         f.write(header)
         f.write(body)
     os.replace(tmp, path)
+    telemetry.SPOOL_BYTES_WRITTEN.inc(len(header) + len(body))
     return zlib.crc32(body, zlib.crc32(header))
 
 
 def _load_npz(path: str, expect_crc: int | None = None) -> dict:
+    """Load + verify one partition file (counts bytes read and CRC
+    failures into the metrics registry)."""
+    try:
+        out = _load_npz_verified(path, expect_crc)
+    except SpoolCorruptionError:
+        telemetry.SPOOL_CRC_FAILURES.inc()
+        raise
+    return out
+
+
+def _load_npz_verified(path: str, expect_crc: int | None = None) -> dict:
     """Load + verify one partition file. ``expect_crc`` is the
     whole-file checksum from the commit manifest (when available);
     the embedded header CRC is always checked. Any mismatch,
@@ -303,6 +315,7 @@ def _load_npz(path: str, expect_crc: int | None = None) -> dict:
     body = raw[_HEADER.size:]
     if zlib.crc32(body) != crc:
         raise SpoolCorruptionError("partition body fails CRC32")
+    telemetry.SPOOL_BYTES_READ.inc(len(raw))
     try:
         with np.load(io.BytesIO(body), allow_pickle=False) as z:
             schema = json.loads(bytes(z["schema"].tobytes()).decode())
@@ -331,8 +344,11 @@ def _stage_dir(root: str, stage_id: str) -> str:
 def write_task_output(
     root: str, stage_id: str, task_id: str, attempt: int, page: Page,
     partitioning: str, key_names: list[str], n_parts: int,
-) -> None:
-    """Partition a task's output page and commit it to the spool."""
+) -> dict:
+    """Partition a task's output page and commit it to the spool.
+
+    Returns ``{"rows": n, "bytes": total_file_bytes}`` for per-task
+    output stats."""
     from trino_tpu import fault
 
     # chaos seam: a spool-write fault fails the producing task BEFORE
@@ -373,6 +389,13 @@ def write_task_output(
     with open(tmp, "w") as f:
         json.dump({"partitions": written, "files": manifest}, f)
     os.replace(tmp, marker)
+    total = sum(
+        os.path.getsize(os.path.join(d, name)) for name in manifest
+    )
+    # the spool IS the fleet's exchange tier: rows committed here are
+    # rows moved between stages
+    telemetry.EXCHANGE_ROWS.inc(int(n))
+    return {"rows": int(n), "bytes": int(total)}
 
 
 def committed_attempt(root: str, stage_id: str, task_id: str) -> int | None:

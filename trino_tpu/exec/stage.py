@@ -26,7 +26,7 @@ from dataclasses import dataclass, field, replace as dc_replace
 import jax.numpy as jnp
 import numpy as np
 
-from trino_tpu import types as T
+from trino_tpu import telemetry, types as T
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec.aggregates import (
     VARIANCE_FNS,
@@ -159,6 +159,10 @@ def build_chain(chain: list[P.PlanNode], layout: ChainLayout, caps: dict[int, li
     """Build (fn, out_layout): ``fn(env, mask) -> (env', mask', flags)``
     is pure and jittable; ``flags`` maps chain position -> overflow
     scalar for each grouped Aggregate."""
+    # each build feeds a fresh trace to jax.jit downstream: the count,
+    # against trino_xla_compile_total, shows how much chain churn turns
+    # into real backend compiles vs jit-cache hits
+    telemetry.CHAINS_BUILT.inc()
     steps = []
     for i, nd in enumerate(chain):
         if isinstance(nd, P.Filter):

@@ -189,6 +189,9 @@ class FaultInjector:
             )
             if fired is not None:
                 self.injected.append((tag, attempt))
+                from trino_tpu import telemetry
+
+                telemetry.CHAOS_INJECTIONS.inc(site=site)
                 raise self.fault_cls(site, tag, attempt, fired.kind)
 
     # ---- cross-process shipping ------------------------------------

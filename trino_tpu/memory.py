@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import threading
 
-from trino_tpu import fault
+from trino_tpu import fault, telemetry
 from trino_tpu import session_properties as SP
 
 __all__ = [
@@ -203,6 +203,8 @@ class MemoryPool:
             self.reserved_bytes += nbytes
             if self.reserved_bytes > self.peak_bytes:
                 self.peak_bytes = self.reserved_bytes
+            telemetry.MEMORY_RESERVED.set(self.reserved_bytes, pool=self.node_id)
+            telemetry.MEMORY_PEAK.set(self.peak_bytes, pool=self.node_id)
 
     def _free(self, ctx: MemoryContext, nbytes: int) -> None:
         with self._lock:
@@ -211,6 +213,7 @@ class MemoryPool:
                 cur.reserved_bytes = max(0, cur.reserved_bytes - nbytes)
                 cur = cur.parent
             self.reserved_bytes = max(0, self.reserved_bytes - nbytes)
+            telemetry.MEMORY_RESERVED.set(self.reserved_bytes, pool=self.node_id)
 
     def snapshot(self) -> dict:
         """JSON-safe pool state shipped on task-status/heartbeat
@@ -300,6 +303,7 @@ class ClusterMemoryManager:
         attribution = ", ".join(
             f"{node}={format_bytes(b)}" for node, b in sorted(per.items())
         )
+        telemetry.MEMORY_KILLS.inc()
         raise ExceededMemoryLimitError(
             f"Query {victim} killed by the cluster memory manager: "
             f"total reservation {format_bytes(totals[victim])} across "

@@ -157,6 +157,23 @@ class Coordinator:
 
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
+                if self.path == "/v1/metrics":
+                    # Prometheus text exposition (the reference's
+                    # /v1/status JMX surface, flattened): query states,
+                    # retry/speculation counters, memory gauges, RPC
+                    # latency histograms
+                    from trino_tpu import telemetry
+
+                    body = telemetry.REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path == "/v1/info":
                     self._send(200, {
                         "nodeVersion": {"version": "trino-tpu-0.1"},

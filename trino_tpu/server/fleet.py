@@ -39,15 +39,22 @@ import uuid
 from collections import deque
 from dataclasses import dataclass
 
-from trino_tpu import fault, memory
+from trino_tpu import fault, memory, telemetry
 from trino_tpu import session_properties as sp
-from trino_tpu.engine import QueryResult, QueryRunner, _has_order
+from trino_tpu.engine import (
+    QueryResult,
+    QueryRunner,
+    _has_order,
+    _stage_stats_line,
+)
 from trino_tpu.exec import spool
 from trino_tpu.exec.local import QueryCancelled
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.plan import nodes as P
 from trino_tpu.plan.fragment import Stage, fragment_plan
 from trino_tpu.plan.serde import plan_to_json
+from trino_tpu.sql import ast
+from trino_tpu.sql.parser import parse_statement
 from trino_tpu.tracker import (
     QueryDeadlineExceededError,
     QueryRetriesExhaustedError,
@@ -238,6 +245,12 @@ class FleetRunner:
         #: current query id (stamped on stage-task requests so worker
         #: pools attribute reservations to the right query)
         self._query_id: str | None = None
+        #: per-attempt telemetry state (set by _execute_attempt)
+        self._tracer = None
+        self._stage_spans: dict[str, telemetry.Span] = {}
+        self._task_stats: list[dict] = []
+        self._retries_by_stage: dict[str, int] = {}
+        self._plan_ms = 0.0
         #: absolute monotonic deadline / cooperative cancel for the
         #: statement in flight (set per execute())
         self._exec_deadline: float | None = None
@@ -265,6 +278,122 @@ class FleetRunner:
     # ---- query entry -----------------------------------------------------
 
     def execute(self, sql: str, cancel_event=None) -> QueryResult:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.Explain) and not stmt.analyze:
+            # plan rendering only; the embedded planner shares the
+            # fleet's parallelism stand-in, so the printed tree matches
+            # what would run distributed
+            return self._planner.execute(sql)
+        explain_analyze = isinstance(stmt, ast.Explain)
+        if explain_analyze:
+            stmt = stmt.statement
+        t0 = time.perf_counter()
+        error = None
+        result = None
+        try:
+            result = self._execute_stmt(stmt, cancel_event)
+            if explain_analyze:
+                result = self._render_fleet_analyze(result)
+            return result
+        except Exception as e:
+            error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            state = "FAILED" if error else "FINISHED"
+            telemetry.QUERIES_TOTAL.inc(state=state)
+            listeners = getattr(self.metadata, "event_listeners", ())
+            if listeners:
+                from trino_tpu.events import (
+                    QueryCompletedEvent,
+                    fire_query_completed,
+                )
+
+                elapsed_ms = (time.perf_counter() - t0) * 1e3
+                fire_query_completed(listeners, QueryCompletedEvent(
+                    query_id=self._query_id or "",
+                    user=self.session.user,
+                    sql=sql,
+                    state=state,
+                    elapsed_ms=elapsed_ms,
+                    rows=len(result.rows) if result else 0,
+                    error=error,
+                    peak_memory_bytes=(
+                        result.peak_memory_bytes if result else 0
+                    ),
+                    peak_memory_per_node=tuple(sorted(
+                        result.peak_memory_per_node.items()
+                    )) if result else (),
+                    planning_ms=getattr(self, "_plan_ms", 0.0),
+                    execution_ms=(
+                        result.execution_ms if result else elapsed_ms
+                    ),
+                    cpu_ms=(
+                        result.execution_ms if result else elapsed_ms
+                    ),
+                    query_retries=(
+                        result.query_retries if result else 0
+                    ),
+                    tasks_retried=self.stats.get("tasks_retried", 0),
+                    tasks_speculated=self.stats.get(
+                        "tasks_speculated", 0
+                    ),
+                    speculation_wins=self.stats.get(
+                        "speculation_wins", 0
+                    ),
+                    workers_readmitted=self.stats.get(
+                        "workers_readmitted", 0
+                    ),
+                ))
+
+    def _render_fleet_analyze(self, res: QueryResult) -> QueryResult:
+        """EXPLAIN ANALYZE rendering for distributed runs.
+
+        One line per stage from the same ``stage_stats`` dicts that
+        back ``system.runtime.tasks``, so the three views always agree.
+        """
+        from trino_tpu.engine import _fmt_bytes
+
+        stats = res.stage_stats
+        total = {
+            "stage_id": "query",
+            "tasks": sum(st["tasks"] for st in stats),
+            # cumulative operator input across stages (intermediate
+            # rows count once per stage boundary, as in the reference's
+            # cumulative query stats)
+            "rows_in": sum(st["rows_in"] for st in stats),
+            "rows_out": len(res.rows),
+            "bytes_out": stats[-1]["bytes_out"] if stats else 0,
+            "elapsed_ms": res.execution_ms,
+            "retries": sum(st.get("retries", 0) for st in stats),
+            "peak_memory_bytes": res.peak_memory_bytes,
+        }
+        lines = [_stage_stats_line("Query", total)]
+        if res.peak_memory_per_node:
+            per_node = ", ".join(
+                f"{node}: {_fmt_bytes(b)}"
+                for node, b in sorted(res.peak_memory_per_node.items())
+            )
+            lines.append(
+                f"Peak memory: {_fmt_bytes(res.peak_memory_bytes)} "
+                f"({per_node})"
+            )
+        for st in stats:
+            lines.append(_stage_stats_line(f"Stage {st['stage_id']}", st))
+        plan = getattr(self, "_last_plan", None)
+        if plan is not None:
+            lines.extend(P.plan_tree_str(plan).splitlines())
+        out = QueryResult(["Query Plan"], [(line,) for line in lines])
+        out.stage_stats = res.stage_stats
+        out.task_stats = res.task_stats
+        out.trace = res.trace
+        out.planning_ms = res.planning_ms
+        out.execution_ms = res.execution_ms
+        out.peak_memory_bytes = res.peak_memory_bytes
+        out.peak_memory_per_node = res.peak_memory_per_node
+        out.query_retries = res.query_retries
+        return out
+
+    def _execute_stmt(self, stmt, cancel_event=None) -> QueryResult:
         raw = self.session.properties.get("retry_max_attempts")
         self.max_attempts = (
             int(raw) if raw is not None else self._default_max_attempts
@@ -337,13 +466,19 @@ class FleetRunner:
                 self.retry_delays.append(delay)
                 time.sleep(delay)
                 query_retries += 1
+                telemetry.QUERY_RETRIES.inc()
             try:
                 if plan is None:
                     # planning inside the loop: a transient planner
                     # fault is query-retryable; the successful plan is
                     # reused across attempts (it is deterministic)
-                    plan = self._planner.plan_sql(sql)
+                    t_plan = time.perf_counter()
+                    plan = self._planner.plan_stmt(stmt)
                     stages = fragment_plan(plan)
+                    self._plan_ms = (
+                        (time.perf_counter() - t_plan) * 1e3
+                    )
+                    self._last_plan = plan
                 return self._execute_attempt(plan, stages, query_retries)
             except Exception as e:
                 if policy != "QUERY" or not _query_tier_retryable(e):
@@ -362,15 +497,30 @@ class FleetRunner:
         """One whole-statement execution under its own spool epoch."""
         query_id = uuid.uuid4().hex[:12]
         self._query_id = query_id
+        # one trace per execution attempt: stage/task/rpc spans hang
+        # off this root; worker-side subtrees stitch in via the trace
+        # context shipped on /v1/stagetask (self._stage_spans)
+        tracer = telemetry.Tracer(query_id)
+        self._tracer = tracer
+        plan_ms = getattr(self, "_plan_ms", 0.0)
+        if plan_ms:
+            psp = tracer.start("planning", "planning")
+            psp.duration_ms = plan_ms
+            psp._open = False
+        self._stage_spans: dict[str, telemetry.Span] = {}
+        self._task_stats: list[dict] = []
+        self._retries_by_stage: dict[str, int] = {}
         qroot = os.path.join(self.spool_root, query_id)
         os.makedirs(qroot, exist_ok=True)
         tasks_by_stage: dict[str, list[str]] = {}
+        t0 = time.perf_counter()
         try:
             self._run_dag(stages, qroot, tasks_by_stage)
-            payload = self._read_root(stages, qroot, tasks_by_stage)
+            with tracer.span("read-root", "spool"):
+                payload = self._read_root(stages, qroot, tasks_by_stage)
             page = spool.host_to_page(payload)
             rows = page.to_pylist()
-            return QueryResult(
+            res = QueryResult(
                 names=list(page.names), rows=rows,
                 ordered=_has_order(plan), plan=plan,
                 peak_memory_bytes=self.cluster_memory.query_total(
@@ -382,11 +532,57 @@ class FleetRunner:
                 query_retries=query_retries,
                 **self.stats,
             )
+            res.planning_ms = plan_ms
+            res.execution_ms = (time.perf_counter() - t0) * 1e3
+            res.task_stats = list(self._task_stats)
+            res.stage_stats = self._aggregate_stage_stats(stages)
+            trace = tracer.finish()
+            for spn in trace.root.walk():
+                if spn._open:
+                    spn.finish()
+            res.trace = trace
+            return res
         finally:
+            self._tracer = None
             if not self.keep_spool:
                 import shutil
 
                 shutil.rmtree(qroot, ignore_errors=True)
+
+    def _aggregate_stage_stats(self, stages: list[Stage]) -> list[dict]:
+        """Fold per-task stats (off task-status responses) into the
+        per-stage aggregates EXPLAIN ANALYZE and system.runtime.tasks
+        render from. ``elapsed_ms``/``peak_memory_bytes`` are per-stage
+        maxima over tasks (stage wall-clock ~ slowest task); rows and
+        bytes are sums over committed attempts."""
+        by_stage: dict[str, dict] = {}
+
+        def entry(sid: str) -> dict:
+            return by_stage.setdefault(sid, {
+                "stage_id": sid, "tasks": 0, "rows_in": 0,
+                "rows_out": 0, "bytes_out": 0, "elapsed_ms": 0.0,
+                "retries": 0, "peak_memory_bytes": 0,
+            })
+
+        for ts in self._task_stats:
+            st = entry(ts["stage_id"])
+            if ts.get("state") != "FINISHED":
+                continue
+            st["tasks"] += 1
+            st["rows_in"] += int(ts.get("rows_in", 0) or 0)
+            st["rows_out"] += int(ts.get("rows_out", 0) or 0)
+            st["bytes_out"] += int(ts.get("bytes_out", 0) or 0)
+            st["elapsed_ms"] = max(
+                st["elapsed_ms"], float(ts.get("elapsed_ms", 0.0) or 0)
+            )
+            st["peak_memory_bytes"] = max(
+                st["peak_memory_bytes"],
+                int(ts.get("peak_memory_bytes", 0) or 0),
+            )
+        for sid, n in self._retries_by_stage.items():
+            entry(sid)["retries"] = n
+        order = [s.stage_id for s in stages]
+        return [by_stage[sid] for sid in order if sid in by_stage]
 
     def _read_root(
         self, stages: list[Stage], qroot: str,
@@ -449,6 +645,7 @@ class FleetRunner:
             except Exception:
                 continue
             self.stats["tasks_retried"] += 1
+            telemetry.TASKS_RETRIED.inc()
             while time.monotonic() < deadline:
                 try:
                     state = self._poll_task(w, spec.task_id, attempt)
@@ -639,6 +836,10 @@ class FleetRunner:
                     f"task {tid} failed after {failures[tid]} "
                     f"attempts: {error}"
                 )
+            telemetry.TASKS_RETRIED.inc()
+            self._retries_by_stage[stage.stage_id] = (
+                self._retries_by_stage.get(stage.stage_id, 0) + 1
+            )
             # exponential backoff with FULL jitter (delay drawn
             # uniformly from [0, cap]): retries of correlated failures
             # decorrelate instead of stampeding the fleet in sync
@@ -682,6 +883,10 @@ class FleetRunner:
                 spool.next_attempt(qroot, psid, ptid),
             )
             self.stats["tasks_retried"] += 1
+            telemetry.TASKS_RETRIED.inc()
+            self._retries_by_stage[psid] = (
+                self._retries_by_stage.get(psid, 0) + 1
+            )
             push(pstage, pspec)
 
         def cancel_attempt(
@@ -745,6 +950,7 @@ class FleetRunner:
                 self._probe_delay.pop(w.uri, None)
                 self._probe_at.pop(w.uri, None)
                 self.stats["workers_readmitted"] += 1
+                telemetry.WORKERS_READMITTED.inc()
             # admit newly-ready stages (task construction sees current
             # worker liveness, so it happens at admission, not upfront)
             for stage in stages:
@@ -752,6 +958,18 @@ class FleetRunner:
                     continue
                 specs = self._make_tasks(stage)
                 specs_of[stage.stage_id] = specs
+                if (
+                    self._tracer is not None
+                    and stage.stage_id not in self._stage_spans
+                ):
+                    # stage span: admission -> full commit; worker task
+                    # subtrees stitch in under it via the trace context
+                    self._stage_spans[stage.stage_id] = (
+                        self._tracer.start(
+                            f"stage {stage.stage_id}", "stage",
+                            tasks=len(specs),
+                        )
+                    )
                 for spec in specs:
                     next_attempt_no[spec.task_id] = 0
                     failures[spec.task_id] = 0
@@ -860,11 +1078,29 @@ class FleetRunner:
                     if tid in done_of[sid]:
                         continue  # duplicate commit of a raced attempt
                     done_of[sid].add(tid)
+                    # per-task stats + worker-side span subtree ride on
+                    # the FINISHED status response
+                    tstats = state.get("stats") or {}
+                    self._task_stats.append({
+                        "query_id": self._query_id,
+                        "stage_id": sid, "task_id": tid, "attempt": a,
+                        "state": "FINISHED", "worker": w.uri,
+                        "rows_in": tstats.get("rows_in", 0),
+                        "rows_out": tstats.get("rows_out", 0),
+                        "bytes_out": tstats.get("bytes_out", 0),
+                        "elapsed_ms": tstats.get("elapsed_ms", 0.0),
+                        "peak_memory_bytes": tstats.get(
+                            "peak_memory_bytes", 0
+                        ),
+                    })
+                    if self._tracer is not None and state.get("spans"):
+                        self._tracer.attach(state["spans"])
                     runtimes.setdefault(sid, []).append(
                         time.monotonic() - t0
                     )
                     if key in speculative:
                         self.stats["speculation_wins"] += 1
+                        telemetry.SPECULATION_WINS.inc()
                     # first committed attempt wins: cancel the losers
                     for k2 in [k for k in inflight if k[0] == tid]:
                         (w2, _, _, _) = inflight.pop(k2)
@@ -874,11 +1110,21 @@ class FleetRunner:
                             s.task_id for s in specs_of[sid]
                         ]
                         complete.add(sid)
+                        ssp = self._stage_spans.get(sid)
+                        if ssp is not None:
+                            ssp.finish()
                         if self.stage_hook is not None:
                             self.stage_hook(sid)
                 elif state["state"] == "FAILED":
                     del inflight[key]
                     error = state.get("error", "task failed")
+                    self._task_stats.append({
+                        "query_id": self._query_id,
+                        "stage_id": sid, "task_id": tid, "attempt": a,
+                        "state": "FAILED", "worker": w.uri,
+                        "rows_in": 0, "rows_out": 0, "bytes_out": 0,
+                        "elapsed_ms": 0.0, "peak_memory_bytes": 0,
+                    })
                     handle_corruption(error)
                     if tid in done_of[sid]:
                         continue  # a sibling attempt already committed
@@ -940,6 +1186,7 @@ class FleetRunner:
                     speculative.add((tid, a2))
                     speculated_tids.add(tid)
                     self.stats["tasks_speculated"] += 1
+                    telemetry.TASKS_SPECULATED.inc()
                     idle.remove(x)
                     if self.post_hook is not None:
                         self.post_hook(sid, tid, x)
@@ -995,25 +1242,55 @@ class FleetRunner:
             # spool directory name doubles as the query id
             "query_id": self._query_id or os.path.basename(qroot),
         }
+        # trace context: the worker roots its task span under this
+        # stage's span, so the shipped-back subtree stitches into the
+        # coordinator's query trace
+        ssp = self._stage_spans.get(stage.stage_id)
+        if self._tracer is not None and ssp is not None:
+            req["trace"] = {
+                "trace_id": self._tracer.trace_id,
+                "parent_span_id": ssp.span_id,
+            }
+        rpc_span = (
+            ssp.child(
+                f"rpc post {spec.task_id}.{attempt}", "rpc",
+                worker=w.uri,
+            )
+            if ssp is not None else None
+        )
         body = json.dumps(req).encode()
         r = urllib.request.Request(
             f"{w.uri}/v1/stagetask", data=body,
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(
-            r, timeout=self.rpc_timeout_s
-        ) as resp:
-            json.loads(resp.read())
+        t_rpc = time.perf_counter()
+        try:
+            with urllib.request.urlopen(
+                r, timeout=self.rpc_timeout_s
+            ) as resp:
+                json.loads(resp.read())
+        finally:
+            if rpc_span is not None:
+                rpc_span.finish()
+            telemetry.RPC_LATENCY.observe(
+                time.perf_counter() - t_rpc, op="post"
+            )
 
     def _poll_task(self, w: FleetWorker, task_id: str, attempt: int) -> dict:
         # an injected poll fault counts toward the consecutive-timeout
         # eviction threshold, like a real unresponsive worker
         fault.check("rpc", tag=f"poll:{task_id}", attempt=attempt)
-        with urllib.request.urlopen(
-            f"{w.uri}/v1/stagetask/{task_id}.{attempt}",
-            timeout=self.rpc_timeout_s,
-        ) as resp:
-            return json.loads(resp.read())
+        t_rpc = time.perf_counter()
+        try:
+            with urllib.request.urlopen(
+                f"{w.uri}/v1/stagetask/{task_id}.{attempt}",
+                timeout=self.rpc_timeout_s,
+            ) as resp:
+                return json.loads(resp.read())
+        finally:
+            telemetry.RPC_LATENCY.observe(
+                time.perf_counter() - t_rpc, op="poll"
+            )
 
 
 def _bind_split(

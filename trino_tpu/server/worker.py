@@ -19,7 +19,7 @@ import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from trino_tpu import fault
+from trino_tpu import fault, telemetry
 from trino_tpu.engine import QueryRunner
 from trino_tpu.plan.serde import plan_from_json
 
@@ -36,6 +36,12 @@ class _Task:
         self.payload: dict | None = None
         self.n_rows = 0
         self.cancel = threading.Event()
+        #: per-task runtime stats / serialized span subtree (stage
+        #: tasks only) — ride back on the FINISHED status response so
+        #: the coordinator folds them into QueryResult.stage_stats and
+        #: stitches the spans into the query trace
+        self.stats: dict | None = None
+        self.spans: dict | None = None
 
 
 class InjectedTaskFailure(fault.InjectedFault):
@@ -116,6 +122,11 @@ class WorkerServer:
                     ))
                 elif t.state in ("FAILED", "CANCELED"):
                     payload.update(error=t.error)
+                if t.state == "FINISHED":
+                    if t.stats is not None:
+                        payload["stats"] = t.stats
+                    if t.spans is not None:
+                        payload["spans"] = t.spans
                 # pool snapshot on every status response: the
                 # coordinator's ClusterMemoryManager aggregates these
                 # (the heartbeat memory surface of the reference's
@@ -127,6 +138,20 @@ class WorkerServer:
 
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
+                if parts == ["v1", "metrics"]:
+                    # Prometheus text exposition of the process-wide
+                    # registry (worker-side counters: task states,
+                    # spool bytes, chaos injections, XLA compiles)
+                    body = telemetry.REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if (
                     len(parts) in (4, 5)
                     and parts[:2] == ["v1", "task"]
@@ -353,6 +378,27 @@ class WorkerServer:
 
         def run():
             self._task_started()
+            import time as _time
+
+            t_task = _time.perf_counter()
+            # worker half of the stitched trace: the task span roots
+            # under the coordinator's stage span (parent_id from the
+            # shipped trace context) and goes back serialized on the
+            # FINISHED status response
+            trace_ctx = req.get("trace") or {}
+            tspan = telemetry.Span(
+                name=f"task {tkey}", kind="task",
+                parent_id=trace_ctx.get("parent_span_id"),
+                trace_id=str(trace_ctx.get("trace_id") or ""),
+                node=f"127.0.0.1:{self.port}",
+                attrs={
+                    "task_id": req["task_id"],
+                    "attempt": int(req["attempt"]),
+                },
+            )
+            rows_in = 0
+            out_stats = {"rows": 0, "bytes": 0}
+            peak_bytes = 0
             try:
                 if req.get("fail"):
                     raise InjectedTaskFailure(
@@ -403,6 +449,7 @@ class WorkerServer:
                             attempt=int(req["attempt"]),
                         )
                         pages = {}
+                        read_sp = tspan.child("spool-read", "spool")
                         for src in req["sources"]:
                             part = (
                                 partition if src["mode"] == "aligned"
@@ -412,9 +459,13 @@ class WorkerServer:
                                 root, src["stage_id"], src["task_ids"],
                                 part,
                             )
+                            if payload.get("cols"):
+                                rows_in += len(payload["cols"][0][0])
                             pages[src["source_id"]] = spool.host_to_page(
                                 payload
                             )
+                        read_sp.finish()
+                        read_sp.attrs["rows"] = rows_in
                         saved = dict(self.runner.session.properties)
                         self.runner.session.properties.update(
                             req.get("session") or {}
@@ -431,10 +482,12 @@ class WorkerServer:
                         # the pool snapshot the coordinator aggregates
                         qid = str(req.get("query_id") or req["task_id"])
                         prev_ctx = ex.memory_ctx
-                        ex.memory_ctx = ex.memory_pool.query_context(
+                        task_ctx = ex.memory_pool.query_context(
                             qid
                         ).child(tkey)
+                        ex.memory_ctx = task_ctx
                         try:
+                            exec_sp = tspan.child("execute", "execution")
                             if self.runner.mesh is not None:
                                 # fleet x mesh: the fragment runs SPMD
                                 # over this worker's device mesh
@@ -448,20 +501,27 @@ class WorkerServer:
                                     page = ex.execute(plan)
                             else:
                                 page = ex.execute(plan)
+                            exec_sp.finish()
                             # a cancelled speculative loser should not
                             # burn spool writes; a cancel arriving after
                             # this check commits anyway, which
                             # attempt-dedup makes safe
                             if not task.cancel.is_set():
-                                spool.write_task_output(
+                                write_sp = tspan.child(
+                                    "spool-write", "spool"
+                                )
+                                out_stats = spool.write_task_output(
                                     root, out["stage_id"],
                                     req["task_id"],
                                     int(req["attempt"]), page,
                                     out["partitioning"],
                                     out["hash_symbols"],
                                     int(out["n_partitions"]),
-                                )
+                                ) or out_stats
+                                write_sp.finish()
+                                write_sp.attrs.update(out_stats)
                         finally:
+                            peak_bytes = task_ctx.peak_bytes
                             ex.cancel_event = None
                             ex.remote_pages = {}
                             ex.remote_hash_keys = {}
@@ -472,6 +532,16 @@ class WorkerServer:
                             fault.deactivate()
                 with self._lock:
                     if not task.cancel.is_set():
+                        task.stats = {
+                            "rows_in": int(rows_in),
+                            "rows_out": int(out_stats.get("rows", 0)),
+                            "bytes_out": int(out_stats.get("bytes", 0)),
+                            "elapsed_ms": (
+                                (_time.perf_counter() - t_task) * 1e3
+                            ),
+                            "peak_memory_bytes": int(peak_bytes),
+                        }
+                        task.spans = tspan.finish().to_dict()
                         task.state = "FINISHED"
             except Exception as e:
                 task.error = f"{type(e).__name__}: {e}"
@@ -479,6 +549,7 @@ class WorkerServer:
                     "CANCELED" if task.cancel.is_set() else "FAILED"
                 )
             finally:
+                telemetry.WORKER_TASKS.inc(state=task.state)
                 self._task_finished()
 
         threading.Thread(target=run, daemon=True).start()

@@ -1,0 +1,565 @@
+"""Unified telemetry: trace spans, a process-wide metrics registry, and
+Prometheus text rendering.
+
+Three cooperating pieces (mirroring the reference engine's airlift stats +
+OpenTelemetry tracing split):
+
+* **Spans** — hierarchical wall-clock spans (query → planning → stage →
+  task → operator), serialisable so worker-side subtrees can ride back on
+  task-status responses and stitch into the coordinator's query trace.
+  Exportable as Chrome trace-event JSON (chrome://tracing / Perfetto).
+* **MetricsRegistry** — labelled counters / gauges / histograms rendered
+  in Prometheus text exposition format; a process-global ``REGISTRY`` is
+  served at ``GET /v1/metrics`` by both coordinator and worker.
+* **XLA compile hooks** — a ``jax.monitoring`` duration listener feeding
+  compile count/seconds counters, plus ``CountingCache`` wrapping the
+  executors' jit caches for hit/miss rates.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Span",
+    "Trace",
+    "Tracer",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "CountingCache",
+    "REGISTRY",
+    "install_jax_compile_hook",
+    "render_prometheus",
+]
+
+
+def _now_ms() -> float:
+    """Epoch milliseconds — spans from different processes share this clock."""
+    return time.time() * 1000.0
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+# ---------------------------------------------------------------------------
+# Trace spans
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Span:
+    name: str
+    kind: str = "internal"  # query|planning|stage|task|operator|spool|rpc|...
+    span_id: str = field(default_factory=_new_id)
+    parent_id: Optional[str] = None
+    trace_id: str = ""
+    start_ms: float = field(default_factory=_now_ms)
+    duration_ms: float = 0.0
+    node: str = ""  # which process produced this span ("" = coordinator)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    children: List["Span"] = field(default_factory=list)
+    _t0: float = field(default_factory=time.perf_counter, repr=False)
+    _open: bool = field(default=True, repr=False)
+
+    def finish(self) -> "Span":
+        if self._open:
+            self.duration_ms = (time.perf_counter() - self._t0) * 1000.0
+            self._open = False
+        return self
+
+    def child(self, name: str, kind: str = "internal", **attrs: Any) -> "Span":
+        sp = Span(name=name, kind=kind, parent_id=self.span_id,
+                  trace_id=self.trace_id, node=self.node, attrs=dict(attrs))
+        self.children.append(sp)
+        return sp
+
+    def walk(self) -> Iterator["Span"]:
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "trace_id": self.trace_id,
+            "start_ms": self.start_ms,
+            "duration_ms": self.duration_ms,
+            "node": self.node,
+            "attrs": dict(self.attrs),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Span":
+        sp = Span(
+            name=d.get("name", "?"),
+            kind=d.get("kind", "internal"),
+            span_id=d.get("span_id") or _new_id(),
+            parent_id=d.get("parent_id"),
+            trace_id=d.get("trace_id", ""),
+            start_ms=float(d.get("start_ms", 0.0)),
+            duration_ms=float(d.get("duration_ms", 0.0)),
+            node=d.get("node", ""),
+            attrs=dict(d.get("attrs") or {}),
+        )
+        sp._open = False
+        sp.children = [Span.from_dict(c) for c in d.get("children") or []]
+        return sp
+
+
+class Trace:
+    """A completed span tree for one query, rooted at the query span."""
+
+    def __init__(self, root: Span) -> None:
+        self.root = root
+        self.trace_id = root.trace_id
+
+    def spans(self) -> List[Span]:
+        return list(self.root.walk())
+
+    def find(self, name: Optional[str] = None, kind: Optional[str] = None) -> List[Span]:
+        out = []
+        for sp in self.root.walk():
+            if name is not None and sp.name != name:
+                continue
+            if kind is not None and sp.kind != kind:
+                continue
+            out.append(sp)
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self.root.to_dict()
+
+    def to_chrome_json(self) -> str:
+        """Render as Chrome trace-event JSON (``ph:"X"`` complete events).
+
+        Loadable in chrome://tracing or https://ui.perfetto.dev. ``pid``
+        groups spans by producing node; ``ts``/``dur`` are microseconds.
+        """
+        events: List[Dict[str, Any]] = []
+        pids: Dict[str, int] = {}
+        for sp in self.root.walk():
+            pid = pids.setdefault(sp.node or "coordinator", len(pids) + 1)
+            events.append({
+                "name": sp.name,
+                "cat": sp.kind,
+                "ph": "X",
+                "ts": sp.start_ms * 1000.0,
+                "dur": max(sp.duration_ms, 0.0) * 1000.0,
+                "pid": pid,
+                "tid": 1,
+                "args": dict(sp.attrs, span_id=sp.span_id,
+                             parent_id=sp.parent_id or ""),
+            })
+        meta = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 1,
+             "args": {"name": node}}
+            for node, pid in pids.items()
+        ]
+        return json.dumps({"traceEvents": meta + events,
+                           "displayTimeUnit": "ms"}, indent=None)
+
+
+class Tracer:
+    """Builds one query's span tree; cheap enough to always be on.
+
+    The coordinator (or local engine) owns a Tracer per query. Workers
+    build detached task subtrees with ``parent_id`` taken from the trace
+    context shipped on ``/v1/stagetask`` and return them serialised on the
+    task-status response; the coordinator stitches them in with
+    :meth:`attach`.
+    """
+
+    def __init__(self, query_id: str = "", trace_id: Optional[str] = None,
+                 node: str = "") -> None:
+        self.trace_id = trace_id or _new_id()
+        self.node = node
+        self.root: Optional[Span] = None
+        self._stack: List[Span] = []
+        if query_id:
+            self.root = Span(name=f"query {query_id}", kind="query",
+                             trace_id=self.trace_id, node=node,
+                             attrs={"query_id": query_id})
+            self._stack = [self.root]
+
+    # -- span lifecycle ----------------------------------------------------
+    def start(self, name: str, kind: str = "internal", parent: Optional[Span] = None,
+              **attrs: Any) -> Span:
+        """Open a span under ``parent`` (default: top of stack / detached root)."""
+        if parent is None and self._stack:
+            parent = self._stack[-1]
+        if parent is not None:
+            sp = parent.child(name, kind, **attrs)
+        else:
+            sp = Span(name=name, kind=kind, trace_id=self.trace_id,
+                      node=self.node, attrs=dict(attrs))
+            if self.root is None:
+                self.root = sp
+        return sp
+
+    def span(self, name: str, kind: str = "internal", **attrs: Any) -> "_SpanCtx":
+        return _SpanCtx(self, name, kind, attrs)
+
+    def attach(self, span_dict: Dict[str, Any]) -> Optional[Span]:
+        """Stitch a serialised (worker-side) subtree under its parent span."""
+        try:
+            sub = Span.from_dict(span_dict)
+        except Exception:
+            return None
+        if self.root is None:
+            return None
+        parent = None
+        if sub.parent_id:
+            for sp in self.root.walk():
+                if sp.span_id == sub.parent_id:
+                    parent = sp
+                    break
+        (parent or self.root).children.append(sub)
+        return sub
+
+    def context(self, parent: Optional[Span] = None) -> Dict[str, str]:
+        """Trace-context dict to ship across RPC boundaries."""
+        sp = parent or (self._stack[-1] if self._stack else self.root)
+        return {"trace_id": self.trace_id,
+                "parent_span_id": sp.span_id if sp is not None else ""}
+
+    def finish(self) -> Trace:
+        for sp in reversed(self._stack):
+            sp.finish()
+        if self.root is None:
+            self.root = Span(name="query", kind="query", trace_id=self.trace_id,
+                             node=self.node)
+        self.root.finish()
+        return Trace(self.root)
+
+
+class _SpanCtx:
+    def __init__(self, tracer: Tracer, name: str, kind: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self._name, self._kind, self._attrs = name, kind, attrs
+        self.span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        self.span = self._tracer.start(self._name, self._kind, **self._attrs)
+        self._tracer._stack.append(self.span)
+        return self.span
+
+    def __exit__(self, *exc: Any) -> None:
+        if self.span is not None:
+            self.span.finish()
+            stack = self._tracer._stack
+            if stack and stack[-1] is self.span:
+                stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry (Prometheus text exposition)
+# ---------------------------------------------------------------------------
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    esc = lambda v: v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in key) + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str) -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+    def render(self) -> List[str]:  # pragma: no cover - overridden
+        return []
+
+    def header(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.kind}"]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help: str) -> None:
+        super().__init__(name, help)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        return [f"{self.name}{_render_labels(k)} {_fmt_val(v)}" for k, v in items]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str) -> None:
+        super().__init__(name, help)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._callbacks: List[Callable[[], Dict[Tuple[Tuple[str, str], ...], float]]] = []
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def add(self, amount: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            merged = dict(self._values)
+        if not merged:
+            merged = {(): 0.0}
+        return [f"{self.name}{_render_labels(k)} {_fmt_val(v)}"
+                for k, v in sorted(merged.items())]
+
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str,
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._totals: Dict[Tuple[Tuple[str, str], ...], int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, **labels: str) -> int:
+        with self._lock:
+            return self._totals.get(_label_key(labels), 0)
+
+    def render(self) -> List[str]:
+        out: List[str] = []
+        with self._lock:
+            keys = sorted(self._counts)
+            for key in keys:
+                counts = self._counts[key]
+                for i, b in enumerate(self.buckets):
+                    lk = key + (("le", _fmt_val(b)),)
+                    out.append(f"{self.name}_bucket{_render_labels(tuple(sorted(lk)))} {counts[i]}")
+                lk = key + (("le", "+Inf"),)
+                out.append(f"{self.name}_bucket{_render_labels(tuple(sorted(lk)))} {self._totals[key]}")
+                out.append(f"{self.name}_sum{_render_labels(key)} {_fmt_val(self._sums[key])}")
+                out.append(f"{self.name}_count{_render_labels(key)} {self._totals[key]}")
+        return out
+
+
+def _fmt_val(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """Registry of named metric families; renders Prometheus text format."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get(self, cls: type, name: str, help: str, **kw: Any) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kw)
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.header())
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+#: Process-global registry served at GET /v1/metrics.
+REGISTRY = MetricsRegistry()
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render()
+
+
+# -- well-known families, created eagerly so /v1/metrics always lists them --
+
+QUERIES_TOTAL = REGISTRY.counter(
+    "trino_queries_total", "Completed queries by terminal state")
+QUERY_RETRIES = REGISTRY.counter(
+    "trino_query_retries_total", "Whole-query re-executions (retry_policy=QUERY)")
+TASKS_RETRIED = REGISTRY.counter(
+    "trino_tasks_retried_total", "Task attempts re-run after failure")
+TASKS_SPECULATED = REGISTRY.counter(
+    "trino_tasks_speculated_total", "Speculative duplicate task attempts launched")
+SPECULATION_WINS = REGISTRY.counter(
+    "trino_speculation_wins_total", "Speculative attempts that finished first")
+WORKERS_READMITTED = REGISTRY.counter(
+    "trino_workers_readmitted_total", "Workers re-admitted after exclusion")
+CHAOS_INJECTIONS = REGISTRY.counter(
+    "trino_chaos_injections_total", "Faults fired by the chaos injector, by site")
+SPOOL_BYTES_WRITTEN = REGISTRY.counter(
+    "trino_spool_bytes_written_total", "Bytes written to exchange spool files")
+SPOOL_BYTES_READ = REGISTRY.counter(
+    "trino_spool_bytes_read_total", "Bytes read back from exchange spool files")
+SPOOL_CRC_FAILURES = REGISTRY.counter(
+    "trino_spool_crc_failures_total", "Spool partition reads failing CRC/manifest checks")
+EXCHANGE_ROWS = REGISTRY.counter(
+    "trino_exchange_rows_total", "Rows moved through mesh exchanges")
+EXCHANGE_BYTES = REGISTRY.counter(
+    "trino_exchange_bytes_total", "Bytes moved through mesh exchanges")
+MEMORY_RESERVED = REGISTRY.gauge(
+    "trino_memory_pool_reserved_bytes", "Currently reserved bytes per memory pool")
+MEMORY_PEAK = REGISTRY.gauge(
+    "trino_memory_pool_peak_bytes", "High-water reserved bytes per memory pool")
+MEMORY_KILLS = REGISTRY.counter(
+    "trino_memory_kills_total", "Queries killed by the cluster memory manager")
+RPC_LATENCY = REGISTRY.histogram(
+    "trino_rpc_latency_seconds", "Coordinator-side fleet RPC latency by op")
+XLA_COMPILES = REGISTRY.counter(
+    "trino_xla_compile_total", "XLA backend compilations observed via jax.monitoring")
+XLA_COMPILE_SECONDS = REGISTRY.counter(
+    "trino_xla_compile_seconds_total", "Cumulative XLA backend compile seconds")
+JIT_CACHE_HITS = REGISTRY.counter(
+    "trino_jit_cache_hits_total", "Executor jit-cache hits, by cache")
+JIT_CACHE_MISSES = REGISTRY.counter(
+    "trino_jit_cache_misses_total", "Executor jit-cache misses, by cache")
+LISTENER_FAILURES = REGISTRY.counter(
+    "trino_event_listener_failures_total", "EventListener callbacks that raised")
+WORKER_TASKS = REGISTRY.counter(
+    "trino_worker_tasks_total", "Stage tasks executed by this worker, by state")
+CHAINS_BUILT = REGISTRY.counter(
+    "trino_chains_built_total", "Fused operator chains built for jit compilation")
+
+
+# ---------------------------------------------------------------------------
+# XLA compile instrumentation
+# ---------------------------------------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_hook_installed = False
+_hook_lock = threading.Lock()
+
+
+def install_jax_compile_hook() -> bool:
+    """Register a jax.monitoring listener feeding the compile counters.
+
+    Idempotent; returns True when the hook is (already) active. Uses the
+    private ``jax._src.monitoring`` registration API (present on jax
+    0.4.x); degrades to a no-op when unavailable.
+    """
+    global _hook_installed
+    with _hook_lock:
+        if _hook_installed:
+            return True
+        try:
+            from jax._src import monitoring as _mon
+
+            def _on_duration(event: str, duration: float, **kw: Any) -> None:
+                if event == _COMPILE_EVENT:
+                    XLA_COMPILES.inc()
+                    XLA_COMPILE_SECONDS.inc(duration)
+
+            _mon.register_event_duration_secs_listener(_on_duration)
+            _hook_installed = True
+        except Exception:
+            _hook_installed = False
+        return _hook_installed
+
+
+def compile_snapshot() -> Dict[str, float]:
+    """Current compile/cache counter values (for before/after deltas)."""
+    return {
+        "compiles": XLA_COMPILES.total(),
+        "compile_seconds": XLA_COMPILE_SECONDS.total(),
+        "cache_hits": JIT_CACHE_HITS.total(),
+        "cache_misses": JIT_CACHE_MISSES.total(),
+    }
+
+
+class CountingCache(dict):
+    """A jit cache dict that counts hit/miss rates into the registry.
+
+    Drop-in for the executors' ``self._jit_cache`` dicts: ``.get`` misses
+    and ``__contains__`` checks that come up empty count as misses; the
+    matching ``.get``/``[]`` that find an entry count as hits.
+    """
+
+    _MISS = object()
+
+    def __init__(self, cache_name: str) -> None:
+        super().__init__()
+        self._cache_name = cache_name
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        hit = dict.get(self, key, CountingCache._MISS)
+        if hit is CountingCache._MISS:
+            JIT_CACHE_MISSES.inc(cache=self._cache_name)
+            return default
+        JIT_CACHE_HITS.inc(cache=self._cache_name)
+        return hit
