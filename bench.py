@@ -33,6 +33,12 @@ resident vs revoked/streamed peaks sit side by side.
 site under both retry tiers (oracle-checked throughout), and the JSON
 line records which sites fired and the retry counts each tier
 absorbed. BENCH_CHAOS_SEED picks the schedule (default 0).
+
+``--stage-admission both`` (or BENCH_STAGE_ADMISSION=1) appends the
+scheduling A/B: TPC-H q3/q5/q9 on a live 2-worker fleet under BARRIER
+vs PIPELINED admission, recording per-query wall-clock, total
+admission-wait, and the producer/consumer overlap seconds pipelined
+admission won.
 """
 
 import argparse
@@ -87,6 +93,14 @@ def main(argv=None) -> None:
         help="also run the seeded chaos soak (trino_tpu.testing.chaos)"
         " against a live 2-worker fleet and record which fault sites"
         " fired and how many retries each tier absorbed",
+    )
+    ap.add_argument(
+        "--stage-admission", choices=["both", "BARRIER", "PIPELINED"],
+        default=None,
+        help="also run the fleet stage-admission A/B: TPC-H q3/q5/q9 "
+        "on a live 2-worker fleet under BARRIER and/or PIPELINED, "
+        "recording wall-clock, per-query admission-wait totals, and "
+        "the producer/consumer overlap the pipelined mode won",
     )
     ap.add_argument(
         "--trace-dir", default=os.environ.get("BENCH_TRACE_DIR"),
@@ -273,6 +287,52 @@ def main(argv=None) -> None:
         detail["sf10_tracked_hwm_bytes"] = int(
             r10.executor.tracked_bytes_hwm
         )
+    if args.stage_admission or _section_enabled(
+        "BENCH_STAGE_ADMISSION", False
+    ):
+        # scheduling A/B (BENCH_r06): the same multi-stage TPC-H
+        # queries on a real 2-process fleet under both admission
+        # modes. PIPELINED should trade admission-wait for overlap at
+        # equal results; both numbers land here so the trade is
+        # auditable per query. Ports 18990+ (bench chaos owns 18980+).
+        import tempfile
+
+        from trino_tpu.testing import chaos as chaos_mod
+
+        pick = args.stage_admission or "both"
+        modes = (
+            ("BARRIER", "PIPELINED") if pick == "both" else (pick,)
+        )
+        procs, uris = chaos_mod.spawn_workers(2, base_port=18990)
+        try:
+            with tempfile.TemporaryDirectory(
+                prefix="bench-admission-"
+            ) as spool:
+                for mode in modes:
+                    fleet = chaos_mod.make_fleet(uris, spool)
+                    fleet.session.properties["stage_admission"] = mode
+                    fleet.session.properties[
+                        "join_distribution_type"
+                    ] = "PARTITIONED"
+                    for q in ("q03", "q05", "q09"):
+                        t0 = time.perf_counter()
+                        res = fleet.execute(QUERIES[q])
+                        key = f"fleet_{mode.lower()}_{q}"
+                        detail[f"{key}_ms"] = round(
+                            (time.perf_counter() - t0) * 1e3, 1
+                        )
+                        detail[f"{key}_admission_wait_ms"] = round(
+                            sum(
+                                st.get("admission_wait_ms", 0.0)
+                                for st in res.stage_stats
+                            ), 1,
+                        )
+                        detail[f"{key}_overlap_s"] = round(
+                            telemetry.SCHED_OVERLAP.value(), 3
+                        )
+        finally:
+            chaos_mod.stop_workers(procs)
+
     if args.chaos or _section_enabled("BENCH_CHAOS", False):
         # robustness gauge, not a perf number: the full seeded soak
         # (all six fault sites, TASK + QUERY tiers, oracle-checked

@@ -246,6 +246,157 @@ def test_chaos_smoke_task_exec(chaos_workers, spool_root):
     )
 
 
+# ---- pipelined admission under chaos (the CI pipelined lane) -------
+
+
+def _oracle_rows(sql):
+    from trino_tpu.engine import QueryRunner
+    from trino_tpu.testing.golden import load_tpch_sqlite, to_sqlite
+
+    oracle = load_tpch_sqlite(
+        QueryRunner.tpch("tiny").metadata.connector("tpch").data("tiny")
+    )
+    return oracle.execute(to_sqlite(sql)).fetchall()
+
+
+def _chaos_run(chaos_workers, spool_root, sql, mode, seed, arm, **props):
+    """One seeded chaos execution under one stage_admission mode."""
+    fleet = chaos.make_fleet(chaos_workers, spool_root)
+    fleet.session.properties["stage_admission"] = mode
+    fleet.session.properties["speculation_enabled"] = False
+    fleet.session.properties["retry_backoff_seed"] = seed
+    fleet.session.properties["retry_initial_delay_ms"] = 5
+    fleet.session.properties["retry_max_delay_ms"] = 20
+    # stretch producer commit tails so pipelined consumers really are
+    # admitted mid-stream, not after an instant full commit
+    fleet.session.properties["spool_partition_delay_ms"] = 40
+    for k, v in props.items():
+        fleet.session.properties[k] = v
+    inj = fault.FaultInjector(seed=seed, max_attempts=fleet.max_attempts)
+    arm(inj)
+    fault.activate(inj)
+    try:
+        return fleet.execute(sql)
+    finally:
+        fault.deactivate()
+
+
+def _assert_modes_agree(chaos_workers, spool_root, sql, seed, arm, **props):
+    """Same seed, both admission modes: byte-identical rows, and both
+    oracle-exact."""
+    from trino_tpu.testing.golden import assert_rows_match
+
+    barrier = _chaos_run(
+        chaos_workers, spool_root, sql, "BARRIER", seed, arm, **props
+    )
+    pipelined = _chaos_run(
+        chaos_workers, spool_root, sql, "PIPELINED", seed, arm, **props
+    )
+    assert pipelined.rows == barrier.rows, (
+        "pipelined admission changed result bytes under chaos"
+    )
+    assert_rows_match(
+        pipelined.rows, _oracle_rows(sql), ordered=pipelined.ordered,
+        abs_tol=1e-6,
+    )
+    return barrier, pipelined
+
+
+def test_chaos_pipelined_producer_retry_mid_stream(
+    chaos_workers, spool_root
+):
+    """Every producer's attempt 0 dies AFTER its partition markers
+    land but BEFORE the attempt manifest (the spool-write site sits in
+    that window): pipelined consumers admitted against those orphaned
+    attempt-0 markers keep reading them — durable, CRC-valid, and
+    byte-identical to the retry's recommit — while the producers retry
+    to full commit."""
+    _, pipelined = _assert_modes_agree(
+        chaos_workers, spool_root, chaos._AGG_SQL, 11,
+        lambda inj: inj.arm("spool-write", times=1),
+    )
+    assert pipelined.tasks_retried >= 1
+
+
+@pytest.mark.slow
+def test_chaos_pipelined_spool_read_fault_on_admitted_edge(
+    chaos_workers, spool_root
+):
+    """A consumer admitted mid-stream fails its attempt-0 pinned
+    source read (spool-read site): the task tier retries it, the
+    re-post re-pins from current commit state, rows stay identical."""
+    _, pipelined = _assert_modes_agree(
+        chaos_workers, spool_root, chaos._JOIN_SQL, 23,
+        lambda inj: inj.arm("spool-read", times=1),
+    )
+    assert pipelined.tasks_retried >= 1
+
+
+@pytest.mark.slow
+def test_chaos_pipelined_speculative_producer_loses(
+    chaos_workers, spool_root
+):
+    """First-commit-wins composition: SIGSTOP a producer mid-stream
+    (after its early partition markers land) so consumers are admitted
+    pinned to its attempt 0, then let the speculative hedge's attempt
+    win the full commit. The loser's durable markers stay readable —
+    the pinned consumers stand, and the rows match a clean BARRIER
+    run byte for byte."""
+    import os
+    import signal
+    import threading
+
+    from trino_tpu.testing.golden import assert_rows_match
+
+    sql = chaos._JOIN_SQL
+    barrier = _chaos_run(
+        chaos_workers, spool_root, sql, "BARRIER", 31, lambda inj: None
+    )
+
+    procs, uris = chaos.spawn_workers(
+        1, base_port=chaos.CHAOS_BASE_PORT + 10
+    )
+    victim = procs[0]
+    try:
+        fleet = chaos.make_fleet(
+            list(chaos_workers) + uris, spool_root,
+            rpc_timeout_s=2.0, max_poll_fails=15,
+        )
+        fleet.session.properties["stage_admission"] = "PIPELINED"
+        fleet.session.properties["spool_partition_delay_ms"] = 150
+        fleet.session.properties["speculation_multiplier"] = 1.5
+        fleet.session.properties["retry_initial_delay_ms"] = 5
+        fleet.session.properties["retry_max_delay_ms"] = 20
+        state = {"stopped": False}
+
+        def post_hook(stage_id, task_id, w):
+            if state["stopped"] or uris[0] not in w.uri:
+                return
+            state["stopped"] = True
+            # stall AFTER the first partition markers commit (~150 ms
+            # into the 4-partition write) so a consumer can pin them
+            t = threading.Timer(
+                0.25, os.kill, (victim.pid, signal.SIGSTOP)
+            )
+            t.daemon = True
+            t.start()
+
+        fleet.post_hook = post_hook
+        result = fleet.execute(sql)
+        assert state["stopped"], "victim worker never received a task"
+        assert result.rows == barrier.rows
+        assert_rows_match(
+            result.rows, _oracle_rows(sql), ordered=result.ordered,
+            abs_tol=1e-6,
+        )
+    finally:
+        try:
+            os.kill(victim.pid, signal.SIGCONT)
+        except OSError:
+            pass
+        chaos.stop_workers(procs)
+
+
 # ---- the full soak (slow tier) -------------------------------------
 
 

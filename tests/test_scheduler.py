@@ -1,0 +1,439 @@
+"""Event-driven partition-granular stage scheduler: readiness rules,
+attempt pins, rescind-on-quarantine, wait/overlap accounting, and the
+end-to-end pipelined fleet path.
+
+Unit tier: EventDrivenScheduler driven directly with fake stages and a
+fake clock (no processes), plus the spool's pinned-read / partition-
+marker contract. Fleet tier: a real 2-worker fleet where a hidden
+per-partition commit delay stretches producer tails so PIPELINED
+admission observably overlaps consumer heads with them — and still
+returns byte-identical rows to BARRIER.
+
+Port discipline: this suite owns 19180+ (test_fleet 18940+, chaos
+18960+, telemetry 19000+, mesh 19140+).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu import telemetry
+from trino_tpu.connectors.tpch.connector import TpchConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.exec import spool
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.scheduler import EventDrivenScheduler
+from trino_tpu.server.fleet import FleetRunner
+from trino_tpu.testing.golden import (
+    assert_rows_match,
+    load_tpch_sqlite,
+    to_sqlite,
+)
+
+BASE_PORT = 19180
+
+
+# ---- unit scaffolding ------------------------------------------------
+
+
+class _In:
+    def __init__(self, stage_id, mode="aligned"):
+        self.source_id = f"src-{stage_id}"
+        self.stage_id = stage_id
+        self.mode = mode
+        self.hash_symbols = ()
+
+
+class _Stage:
+    def __init__(self, sid, inputs=()):
+        self.stage_id = sid
+        self.inputs = list(inputs)
+
+
+class _Spec:
+    def __init__(self, tid, partition=None):
+        self.task_id = tid
+        self.partition = partition
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _sched(stages, mode="PIPELINED", clock=None):
+    return EventDrivenScheduler(
+        stages, mode=mode, clock=clock or _Clock()
+    )
+
+
+def _chain():
+    """producer stage "0" (2 tasks) -> aligned consumer stage "1"."""
+    s0 = _Stage("0")
+    s1 = _Stage("1", [_In("0")])
+    return s0, s1
+
+
+# ---- readiness rules -------------------------------------------------
+
+
+def test_barrier_mode_requires_complete_inputs():
+    s0, s1 = _chain()
+    sched = _sched([s0, s1], mode="BARRIER")
+    sched.register_stage(s0, [_Spec("s0p0", 0), _Spec("s0p1", 1)])
+    sched.register_stage(s1, [_Spec("s1p0", 0)])
+    spec = _Spec("s1p0", 0)
+    assert not sched.task_ready(s1, spec)
+    # partition events are not enough in BARRIER mode
+    sched.on_partition_commit("0", "s0p0", 0, 0)
+    sched.on_partition_commit("0", "s0p1", 0, 0)
+    assert not sched.task_ready(s1, spec)
+    sched.on_stage_complete("0")
+    assert sched.task_ready(s1, spec)
+    # and BARRIER ships no pins: the legacy wire format is untouched
+    assert sched.pins_for(s1, spec) is None
+    assert sched.admit(s1, spec) is None
+
+
+def test_pipelined_admits_on_partition_across_all_producers():
+    s0, s1 = _chain()
+    sched = _sched([s0, s1])
+    sched.register_stage(s0, [_Spec("s0p0", 0), _Spec("s0p1", 1)])
+    sched.register_stage(s1, [_Spec("s1p0", 0), _Spec("s1p1", 1)])
+    c0, c1 = _Spec("s1p0", 0), _Spec("s1p1", 1)
+    assert not sched.task_ready(s1, c0)
+    # one producer committed partition 0: the other still owes it
+    sched.on_partition_commit("0", "s0p0", 0, 0)
+    assert not sched.task_ready(s1, c0)
+    sched.on_partition_commit("0", "s0p1", 0, 0)
+    assert sched.task_ready(s1, c0)
+    # consumer for partition 1 is untouched by partition-0 commits
+    assert not sched.task_ready(s1, c1)
+    # a leaf stage has no inputs: always dispatchable (no deadlock)
+    assert sched.task_ready(s0, _Spec("s0p0", 0))
+
+
+def test_pipelined_full_commit_covers_markerless_empty_partition():
+    """An EMPTY partition writes no marker — the producer's full
+    commit is the only signal that makes it observable."""
+    s0, s1 = _chain()
+    sched = _sched([s0, s1])
+    sched.register_stage(s0, [_Spec("s0p0", 0)])
+    sched.register_stage(s1, [_Spec("s1p3", 3)])
+    spec = _Spec("s1p3", 3)
+    assert not sched.task_ready(s1, spec)
+    sched.on_task_commit("0", "s0p0", 0)
+    assert sched.task_ready(s1, spec)
+
+
+def test_pipelined_barrier_edges_for_broadcast_and_gather():
+    s0 = _Stage("0")
+    bcast = _Stage("1", [_In("0", mode="all")])
+    gather = _Stage("2", [_In("0")])
+    sched = _sched([s0, bcast, gather])
+    sched.register_stage(s0, [_Spec("s0p0", 0)])
+    sched.register_stage(bcast, [_Spec("s1p0", 0)])
+    sched.register_stage(gather, [_Spec("s2t0", None)])
+    sched.on_partition_commit("0", "s0p0", 0, 0)
+    sched.on_task_commit("0", "s0p0", 0)
+    # an "all"-mode edge needs every producer partition; a gather task
+    # (partition=None) cannot name one: both wait for the barrier
+    assert not sched.task_ready(bcast, _Spec("s1p0", 0))
+    assert not sched.task_ready(gather, _Spec("s2t0", None))
+    sched.on_stage_complete("0")
+    assert sched.task_ready(bcast, _Spec("s1p0", 0))
+    assert sched.task_ready(gather, _Spec("s2t0", None))
+
+
+# ---- pins ------------------------------------------------------------
+
+
+def test_pins_carry_spec_order_and_committed_attempts():
+    s0, s1 = _chain()
+    sched = _sched([s0, s1])
+    sched.register_stage(s0, [_Spec("s0p0", 0), _Spec("s0p1", 1)])
+    sched.register_stage(s1, [_Spec("s1p0", 0)])
+    spec = _Spec("s1p0", 0)
+    sched.on_partition_commit("0", "s0p0", 1, 0)  # a retry's attempt
+    sched.on_partition_commit("0", "s0p1", 0, 0)
+    pins = sched.admit(s1, spec)
+    # task_ids in registered spec order — the read-order law that
+    # keeps BARRIER and PIPELINED results byte-identical
+    assert pins["0"]["task_ids"] == ["s0p0", "s0p1"]
+    assert pins["0"]["attempts"] == {"s0p0": 1, "s0p1": 0}
+
+
+def test_pins_omit_attempts_until_every_producer_is_pinnable():
+    s0, s1 = _chain()
+    sched = _sched([s0, s1])
+    sched.register_stage(s0, [_Spec("s0p0", 0), _Spec("s0p1", 1)])
+    sched.register_stage(s1, [_Spec("s1p0", 0)])
+    sched.on_partition_commit("0", "s0p0", 0, 0)
+    pins = sched.pins_for(s1, _Spec("s1p0", 0))
+    assert pins["0"]["task_ids"] == ["s0p0", "s0p1"]
+    assert "attempts" not in pins["0"]
+    # a full commit pins smallest-attempt-first, like the spool's
+    # committed_attempt dedup
+    sched.on_task_commit("0", "s0p1", 2)
+    sched.on_task_commit("0", "s0p1", 1)
+    pins = sched.pins_for(s1, _Spec("s1p0", 0))
+    assert pins["0"]["attempts"] == {"s0p0": 0, "s0p1": 1}
+
+
+# ---- retract / rescind -----------------------------------------------
+
+
+def test_retract_names_dependents_and_revokes_readiness():
+    s0, s1 = _chain()
+    sched = _sched([s0, s1])
+    sched.register_stage(s0, [_Spec("s0p0", 0)])
+    sched.register_stage(s1, [_Spec("s1p0", 0)])
+    spec = _Spec("s1p0", 0)
+    sched.on_partition_commit("0", "s0p0", 0, 0)
+    assert sched.task_ready(s1, spec)
+    pins = sched.admit(s1, spec)
+    assert pins["0"]["attempts"] == {"s0p0": 0}
+    # quarantine of attempt 0: the consumer's admission is rescinded
+    assert sched.retract("0", "s0p0", 0) == ["s1p0"]
+    assert not sched.task_ready(s1, spec)
+    # idempotent: the dependents were consumed by the first retract
+    assert sched.retract("0", "s0p0", 0) == []
+    # a clean recommit re-admits, now pinned to the new attempt
+    sched.on_partition_commit("0", "s0p0", 1, 0)
+    assert sched.task_ready(s1, spec)
+    assert sched.pins_for(s1, spec)["0"]["attempts"] == {"s0p0": 1}
+
+
+def test_retract_reopens_a_completed_stage():
+    s0, s1 = _chain()
+    sched = _sched([s0, s1])
+    sched.register_stage(s0, [_Spec("s0p0", 0)])
+    sched.register_stage(s1, [_Spec("s1t0", None)])
+    sched.on_task_commit("0", "s0p0", 0)
+    sched.on_stage_complete("0")
+    assert sched.task_ready(s1, _Spec("s1t0", None))
+    sched.retract("0", "s0p0", 0)
+    assert not sched.task_ready(s1, _Spec("s1t0", None))
+
+
+# ---- wait / overlap accounting ---------------------------------------
+
+
+def test_admission_wait_and_overlap_books():
+    clock = _Clock()
+    s0, s1 = _chain()
+    sched = _sched([s0, s1], clock=clock)
+    sched.register_stage(s0, [_Spec("s0p0", 0)])
+    sched.register_stage(s1, [_Spec("s1p0", 0)])
+    sched.admit(s0, _Spec("s0p0", 0))  # leaf admits instantly
+    assert sched.admission_wait_ms("s0p0") == 0.0
+    clock.t = 2.0
+    sched.on_partition_commit("0", "s0p0", 0, 0)
+    sched.admit(s1, _Spec("s1p0", 0))
+    assert sched.admission_wait_ms("s1p0") == pytest.approx(2000.0)
+    # the consumer ran 3 s against the still-streaming producer
+    assert sched.overlap_seconds() == 0.0
+    clock.t = 5.0
+    sched.on_stage_complete("0")
+    assert sched.overlap_seconds() == pytest.approx(3.0)
+    # re-admission (a retry) must not re-open books
+    clock.t = 9.0
+    sched.admit(s1, _Spec("s1p0", 0))
+    assert sched.admission_wait_ms("s1p0") == pytest.approx(2000.0)
+    assert sched.admissions == 2
+    assert sched.overlap_seconds() == pytest.approx(3.0)
+
+
+# ---- spool: pinned reads over partition markers ----------------------
+
+
+def _page(n=64):
+    import numpy as np
+
+    from trino_tpu import types as T
+
+    return spool.host_to_page({
+        "names": ["k"],
+        "types": [T.BIGINT],
+        "cols": [(np.arange(n, dtype=np.int64), None)],
+    })
+
+
+def test_spool_pinned_read_without_attempt_manifest(tmp_path):
+    """A consumer admitted mid-stream reads an attempt that has NOT
+    fully committed: per-partition markers alone must carry it."""
+    root = str(tmp_path)
+    spool.write_task_output(root, "3", "s3t0", 0, _page(), "hash", ["k"], 4)
+    # withdraw the attempt-level manifest, keep the partition markers:
+    # the shape of an attempt caught mid-stream
+    (done,) = [
+        p for p in glob.glob(str(tmp_path / "stage-3" / "*.done"))
+        if "-p" not in os.path.basename(p)
+    ]
+    os.unlink(done)
+    assert spool.committed_attempt(root, "3", "s3t0") is None
+    parts = spool.committed_partitions(root, "3", "s3t0", 0)
+    assert parts
+    got = spool.read_partition(
+        root, "3", ["s3t0"], parts[0], attempts={"s3t0": 0}
+    )
+    assert len(got["cols"][0][0]) > 0
+    # unpinned readers still refuse: no attempt ever fully committed
+    with pytest.raises(FileNotFoundError):
+        spool.read_partition(root, "3", ["s3t0"], parts[0])
+    # and a pin against a partition that holds no marker refuses too
+    missing = next(p for p in range(4) if p not in parts) if len(
+        parts
+    ) < 4 else None
+    if missing is not None:
+        with pytest.raises(spool.SpoolCorruptionError):
+            spool.read_partition(
+                root, "3", ["s3t0"], missing, attempts={"s3t0": 0}
+            )
+
+
+# ---- fleet: overlap + equivalence ------------------------------------
+
+
+def _spawn_worker(port: int) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "trino_tpu.server.worker",
+            "--port", str(port),
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/info", timeout=1
+            ) as resp:
+                json.loads(resp.read())
+                return proc
+        except Exception:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker died: {proc.stdout.read()[:4000]}"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise TimeoutError("worker did not come up")
+            time.sleep(0.3)
+
+
+@pytest.fixture(scope="module")
+def workers():
+    procs = [_spawn_worker(BASE_PORT + i) for i in range(2)]
+    yield [f"http://127.0.0.1:{BASE_PORT + i}" for i in range(2)]
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+@pytest.fixture(scope="module")
+def spool_root(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("sched-spool"))
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    data = QueryRunner.tpch("tiny").metadata.connector("tpch").data("tiny")
+    return load_tpch_sqlite(data)
+
+
+def _make_fleet(workers, spool_root, mode):
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    fleet = FleetRunner(
+        list(workers), md, Session(catalog="tpch", schema="tiny"),
+        spool_root=spool_root, n_partitions=4,
+    )
+    fleet.session.properties["stage_admission"] = mode
+    if mode == "PIPELINED":
+        # stretch every producer's commit tail so the pipelined
+        # overlap is macroscopic instead of a scheduling-noise
+        # artifact (rows are delay-independent, so the BARRIER
+        # reference run skips it)
+        fleet.session.properties["spool_partition_delay_ms"] = 120
+    fleet.session.properties["join_distribution_type"] = "PARTITIONED"
+    return fleet
+
+
+_JOIN_SQL = (
+    "select c_mktsegment, count(*), sum(o_totalprice) "
+    "from customer, orders where c_custkey = o_custkey "
+    "group by c_mktsegment order by 1"
+)
+
+
+def test_pipelined_fleet_overlaps_and_matches_barrier(
+    workers, spool_root, oracle
+):
+    barrier = _make_fleet(workers, spool_root, "BARRIER").execute(
+        _JOIN_SQL
+    )
+    fleet = _make_fleet(workers, spool_root, "PIPELINED")
+    adm0 = telemetry.SCHED_ADMISSIONS.value(mode="PIPELINED")
+    res = fleet.execute(_JOIN_SQL)
+
+    # byte-identical rows: same producer payloads, read in the same
+    # task order, only admitted earlier
+    assert res.rows == barrier.rows
+    expected = oracle.execute(to_sqlite(_JOIN_SQL)).fetchall()
+    assert_rows_match(
+        res.rows, expected, ordered=res.ordered, abs_tol=1e-6
+    )
+
+    # the overlap gauge saw a real producer-tail/consumer-head overlap
+    assert telemetry.SCHED_OVERLAP.value() > 0.0
+    assert telemetry.SCHED_ADMISSIONS.value(mode="PIPELINED") > adm0
+
+    # some consumer task span STARTED before a topologically earlier
+    # stage span ENDED — pipelining, visible in the stitched trace
+    stage_order = [st["stage_id"] for st in res.stage_stats]
+    spans = {
+        s.name: s for s in res.trace.find(kind="stage")
+    }
+    overlapped = False
+    for k, sid in enumerate(stage_order[1:], start=1):
+        consumer_tasks = [
+            t for t in res.trace.find(kind="task")
+            if t.parent_id == spans[f"stage {sid}"].span_id
+        ]
+        for prev in stage_order[:k]:
+            psp = spans[f"stage {prev}"]
+            p_end = psp.start_ms + psp.duration_ms
+            if any(t.start_ms < p_end for t in consumer_tasks):
+                overlapped = True
+    assert overlapped, "no consumer task span overlapped a producer stage"
+
+    # admission wait surfaces on stage_stats (and through it on
+    # system.runtime.tasks and EXPLAIN ANALYZE)
+    assert all("admission_wait_ms" in st for st in res.stage_stats)
+    assert sum(
+        st["admission_wait_ms"] for st in res.stage_stats
+    ) > 0.0
+
+
+def test_stage_admission_property_is_validated(workers, spool_root):
+    fleet = _make_fleet(workers, spool_root, "EAGERLY")
+    with pytest.raises(Exception, match="stage_admission"):
+        fleet.execute("select count(*) from nation")

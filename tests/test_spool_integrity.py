@@ -118,7 +118,12 @@ def test_spool_detects_missing_partition_file(tmp_path):
 def test_spool_done_marker_carries_manifest(tmp_path):
     root = str(tmp_path)
     _write(root)
-    (marker,) = glob.glob(str(tmp_path / "stage-7" / "*.done"))
+    # the attempt-level manifest marker, not the per-partition
+    # -p{N}.done markers pipelined admission also commits
+    (marker,) = [
+        p for p in glob.glob(str(tmp_path / "stage-7" / "*.done"))
+        if "-p" not in os.path.basename(p)
+    ]
     meta = json.load(open(marker))
     files = {
         os.path.basename(p)
@@ -145,6 +150,27 @@ def test_spool_quarantine_and_next_attempt(tmp_path):
     assert spool.committed_attempt(root, "7", "s7t0") == 1
     got = spool.read_partition(root, "7", ["s7t0"], None)
     assert sorted(got["cols"][0][0].tolist()) == list(range(64))
+
+
+def test_spool_quarantine_retracts_partition_markers(tmp_path):
+    """Regression: quarantining an attempt must withdraw its
+    per-partition ``-p{N}.done`` markers along with the attempt-level
+    manifest marker — a stale partition marker would let pipelined
+    admission re-admit a consumer against the quarantined data."""
+    root = str(tmp_path)
+    _write(root, attempt=0)
+    parts = spool.committed_partitions(root, "7", "s7t0", 0)
+    assert parts, "writer committed no partition markers"
+    assert spool.quarantine_attempt(root, "7", "s7t0", 0) is True
+    assert spool.committed_partitions(root, "7", "s7t0", 0) == []
+    # the evidence trail survives as .done.bad for every marker tier
+    bad = glob.glob(str(tmp_path / "stage-7" / "*.done.bad"))
+    assert len(bad) == 1 + len(parts)
+    # a pinned read against the quarantined attempt now refuses
+    with pytest.raises(spool.SpoolCorruptionError):
+        spool.read_partition(
+            root, "7", ["s7t0"], parts[0], attempts={"s7t0": 0}
+        )
 
 
 def test_corruption_error_is_machine_parseable(tmp_path):
@@ -249,6 +275,11 @@ def test_fleet_reruns_producer_after_spool_corruption(fleet, oracle):
 
     fleet.stage_hook = stage_hook
     fleet.keep_spool = True  # inspect quarantine state after the query
+    # pin the stage barrier: this scenario requires the consumer to
+    # read AFTER the corruption hook fires at stage completion; under
+    # PIPELINED the consumer may legitimately finish its (CRC-valid)
+    # read before the hook ever corrupts the file
+    fleet.session.properties["stage_admission"] = "BARRIER"
     sql = (
         "select o_orderpriority, count(*) from orders "
         "group by o_orderpriority order by 1"

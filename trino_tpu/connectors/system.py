@@ -49,6 +49,7 @@ _TASKS_SCHEMA = TableSchema("tasks", [
     ("bytes_out", T.BIGINT),
     ("elapsed_ms", T.DOUBLE),
     ("peak_memory_bytes", T.BIGINT),
+    ("admission_wait_ms", T.DOUBLE),
 ])
 
 #: live memory-governance state (system.runtime "memory" view — the
@@ -180,6 +181,7 @@ class SystemConnector(Connector):
                     int(t.get("bytes_out", 0)),
                     float(t.get("elapsed_ms", 0.0)),
                     int(t.get("peak_memory_bytes", 0)),
+                    float(t.get("admission_wait_ms", 0.0)),
                 ))
         return out
 

@@ -266,6 +266,7 @@ class QueryRunner:
                             "elapsed_ms": elapsed_ms,
                             "retries": 0,
                             "peak_memory_bytes": qctx.peak_bytes,
+                            "admission_wait_ms": 0.0,
                         }]
                     if not result.task_stats:
                         # mirror the (possibly _explain-provided)
@@ -747,6 +748,7 @@ class QueryRunner:
             "elapsed_ms": total_ms,
             "retries": 0,
             "peak_memory_bytes": peak_bytes,
+            "admission_wait_ms": 0.0,
         }]
         lines = [_stage_stats_line("Query", stage_stats[0])]
         if peak_bytes:
@@ -800,6 +802,8 @@ def _stage_stats_line(label: str, st: dict) -> str:
         line += f", retries: {st['retries']}"
     if st.get("peak_memory_bytes"):
         line += f", peak memory: {_fmt_bytes(st['peak_memory_bytes'])}"
+    if st.get("admission_wait_ms"):
+        line += f", admission wait: {st['admission_wait_ms']:.1f} ms"
     return line
 
 

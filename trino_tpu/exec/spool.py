@@ -14,17 +14,25 @@ Layout (all under one per-query spool root, shared across workers on
 one host; a multi-host deployment mounts shared storage the same way
 the reference points the filesystem exchange at S3/GCS):
 
-    {root}/stage-{sid}/t{task}-a{attempt}-p{part}.npz   partition data
-    {root}/stage-{sid}/t{task}-a{attempt}.done          commit marker
+    {root}/stage-{sid}/t{task}-a{attempt}-p{part}.npz    partition data
+    {root}/stage-{sid}/t{task}-a{attempt}-p{part}.done   partition marker
+    {root}/stage-{sid}/t{task}-a{attempt}.done           commit marker
 
 Commit protocol: partition files are written to ``*.tmp`` and renamed
-(atomic on POSIX), then the ``.done`` marker is written last. Readers
-only consume attempts with a marker; a kill -9 mid-write leaves
-ignorable garbage. Duplicate attempts of a task (speculative or
-post-crash retries) are deduplicated by picking the smallest committed
-attempt — tasks are deterministic, so any committed attempt carries
-identical data (the reference dedupes replayed FTE output the same
-way, MAIN/operator/DeduplicatingDirectExchangeBuffer.java).
+(atomic on POSIX); after each partition file lands, a per-partition
+``-p{part}.done`` marker (file name + whole-file CRC32) is committed
+the same way, and the attempt-level ``.done`` marker is written last.
+Attempt-level readers only consume attempts with the final marker; a
+kill -9 mid-write leaves ignorable garbage. The per-partition markers
+are the incremental-commit feed of the pipelined stage scheduler
+(trino_tpu/scheduler.py): a consumer task pinned to a specific
+attempt may read a partition as soon as its marker exists, before the
+producing task finishes its remaining partitions. Duplicate attempts
+of a task (speculative or post-crash retries) are deduplicated by
+picking the smallest committed attempt — tasks are deterministic, so
+any committed attempt carries identical data (the reference dedupes
+replayed FTE output the same way,
+MAIN/operator/DeduplicatingDirectExchangeBuffer.java).
 
 Partition files are a real columnar page serde: per column a storage-
 form numpy array (ints/doubles/bools/two-limb decimals as-is, VARCHAR
@@ -59,6 +67,7 @@ __all__ = [
     "write_task_output", "read_partition", "partition_ids",
     "page_to_host", "host_to_page", "committed_attempt",
     "SpoolCorruptionError", "quarantine_attempt", "next_attempt",
+    "partition_marker", "committed_partitions",
 ]
 
 
@@ -341,19 +350,66 @@ def _stage_dir(root: str, stage_id: str) -> str:
     return os.path.join(root, f"stage-{stage_id}")
 
 
+def partition_marker(
+    root: str, stage_id: str, task_id: str, attempt: int, part: int
+) -> str:
+    """Path of the per-partition commit marker (exists once that
+    partition's file is durably on disk, before the attempt-level
+    ``.done``)."""
+    return os.path.join(
+        _stage_dir(root, stage_id), f"t{task_id}-a{attempt}-p{part}.done"
+    )
+
+
+def _commit_partition_marker(
+    d: str, task_id: str, attempt: int, part: int, name: str, crc: int
+) -> None:
+    marker = os.path.join(d, f"t{task_id}-a{attempt}-p{part}.done")
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"file": name, "crc": crc}, f)
+    os.replace(tmp, marker)
+
+
+def committed_partitions(
+    root: str, stage_id: str, task_id: str, attempt: int
+) -> list[int]:
+    """Partition ids of ``attempt`` whose per-partition markers are on
+    disk (quarantined markers excluded). Sorted ascending."""
+    d = _stage_dir(root, stage_id)
+    if not os.path.isdir(d):
+        return []
+    prefix = f"t{task_id}-a{attempt}-p"
+    out = []
+    for f in os.listdir(d):
+        if f.startswith(prefix) and f.endswith(".done"):
+            body = f[len(prefix):-len(".done")]
+            if body.isdigit():
+                out.append(int(body))
+    return sorted(out)
+
+
 def write_task_output(
     root: str, stage_id: str, task_id: str, attempt: int, page: Page,
     partitioning: str, key_names: list[str], n_parts: int,
+    partition_delay_ms: float = 0.0, on_partition=None,
 ) -> dict:
     """Partition a task's output page and commit it to the spool.
 
+    Each partition file is followed by its own ``-p{part}.done``
+    marker (name + whole-file CRC32) the moment it lands —
+    ``on_partition(part)`` fires after each such commit so the worker
+    can report incremental progress to the pipelined scheduler.
+    ``partition_delay_ms`` sleeps after each partition commit (test
+    hook: widens the producer write tail so pipelined-admission
+    overlap is observable on tiny data).
+
     Returns ``{"rows": n, "bytes": total_file_bytes}`` for per-task
     output stats."""
+    import time as _time
+
     from trino_tpu import fault
 
-    # chaos seam: a spool-write fault fails the producing task BEFORE
-    # its commit marker lands, so no corrupt attempt becomes readable
-    fault.check("spool-write", tag=f"{stage_id}:{task_id}", attempt=attempt)
     d = _stage_dir(root, stage_id)
     os.makedirs(d, exist_ok=True)
     payload = page_to_host(page)
@@ -370,16 +426,35 @@ def write_task_output(
     for p in np.unique(parts):
         sel = np.nonzero(parts == p)[0]
         name = f"t{task_id}-a{attempt}-p{int(p)}.npz"
-        manifest[name] = _save_npz(os.path.join(d, name), payload, sel)
+        crc = _save_npz(os.path.join(d, name), payload, sel)
+        manifest[name] = crc
+        _commit_partition_marker(d, task_id, attempt, int(p), name, crc)
         written.append(int(p))
+        if on_partition is not None:
+            on_partition(int(p))
+        if partition_delay_ms:
+            _time.sleep(partition_delay_ms / 1e3)
     if not written:
         # empty output still ships its schema (consumers need a typed
         # zero-row page, the empty-serialized-page analog)
         name = f"t{task_id}-a{attempt}-p0.npz"
-        manifest[name] = _save_npz(
+        crc = _save_npz(
             os.path.join(d, name), payload, np.zeros(0, dtype=np.int64)
         )
+        manifest[name] = crc
+        _commit_partition_marker(d, task_id, attempt, 0, name, crc)
         written.append(0)
+        if on_partition is not None:
+            on_partition(0)
+    # chaos seam: a spool-write fault fails the producing task AFTER
+    # its partition files (and their per-partition markers) landed but
+    # BEFORE the attempt-level commit marker — the genuinely dangerous
+    # FTE window: a pipelined consumer may already be admitted on the
+    # orphaned markers while attempt-level dedup never sees this
+    # attempt. The partition files themselves are complete and
+    # CRC-valid, so a consumer pinned to the orphan reads correct
+    # bytes; the retry commits a fresh attempt for everyone else.
+    fault.check("spool-write", tag=f"{stage_id}:{task_id}", attempt=attempt)
     # commit marker last: readers ignore attempts without one. The
     # marker doubles as the attempt's integrity manifest — file list
     # plus whole-file CRC32s — so a reader detects a swapped,
@@ -408,7 +483,10 @@ def committed_attempt(root: str, stage_id: str, task_id: str) -> int | None:
     prefix = f"t{task_id}-a"
     for f in os.listdir(d):
         if f.startswith(prefix) and f.endswith(".done"):
-            a = int(f[len(prefix):-len(".done")])
+            body = f[len(prefix):-len(".done")]
+            if not body.isdigit():
+                continue  # per-partition marker (tN-aA-pP.done)
+            a = int(body)
             best = a if best is None else min(best, a)
     return best
 
@@ -441,26 +519,50 @@ def quarantine_attempt(
     root: str, stage_id: str, task_id: str, attempt: int
 ) -> bool:
     """Withdraw a corrupt attempt from the committed set by renaming
-    its ``.done`` marker to ``.done.bad`` (readers dedupe on ``.done``
-    suffix, so the attempt stops existing for them; the data files
-    stay for forensics). Idempotent: returns False when the marker is
-    already gone."""
+    its ``.done`` marker — AND every per-partition ``-p{part}.done``
+    marker of the same attempt — to ``.done.bad`` (readers dedupe on
+    the ``.done`` suffix, so the attempt stops existing for them; the
+    data files stay for forensics). Retracting the partition markers
+    matters under pipelined admission: a consumer pinned to this
+    attempt must hit a hard SpoolCorruptionError on its next read
+    instead of silently consuming quarantined bytes, and the scheduler
+    rescinds admissions that depended on them. Idempotent: returns
+    False when no marker of the attempt was left to withdraw."""
     d = _stage_dir(root, stage_id)
+    withdrew = False
     marker = os.path.join(d, f"t{task_id}-a{attempt}.done")
     try:
         os.replace(marker, marker + ".bad")
-        return True
+        withdrew = True
     except FileNotFoundError:
-        return False
+        pass
+    for p in committed_partitions(root, stage_id, task_id, attempt):
+        pm = os.path.join(d, f"t{task_id}-a{attempt}-p{p}.done")
+        try:
+            os.replace(pm, pm + ".bad")
+            withdrew = True
+        except FileNotFoundError:
+            pass
+    return withdrew
 
 
 def read_partition(
     root: str, stage_id: str, task_ids: list[str],
-    partition: int | None,
+    partition: int | None, attempts: dict | None = None,
 ) -> dict:
     """Read one partition (or, when ``partition`` is None, everything)
     written by the given tasks, deduplicated to one committed attempt
-    per task. Raises if any task has no committed attempt."""
+    per task. Raises if any task has no committed attempt.
+
+    ``attempts`` (``{task_id: attempt}``) pins specific producer
+    attempts — the pipelined scheduler's admission contract: a
+    consumer admitted on per-partition markers reads exactly the
+    attempt the coordinator observed, never mixing attempts when a
+    speculative or retried producer commits a different attempt later.
+    A pinned attempt without its attempt-level ``.done`` is read
+    through its per-partition markers; a pin whose markers were
+    retracted (quarantine) raises :class:`SpoolCorruptionError` with
+    producer coordinates so the scheduler re-runs the producer."""
     from trino_tpu import fault
 
     d = _stage_dir(root, stage_id)
@@ -474,16 +576,54 @@ def read_partition(
         # the active injector's (the CONSUMER's retry level), so
         # times-schedules let a retried read eventually succeed.
         fault.check("spool-read", tag=f"{stage_id}:{tid}")
-        a = committed_attempt(root, stage_id, tid)
-        if a is None:
-            raise FileNotFoundError(
-                f"stage {stage_id} task {tid}: no committed attempt in spool"
-            )
+        pinned = attempts.get(tid) if attempts else None
+        if pinned is None:
+            a = committed_attempt(root, stage_id, tid)
+            if a is None:
+                raise FileNotFoundError(
+                    f"stage {stage_id} task {tid}: no committed attempt "
+                    "in spool"
+                )
+        else:
+            a = int(pinned)
         marker = os.path.join(d, f"t{tid}-a{a}.done")
-        with open(marker) as f:
-            meta = json.load(f)
-        written = meta["partitions"]
-        crcs = meta.get("files", {})
+        if pinned is not None and not os.path.exists(marker):
+            # pinned attempt not (yet) fully committed: read through
+            # its per-partition markers
+            written = committed_partitions(root, stage_id, tid, a)
+            if not written:
+                raise SpoolCorruptionError(
+                    "pinned attempt has no committed partitions "
+                    "(markers retracted by quarantine?)",
+                    stage_id=stage_id, task_id=tid, attempt=a,
+                    path=marker,
+                )
+            crcs = {}
+            for p in written:
+                pm = os.path.join(d, f"t{tid}-a{a}-p{p}.done")
+                try:
+                    with open(pm) as f:
+                        pmeta = json.load(f)
+                    crcs[pmeta["file"]] = pmeta["crc"]
+                except (OSError, ValueError, KeyError):
+                    raise SpoolCorruptionError(
+                        "unreadable per-partition marker",
+                        stage_id=stage_id, task_id=tid, attempt=a,
+                        path=pm,
+                    ) from None
+            if partition is not None and partition not in written:
+                # the admission basis vanished between admit and read
+                raise SpoolCorruptionError(
+                    f"pinned partition {partition} has no marker "
+                    "(retracted by quarantine?)",
+                    stage_id=stage_id, task_id=tid, attempt=a,
+                    path=marker,
+                )
+        else:
+            with open(marker) as f:
+                meta = json.load(f)
+            written = meta["partitions"]
+            crcs = meta.get("files", {})
         wanted = written if partition is None else (
             [partition] if partition in written else []
         )

@@ -1,0 +1,235 @@
+"""Event-driven partition-granular stage scheduler.
+
+The fleet's barrier scheduler admits a consumer stage only after its
+producer stage has FULLY committed — every consumer head waits for the
+slowest producer tail. This module is the EventDriven-scheduler analog
+(the reference's speculative, partition-granular FTE admission,
+MAIN/execution/scheduler/faulttolerant/EventDrivenFaultTolerantQueryScheduler.java;
+same direction as morsel-driven parallelism: a DAG edge is a
+per-partition data dependency, not a stage-level barrier):
+
+* producers commit per-partition ``-p{part}.done`` markers as each
+  partition file lands (exec/spool.py) and report the committed set on
+  every task-status poll;
+* :class:`EventDrivenScheduler` folds those ``(stage, task, attempt,
+  partition)`` events and admits an aligned consumer task the moment
+  its specific input partition is committed across ALL producer tasks;
+* each admission pins the exact producer attempts the coordinator
+  observed, so a consumer never mixes attempts when a speculative or
+  retried producer commits a different attempt later (any CRC-valid
+  committed attempt of a deterministic task carries identical bytes,
+  so reading a pinned non-winning attempt is still correct);
+* quarantining a producer attempt retracts its partition commits and
+  rescinds the in-flight admissions that depended on them.
+
+Readiness rules (``task_ready``):
+
+* ``BARRIER`` mode — every input stage fully complete (the legacy
+  behavior, preserved as fallback and for A/B benching via the
+  ``stage_admission`` session property);
+* ``PIPELINED`` mode — for each input edge: a fully complete input
+  stage is always satisfied; an ``aligned`` edge into a partitioned
+  consumer task ``p`` is satisfied once every producer task has
+  committed partition ``p`` (or fully committed — the only way an
+  EMPTY partition, which writes no marker, becomes observable); any
+  other edge (``all``-mode / broadcast, or a non-partitioned consumer
+  such as a root gather) degrades to the barrier rule for that edge.
+  Leaf stages have no inputs, so the DAG always has dispatchable work
+  and pipelined admission cannot deadlock: a task is admitted only
+  when every byte it will read is already durable.
+"""
+
+from __future__ import annotations
+
+import time
+
+from trino_tpu import telemetry
+
+__all__ = ["EventDrivenScheduler"]
+
+
+class EventDrivenScheduler:
+    """Partition-granular admission control for one fleet DAG run.
+
+    The FleetRunner RPC loop feeds commit events in (``on_partition_
+    commit`` / ``on_task_commit`` / ``on_stage_complete``) and asks
+    ``task_ready`` before dispatching a queued task; ``admit`` records
+    the admission (wait histogram, overlap windows) and returns the
+    per-input-stage attempt pins to ship on the stage-task request.
+    Single-threaded by construction — it is only touched from the
+    coordinator's ``_run_dag`` loop."""
+
+    def __init__(
+        self, stages, mode: str = "PIPELINED", *, clock=time.monotonic,
+    ):
+        self.mode = str(mode).upper()
+        self._clock = clock
+        self._by_id = {s.stage_id: s for s in stages}
+        #: sid -> tid -> attempt -> committed partition ids
+        self._partitions: dict[str, dict[str, dict[int, set[int]]]] = {}
+        #: sid -> tid -> fully committed attempts
+        self._task_commits: dict[str, dict[str, set[int]]] = {}
+        self._complete: set[str] = set()
+        #: sid -> registered task ids, in spec order (read-order law:
+        #: consumers concatenate producer payloads in this order, so
+        #: BARRIER and PIPELINED return byte-identical results)
+        self._specs: dict[str, list[str]] = {}
+        self._queued_at: dict[str, float] = {}
+        self._admitted_at: dict[str, float] = {}
+        self._admission_wait_ms: dict[str, float] = {}
+        #: (producer sid, tid, attempt) -> consumer tids pinned to it
+        self._dependents: dict[tuple[str, str, int], set[str]] = {}
+        #: open overlap windows: (consumer tid, producer sid, t_admit)
+        self._overlap_open: list[tuple[str, str, float]] = []
+        self._overlap_s = 0.0
+        self.admissions = 0
+        self.rescinds = 0
+
+    # ---- commit-event feed -------------------------------------------------
+
+    def register_stage(self, stage, specs) -> None:
+        """A stage's tasks were constructed and queued; admission-wait
+        clocks start now."""
+        self._specs[stage.stage_id] = [s.task_id for s in specs]
+        now = self._clock()
+        for s in specs:
+            self._queued_at.setdefault(s.task_id, now)
+
+    def on_partition_commit(
+        self, sid: str, tid: str, attempt: int, part: int
+    ) -> None:
+        self._partitions.setdefault(sid, {}).setdefault(
+            tid, {}
+        ).setdefault(int(attempt), set()).add(int(part))
+
+    def on_task_commit(self, sid: str, tid: str, attempt: int) -> None:
+        self._task_commits.setdefault(sid, {}).setdefault(
+            tid, set()
+        ).add(int(attempt))
+
+    def on_stage_complete(self, sid: str) -> None:
+        """Close the overlap windows of consumers admitted while this
+        producer was still streaming: that span IS the pipelining win."""
+        self._complete.add(sid)
+        now = self._clock()
+        still = []
+        for (tid, psid, t0) in self._overlap_open:
+            if psid == sid:
+                self._overlap_s += max(0.0, now - t0)
+            else:
+                still.append((tid, psid, t0))
+        self._overlap_open = still
+
+    def retract(self, sid: str, tid: str, attempt: int) -> list[str]:
+        """A producer attempt was quarantined: drop its commit records
+        and return the consumer tasks whose admission pinned it (the
+        fleet cancels + requeues the non-finished ones; a FINISHED
+        consumer already CRC-verified every byte it read, and the
+        producer is deterministic, so its output stands)."""
+        attempt = int(attempt)
+        self._partitions.get(sid, {}).get(tid, {}).pop(attempt, None)
+        self._task_commits.get(sid, {}).get(tid, set()).discard(attempt)
+        self._complete.discard(sid)
+        return sorted(self._dependents.pop((sid, tid, attempt), ()))
+
+    # ---- readiness + admission --------------------------------------------
+
+    def task_ready(self, stage, spec) -> bool:
+        if self.mode != "PIPELINED":
+            return all(
+                i.stage_id in self._complete for i in stage.inputs
+            )
+        for i in stage.inputs:
+            if i.stage_id in self._complete:
+                continue
+            if i.mode != "aligned" or spec.partition is None:
+                return False  # barrier edge (broadcast / gather)
+            ptids = self._specs.get(i.stage_id)
+            if not ptids:
+                return False
+            for ptid in ptids:
+                if self._pin_attempt(
+                    i.stage_id, ptid, spec.partition
+                ) is None:
+                    return False
+        return True
+
+    def _pin_attempt(
+        self, sid: str, ptid: str, part: int | None
+    ) -> int | None:
+        """Attempt to pin for one producer task: smallest fully
+        committed attempt, else (for a specific partition) the
+        smallest attempt holding that partition's marker."""
+        commits = self._task_commits.get(sid, {}).get(ptid)
+        if commits:
+            return min(commits)
+        if part is None:
+            return None
+        by_attempt = self._partitions.get(sid, {}).get(ptid, {})
+        cands = [a for a, ps in by_attempt.items() if part in ps]
+        return min(cands) if cands else None
+
+    def pins_for(self, stage, spec) -> dict | None:
+        """Per-input-stage source pins for a stage-task request:
+        ``{sid: {"task_ids": [...], "attempts": {tid: attempt}}}``.
+        ``task_ids`` always carries the registered spec order;
+        ``attempts`` is included only when every producer task is
+        pinnable (otherwise the worker falls back to attempt-level
+        dedup, which needs the stage complete). Returns None in
+        BARRIER mode — the legacy wire format stays untouched."""
+        if self.mode != "PIPELINED":
+            return None
+        pins = {}
+        for i in stage.inputs:
+            sid = i.stage_id
+            ptids = self._specs.get(sid)
+            if not ptids:
+                return None  # producer not registered yet; cannot post
+            entry: dict = {"task_ids": list(ptids)}
+            part = spec.partition if i.mode == "aligned" else None
+            attempts = {}
+            for ptid in ptids:
+                a = self._pin_attempt(sid, ptid, part)
+                if a is None:
+                    attempts = None
+                    break
+                attempts[ptid] = a
+            if attempts is not None:
+                entry["attempts"] = attempts
+            pins[sid] = entry
+        return pins
+
+    def admit(self, stage, spec) -> dict | None:
+        """Record a dispatch of ``spec`` (first admission only for the
+        wait/overlap books; re-posts and speculative attempts reuse
+        it) and return the source pins for the request."""
+        tid = spec.task_id
+        now = self._clock()
+        if tid not in self._admitted_at:
+            self._admitted_at[tid] = now
+            wait = max(0.0, now - self._queued_at.get(tid, now))
+            self._admission_wait_ms[tid] = wait * 1e3
+            self.admissions += 1
+            telemetry.SCHED_ADMISSIONS.inc(mode=self.mode)
+            telemetry.SCHED_ADMISSION_WAIT.observe(wait, mode=self.mode)
+            for i in stage.inputs:
+                if i.stage_id not in self._complete:
+                    self._overlap_open.append((tid, i.stage_id, now))
+        pins = self.pins_for(stage, spec)
+        if pins:
+            for psid, entry in pins.items():
+                for ptid, a in (entry.get("attempts") or {}).items():
+                    self._dependents.setdefault(
+                        (psid, ptid, int(a)), set()
+                    ).add(tid)
+        return pins
+
+    # ---- read-side surfaces ------------------------------------------------
+
+    def admission_wait_ms(self, tid: str) -> float:
+        return float(self._admission_wait_ms.get(tid, 0.0))
+
+    def overlap_seconds(self) -> float:
+        """Total producer/consumer overlap won so far (closed windows
+        only; all windows close once every stage completes)."""
+        return float(self._overlap_s)

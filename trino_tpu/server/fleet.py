@@ -4,9 +4,15 @@ with durable spooled stage outputs.
 The analog of the reference's fault-tolerant query scheduler
 (MAIN/execution/scheduler/faulttolerant/EventDrivenFaultTolerantQueryScheduler.java:200):
 the coordinator plans SQL locally, cuts the plan into stages
-(plan.fragment), and runs the stages as batch-synchronous waves.
-Every task's output is committed to the spooled exchange (exec.spool)
-before the next stage starts, so:
+(plan.fragment), and schedules them through one event loop. Stage
+admission granularity is the ``stage_admission`` session property:
+``PIPELINED`` (default) delegates per-task readiness to the
+partition-granular EventDrivenScheduler (trino_tpu/scheduler.py) —
+a consumer task starts the moment its input partition is committed
+across all producer tasks, pinned to the observed attempts;
+``BARRIER`` preserves the legacy batch-synchronous waves. Either way
+every task's output is committed to the spooled exchange (exec.spool)
+before anything reads it, so:
 
 - inter-stage data crosses worker processes through durable
   hash-partitioned files (the DCN/FTE exchange tier, SURVEY.md §5.8) —
@@ -53,6 +59,7 @@ from trino_tpu.metadata import Metadata, Session
 from trino_tpu.plan import nodes as P
 from trino_tpu.plan.fragment import Stage, fragment_plan
 from trino_tpu.plan.serde import plan_to_json
+from trino_tpu.scheduler import EventDrivenScheduler
 from trino_tpu.sql import ast
 from trino_tpu.sql.parser import parse_statement
 from trino_tpu.tracker import (
@@ -238,6 +245,9 @@ class FleetRunner:
         #: task_id -> (Stage, _TaskSpec) from the last _run_dag, kept
         #: for coordinator-side corruption recovery on the root read
         self._last_specs: dict[str, tuple[Stage, _TaskSpec]] = {}
+        #: the admission scheduler of the current/last _run_dag
+        #: (exposed for tests/bench: admission waits, overlap seconds)
+        self._scheduler: EventDrivenScheduler | None = None
         #: coordinator-side memory governor: aggregates the per-worker
         #: pool snapshots shipped on task-status responses, enforces
         #: query_max_memory, and kills the largest query on breach
@@ -366,6 +376,9 @@ class FleetRunner:
             "elapsed_ms": res.execution_ms,
             "retries": sum(st.get("retries", 0) for st in stats),
             "peak_memory_bytes": res.peak_memory_bytes,
+            "admission_wait_ms": sum(
+                st.get("admission_wait_ms", 0.0) for st in stats
+            ),
         }
         lines = [_stage_stats_line("Query", total)]
         if res.peak_memory_per_node:
@@ -562,6 +575,7 @@ class FleetRunner:
                 "stage_id": sid, "tasks": 0, "rows_in": 0,
                 "rows_out": 0, "bytes_out": 0, "elapsed_ms": 0.0,
                 "retries": 0, "peak_memory_bytes": 0,
+                "admission_wait_ms": 0.0,
             })
 
         for ts in self._task_stats:
@@ -578,6 +592,9 @@ class FleetRunner:
             st["peak_memory_bytes"] = max(
                 st["peak_memory_bytes"],
                 int(ts.get("peak_memory_bytes", 0) or 0),
+            )
+            st["admission_wait_ms"] += float(
+                ts.get("admission_wait_ms", 0.0) or 0
             )
         for sid, n in self._retries_by_stage.items():
             entry(sid)["retries"] = n
@@ -614,6 +631,12 @@ class FleetRunner:
                     spool.quarantine_attempt(
                         qroot, e.stage_id, e.task_id, e.attempt
                     )
+                    # keep the scheduler's commit books consistent with
+                    # the spool (quarantine retracted the markers too)
+                    if self._scheduler is not None:
+                        self._scheduler.retract(
+                            e.stage_id, e.task_id, e.attempt
+                        )
                     self._rerun_task(
                         qroot, tasks_by_stage, e.stage_id, e.task_id
                     )
@@ -640,7 +663,11 @@ class FleetRunner:
                 continue
             try:
                 self._post_task(
-                    w, stage, spec, attempt, qroot, tasks_by_stage
+                    w, stage, spec, attempt, qroot, tasks_by_stage,
+                    pins=(
+                        self._scheduler.pins_for(stage, spec)
+                        if self._scheduler is not None else None
+                    ),
                 )
             except Exception:
                 continue
@@ -706,15 +733,21 @@ class FleetRunner:
         self, stages: list[Stage], qroot: str,
         tasks_by_stage: dict[str, list[str]],
     ) -> None:
-        """Schedule ALL stages through one event loop, subtree-
-        interleaved: a stage is admitted the moment EVERY input stage
-        has fully committed (spool commits are per-task and atomic),
-        so independent subtrees — the two scan stages under a
-        partitioned join, the branches of a UNION — run tasks across
-        the pool concurrently. This is coarser than true pipelining:
-        a consumer never starts while a producer stage is partially
-        committed (partition-level admission is a ROADMAP open item);
-        what overlaps is sibling subtrees, not producer/consumer pairs.
+        """Schedule ALL stages through one event loop. Readiness is
+        the EventDrivenScheduler's call, per the ``stage_admission``
+        session property:
+
+        - ``BARRIER``: a stage's tasks queue only once EVERY input
+          stage has fully committed — independent subtrees (the two
+          scan stages under a partitioned join, UNION branches) still
+          interleave across the pool, but a consumer never starts
+          while a producer stage is partially committed;
+        - ``PIPELINED`` (default): every stage registers up front and
+          each TASK dispatches the moment its specific input
+          partitions are committed across all producer tasks (fed by
+          the committed-partition sets workers report on status
+          polls), with the observed producer attempts pinned on the
+          stage-task request — producer tails overlap consumer heads.
 
         The loop also owns the fault-tolerance machinery:
         - retry with exponential backoff + full jitter
@@ -763,6 +796,11 @@ class FleetRunner:
         quarantined: set[tuple[str, str, int]] = set()
         deadline = time.monotonic() + self.timeout_s
 
+        mode = str(sp.get(self.session, "stage_admission")).upper()
+        pipelined = mode == "PIPELINED"
+        sched = EventDrivenScheduler(stages, mode=mode)
+        self._scheduler = sched
+
         retry_init_ms = float(sp.get(self.session, "retry_initial_delay_ms"))
         retry_max_ms = float(sp.get(self.session, "retry_max_delay_ms"))
         spec_enabled = (
@@ -790,23 +828,38 @@ class FleetRunner:
         def ready(stage: Stage) -> bool:
             return all(i.stage_id in complete for i in stage.inputs)
 
+        def stage_startable(stage: Stage) -> bool:
+            # BARRIER constructs a stage's tasks only once its inputs
+            # completed (task construction sees post-barrier worker
+            # liveness); PIPELINED registers every stage up front —
+            # children-first fragment order means producers register
+            # before their consumers, and per-TASK readiness is the
+            # scheduler's call at dispatch time
+            return pipelined or ready(stage)
+
         def take_next(now: float):
             """Next dispatchable (stage, spec) round-robin across
             non-empty queues, skipping tasks still in retry backoff
-            and stages whose inputs regressed (corruption recovery
-            de-completes a producer stage — its consumers hold)."""
+            and tasks the scheduler does not admit yet (inputs not
+            committed at the required granularity, or regressed —
+            corruption recovery de-completes a producer stage, so its
+            consumers hold)."""
             for _ in range(len(rr)):
                 sid = rr[0]
                 rr.rotate(-1)
                 q = queues.get(sid)
-                if not q or not ready(by_id[sid]):
+                if not q:
                     continue
+                stage = by_id[sid]
                 for _ in range(len(q)):
                     spec = q.popleft()
-                    if now < eligible_at.get(spec.task_id, 0.0):
+                    if (
+                        now < eligible_at.get(spec.task_id, 0.0)
+                        or not sched.task_ready(stage, spec)
+                    ):
                         q.append(spec)
                         continue
-                    return by_id[sid], spec
+                    return stage, spec
             return None
 
         def mark_dead(w: FleetWorker) -> None:
@@ -865,6 +918,32 @@ class FleetRunner:
                 return
             quarantined.add((psid, ptid, pa))
             spool.quarantine_attempt(qroot, psid, ptid, pa)
+            # rescind pipelined admissions pinned to the quarantined
+            # attempt: cancel the in-flight consumer attempts and
+            # requeue them (no failure counted — the consumer did
+            # nothing wrong). A FINISHED consumer stands: it CRC-
+            # verified every byte it read, and producer determinism
+            # makes any verified attempt's bytes correct.
+            for vtid in sched.retract(psid, ptid, pa):
+                ventry = spec_by_tid.get(vtid)
+                if ventry is None:
+                    continue
+                vstage, vspec = ventry
+                if vtid in done_of[vstage.stage_id]:
+                    continue
+                vkeys = [k for k in inflight if k[0] == vtid]
+                if not vkeys:
+                    continue  # still queued: re-pins at next dispatch
+                for k2 in vkeys:
+                    (w2, _, _, _) = inflight.pop(k2)
+                    cancel_attempt(w2, vtid, k2[1])
+                sched.rescinds += 1
+                telemetry.SCHED_RESCINDS.inc()
+                self.failure_log.append(
+                    f"{vtid}: admission rescinded (producer "
+                    f"{ptid} attempt {pa} quarantined)"
+                )
+                push(vstage, vspec)
             if psid not in by_id or ptid not in spec_by_tid:
                 return
             if ptid not in done_of[psid]:
@@ -951,13 +1030,15 @@ class FleetRunner:
                 self._probe_at.pop(w.uri, None)
                 self.stats["workers_readmitted"] += 1
                 telemetry.WORKERS_READMITTED.inc()
-            # admit newly-ready stages (task construction sees current
-            # worker liveness, so it happens at admission, not upfront)
+            # admit newly-startable stages (under BARRIER, task
+            # construction sees current worker liveness, so it happens
+            # at admission, not upfront)
             for stage in stages:
-                if stage.stage_id in started or not ready(stage):
+                if stage.stage_id in started or not stage_startable(stage):
                     continue
                 specs = self._make_tasks(stage)
                 specs_of[stage.stage_id] = specs
+                sched.register_stage(stage, specs)
                 if (
                     self._tracer is not None
                     and stage.stage_id not in self._stage_spans
@@ -1004,7 +1085,10 @@ class FleetRunner:
                     break
                 a = next_attempt_no[spec.task_id]
                 try:
-                    self._post_task(w, stage, spec, a, qroot, tasks_by_stage)
+                    self._post_task(
+                        w, stage, spec, a, qroot, tasks_by_stage,
+                        pins=sched.admit(stage, spec),
+                    )
                     next_attempt_no[spec.task_id] = a + 1
                     inflight[(spec.task_id, a)] = (
                         w, stage, spec, time.monotonic()
@@ -1073,11 +1157,16 @@ class FleetRunner:
                         record_failure(st2, sp2, "worker died")
                     continue
                 sid = stage.stage_id
+                # committed-partition sets ride on every status
+                # response: the event feed of pipelined admission
+                for p in state.get("partitions") or ():
+                    sched.on_partition_commit(sid, tid, a, int(p))
                 if state["state"] == "FINISHED":
                     del inflight[key]
                     if tid in done_of[sid]:
                         continue  # duplicate commit of a raced attempt
                     done_of[sid].add(tid)
+                    sched.on_task_commit(sid, tid, a)
                     # per-task stats + worker-side span subtree ride on
                     # the FINISHED status response
                     tstats = state.get("stats") or {}
@@ -1091,6 +1180,9 @@ class FleetRunner:
                         "elapsed_ms": tstats.get("elapsed_ms", 0.0),
                         "peak_memory_bytes": tstats.get(
                             "peak_memory_bytes", 0
+                        ),
+                        "admission_wait_ms": sched.admission_wait_ms(
+                            tid
                         ),
                     })
                     if self._tracer is not None and state.get("spans"):
@@ -1110,6 +1202,7 @@ class FleetRunner:
                             s.task_id for s in specs_of[sid]
                         ]
                         complete.add(sid)
+                        sched.on_stage_complete(sid)
                         ssp = self._stage_spans.get(sid)
                         if ssp is not None:
                             ssp.finish()
@@ -1124,6 +1217,9 @@ class FleetRunner:
                         "state": "FAILED", "worker": w.uri,
                         "rows_in": 0, "rows_out": 0, "bytes_out": 0,
                         "elapsed_ms": 0.0, "peak_memory_bytes": 0,
+                        "admission_wait_ms": sched.admission_wait_ms(
+                            tid
+                        ),
                     })
                     handle_corruption(error)
                     if tid in done_of[sid]:
@@ -1167,8 +1263,11 @@ class FleetRunner:
                         continue
                     a2 = next_attempt_no[tid]
                     try:
+                        # the hedge re-pins from current commit state;
+                        # either attempt's pins read identical bytes
                         self._post_task(
-                            x, stage, spec, a2, qroot, tasks_by_stage
+                            x, stage, spec, a2, qroot, tasks_by_stage,
+                            pins=sched.admit(stage, spec),
                         )
                     except urllib.error.HTTPError as e:
                         if e.code == 409:
@@ -1193,6 +1292,9 @@ class FleetRunner:
             if inflight or not n_pending():
                 time.sleep(self.poll_s)
         self._last_specs = dict(spec_by_tid)
+        # the pipelining win, as one number: seconds of consumer
+        # runtime that overlapped a still-streaming producer stage
+        telemetry.SCHED_OVERLAP.set(sched.overlap_seconds())
         assert set(tasks_by_stage) == set(by_id)
 
     # ---- worker RPC ------------------------------------------------------
@@ -1200,6 +1302,7 @@ class FleetRunner:
     def _post_task(
         self, w: FleetWorker, stage: Stage, spec: _TaskSpec, attempt: int,
         qroot: str, tasks_by_stage: dict[str, list[str]],
+        pins: dict | None = None,
     ) -> None:
         # chaos seam: an injected rpc fault on the POST looks like a
         # dead worker to the dispatch loop (evict -> re-admission
@@ -1219,13 +1322,28 @@ class FleetRunner:
             ),
             "plan": spec.plan_json,
             "partition": spec.partition,
+            # pipelined admission ships pins per input stage: the
+            # producer task list in registered spec order (the stage
+            # may not be complete, so tasks_by_stage has no entry yet)
+            # and, when available, the exact attempt to read per
+            # producer task so a consumer never mixes attempts
             "sources": [
                 {
                     "source_id": i.source_id,
                     "stage_id": i.stage_id,
                     "mode": i.mode,
                     "hash_symbols": list(i.hash_symbols),
-                    "task_ids": tasks_by_stage[i.stage_id],
+                    "task_ids": (
+                        pins[i.stage_id]["task_ids"]
+                        if pins and i.stage_id in pins
+                        else tasks_by_stage[i.stage_id]
+                    ),
+                    **(
+                        {"attempts": pins[i.stage_id]["attempts"]}
+                        if pins and i.stage_id in pins
+                        and "attempts" in pins[i.stage_id]
+                        else {}
+                    ),
                 }
                 for i in stage.inputs
             ],

@@ -42,6 +42,11 @@ class _Task:
         #: stitches the spans into the query trace
         self.stats: dict | None = None
         self.spans: dict | None = None
+        #: partition ids this stage task has durably committed so far
+        #: (per-partition spool markers) — reported on every status
+        #: poll so the coordinator's pipelined scheduler can admit
+        #: consumers before the task finishes
+        self.partitions: list[int] = []
 
 
 class InjectedTaskFailure(fault.InjectedFault):
@@ -127,6 +132,11 @@ class WorkerServer:
                         payload["stats"] = t.stats
                     if t.spans is not None:
                         payload["spans"] = t.spans
+                # committed-partition set on every status response:
+                # the event feed of the pipelined stage scheduler
+                # (list append/copy are atomic under the GIL, so no
+                # lock against the run thread is needed)
+                payload["partitions"] = list(t.partitions)
                 # pool snapshot on every status response: the
                 # coordinator's ClusterMemoryManager aggregates these
                 # (the heartbeat memory surface of the reference's
@@ -457,7 +467,7 @@ class WorkerServer:
                             )
                             payload = spool.read_partition(
                                 root, src["stage_id"], src["task_ids"],
-                                part,
+                                part, attempts=src.get("attempts"),
                             )
                             if payload.get("cols"):
                                 rows_in += len(payload["cols"][0][0])
@@ -517,6 +527,12 @@ class WorkerServer:
                                     out["partitioning"],
                                     out["hash_symbols"],
                                     int(out["n_partitions"]),
+                                    partition_delay_ms=float(
+                                        (req.get("session") or {}).get(
+                                            "spool_partition_delay_ms", 0
+                                        ) or 0
+                                    ),
+                                    on_partition=task.partitions.append,
                                 ) or out_stats
                                 write_sp.finish()
                                 write_sp.attrs.update(out_stats)

@@ -316,6 +316,18 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "tasks from hedging on scheduling noise)",
             "bigint", 500, _non_negative("speculation_min_task_age_ms"),
         ),
+        _P(
+            "stage_admission",
+            "Fleet stage-admission granularity: BARRIER admits a "
+            "consumer stage only after every producer stage fully "
+            "commits; PIPELINED (EventDrivenScheduler) admits each "
+            "consumer task the moment its input partition is "
+            "committed across all producer tasks, overlapping "
+            "producer tails with consumer heads "
+            "(EventDrivenFaultTolerantQueryScheduler analog)",
+            "varchar", "PIPELINED",
+            _one_of("stage_admission", {"BARRIER", "PIPELINED"}),
+        ),
         # ---- test/failure injection (hidden) --------------------------
         _P(
             "task_delay_ms",
@@ -348,6 +360,14 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "Test hook: delay inside statement planning (exercises "
             "query_max_planning_time enforcement)",
             "double", 0.0, _non_negative("planning_delay_ms"),
+            hidden=True,
+        ),
+        _P(
+            "spool_partition_delay_ms",
+            "Test hook: sleep after each committed spool partition "
+            "write (widens producer write tails so pipelined-"
+            "admission overlap is observable on tiny data)",
+            "double", 0.0, _non_negative("spool_partition_delay_ms"),
             hidden=True,
         ),
     ]

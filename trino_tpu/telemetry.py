@@ -495,6 +495,14 @@ WORKER_TASKS = REGISTRY.counter(
     "trino_worker_tasks_total", "Stage tasks executed by this worker, by state")
 CHAINS_BUILT = REGISTRY.counter(
     "trino_chains_built_total", "Fused operator chains built for jit compilation")
+SCHED_ADMISSIONS = REGISTRY.counter(
+    "trino_sched_admissions_total", "Fleet stage tasks admitted, by stage_admission mode")
+SCHED_ADMISSION_WAIT = REGISTRY.histogram(
+    "trino_sched_admission_wait_seconds", "Queue-to-first-dispatch wait per fleet task, by mode")
+SCHED_OVERLAP = REGISTRY.gauge(
+    "trino_sched_overlap_seconds", "Producer/consumer overlap won by pipelined admission, last fleet query")
+SCHED_RESCINDS = REGISTRY.counter(
+    "trino_sched_rescinds_total", "Pipelined admissions rescinded after a producer-attempt quarantine")
 
 
 # ---------------------------------------------------------------------------
