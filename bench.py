@@ -39,6 +39,22 @@ scheduling A/B: TPC-H q3/q5/q9 on a live 2-worker fleet under BARRIER
 vs PIPELINED admission, recording per-query wall-clock, total
 admission-wait, and the producer/consumer overlap seconds pipelined
 admission won.
+
+Time budget: BENCH_BUDGET_S (default 840) bounds the whole run.
+Optional sections declare a cost estimate up front and SKIP (recorded
+in detail.skipped_sections) when the remaining budget cannot cover
+them, so the harness timeout is never hit; the JSON line always prints
+— even when a section raises, the partial detail plus the error lands
+on stdout rather than a bare traceback.
+
+Compile-tax split: each core query reports its cold (first-run)
+compile count/seconds AND a same-process warm pass (expected: zero
+compiles, all jit-cache hits). A fresh-process probe
+(tools/warm_probe.py) then replays the same queries against the
+persistent XLA cache — detail.warmproc_* shows what a worker restart
+actually pays (target: <= 1 compile per query). ``--prewarm`` (or
+BENCH_PREWARM=1) runs exec.shapes.prewarm() first and records its
+summary.
 """
 
 import argparse
@@ -81,12 +97,18 @@ def _section_enabled(env_name: str, full: bool) -> bool:
     return full
 
 
-def main(argv=None) -> None:
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
         "--full", action="store_true",
         help="also run the long sections: TPC-DS SF1 and the "
         "bigger-than-HBM SF10 streamed tier (hundreds of seconds)",
+    )
+    ap.add_argument(
+        "--prewarm", action="store_true",
+        help="trace-compile the canonical shape-bucket kernel set "
+        "(exec.shapes.prewarm) before the core section and record its "
+        "summary",
     )
     ap.add_argument(
         "--chaos", action="store_true",
@@ -113,8 +135,60 @@ def main(argv=None) -> None:
     reps = int(os.environ.get("BENCH_REPS", "5"))
     schema = f"sf{sf:g}" if sf != 0.01 else "tiny"
 
+    # ---- time budget: the harness kills us at its timeout; we skip
+    # sections instead of dying mid-run with no JSON on stdout
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "840"))
+    t_start = time.perf_counter()
+    skipped = []
+
+    def remaining() -> float:
+        return budget_s - (time.perf_counter() - t_start)
+
+    def fits(name: str, est_s: float) -> bool:
+        """Admit an optional section only when its cost estimate fits
+        the remaining budget; a skip is reported, never silent."""
+        if remaining() >= est_s:
+            return True
+        skipped.append({
+            "section": name, "est_s": est_s,
+            "left_s": round(remaining(), 1),
+        })
+        return False
+
+    detail = {}
+    out = {
+        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
+        "value": 0.0,
+        "unit": "rows/s",
+        "vs_baseline": 0.0,
+        "detail": detail,
+    }
+    try:
+        rc = _run_sections(args, sf, reps, schema, detail, out, fits,
+                           remaining)
+    except Exception as e:  # partial runs still emit parseable JSON
+        import traceback
+
+        detail["error"] = f"{type(e).__name__}: {e}"
+        detail["traceback"] = traceback.format_exc()[-2000:]
+        rc = 1
+    finally:
+        if skipped:
+            detail["skipped_sections"] = skipped
+        detail["budget_s"] = budget_s
+        detail["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        print(json.dumps(out))
+    return rc
+
+
+def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
     from trino_tpu.connectors.tpch.queries import QUERIES
     from trino_tpu.engine import QueryRunner
+
+    if args.prewarm or os.environ.get("BENCH_PREWARM", "0") != "0":
+        from trino_tpu.exec import shapes
+
+        detail["prewarm"] = shapes.prewarm()
 
     runner = QueryRunner.tpch(schema)
     conn = runner.metadata.connector("tpch")
@@ -164,6 +238,17 @@ def main(argv=None) -> None:
         # memory governance observability: the warmup run's peak
         # reservation (trino_tpu.memory context tree) is free to record
         peaks[q] = result.peak_memory_bytes
+        # same-process warm pass: with shape bucketing on, the second
+        # run of an operator mix must be all jit-cache hits (the cold/
+        # warm split that makes the compile tax auditable per query)
+        runner.execute(sql)
+        c2 = telemetry.compile_snapshot()
+        compile_stats[q]["warm_compiles"] = int(
+            c2["compiles"] - c1["compiles"]
+        )
+        compile_stats[q]["warm_jit_hits"] = int(
+            c2["cache_hits"] - c1["cache_hits"]
+        )
         ours[q], lo, hi = timed_runs(lambda: runner.execute(sql), reps)
         spread[q] = (lo, hi)
     assert rowcounts["q01"] == 4, f"Q1 must yield 4 groups, got {rowcounts['q01']}"
@@ -201,7 +286,7 @@ def main(argv=None) -> None:
         math.prod(speedups.values()) ** (1 / len(speedups))
         if speedups else 0.0
     )
-    detail = {f"{q}_ms": round(ours[q] * 1e3, 1) for q in QUERY_IDS}
+    detail.update({f"{q}_ms": round(ours[q] * 1e3, 1) for q in QUERY_IDS})
     detail.update({
         f"{q}_ms_spread": [round(s * 1e3, 1) for s in spread[q]]
         for q in QUERY_IDS
@@ -229,10 +314,44 @@ def main(argv=None) -> None:
         detail[f"{q}_warmup_compiles"] = compile_stats[q]["compiles"]
         detail[f"{q}_warmup_compile_s"] = compile_stats[q]["compile_s"]
         detail[f"{q}_jit_cache_hits"] = compile_stats[q]["cache_hits"]
+        detail[f"{q}_warm_compiles"] = compile_stats[q]["warm_compiles"]
+        detail[f"{q}_warm_jit_hits"] = compile_stats[q]["warm_jit_hits"]
         if q in top_spans:
             detail[f"{q}_top_spans"] = top_spans[q]
 
-    if _section_enabled("BENCH_MEMORY", args.full):
+    # headline lands as soon as the core section is done: every later
+    # section only ever ADDS detail, so a budget skip or section error
+    # cannot cost the metric
+    out["value"] = round(n_rows / ours["q01"], 1)
+    out["vs_baseline"] = round(vs, 3)
+
+    if fits("warm_process_probe", 120.0):
+        # cross-process warmth: replay the core queries in a FRESH
+        # process against the persistent XLA cache this run just
+        # populated — the restart cost a real worker pays (target:
+        # <= 1 compile per query; the deltas land in warmproc_*)
+        import subprocess
+        import sys
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            probe = subprocess.run(
+                [sys.executable,
+                 os.path.join(here, "tools", "warm_probe.py"),
+                 *QUERY_IDS],
+                capture_output=True, text=True, cwd=here,
+                timeout=max(min(remaining() - 30, 240), 60),
+            )
+            report = json.loads(probe.stdout.strip().splitlines()[-1])
+            for q, st in report.items():
+                for k, v in st.items():
+                    detail[f"warmproc_{q}_{k}"] = v
+        except Exception as e:
+            detail["warmproc_error"] = f"{type(e).__name__}: {e}"
+
+    if _section_enabled("BENCH_MEMORY", args.full) and fits(
+        "memory_budgeted", 120.0
+    ):
         # memory section (long variant): the same queries re-run under
         # a 256 MiB hbm budget so the streamed/grace tier's peak
         # reservations sit next to the resident peaks above — the
@@ -247,7 +366,10 @@ def main(argv=None) -> None:
             )
         detail["memory_budget_bytes"] = 256 << 20
 
-    if _section_enabled("BENCH_TPCDS", args.full) and sf == 1:
+    if (
+        _section_enabled("BENCH_TPCDS", args.full) and sf == 1
+        and fits("tpcds_sf1", 420.0)
+    ):
         # BASELINE config #4: deep join trees (q72) and self-join CTE +
         # IN-subqueries (q95) at TPC-DS SF1. NOTE (VERDICT r4 weak #9):
         # the generator is spec-shaped but not dsdgen-bit-identical, so
@@ -262,7 +384,10 @@ def main(argv=None) -> None:
             med, _, _ = timed_runs(lambda: ds.execute(sql), max(reps - 2, 3))
             detail[f"tpcds_sf1_{q}_ms"] = round(med * 1e3, 1)
 
-    if _section_enabled("BENCH_SF10", args.full) and sf == 1:
+    if (
+        _section_enabled("BENCH_SF10", args.full) and sf == 1
+        and fits("sf10_streamed", 420.0)
+    ):
         # BASELINE config #3 direction: bigger-than-HBM execution. Q1
         # and Q18 at SF10 run the streamed tier (chunked scans, partial
         # aggregation, streamed-probe joins) under a 2 GiB device
@@ -287,9 +412,10 @@ def main(argv=None) -> None:
         detail["sf10_tracked_hwm_bytes"] = int(
             r10.executor.tracked_bytes_hwm
         )
-    if args.stage_admission or _section_enabled(
-        "BENCH_STAGE_ADMISSION", False
-    ):
+    if (
+        args.stage_admission
+        or _section_enabled("BENCH_STAGE_ADMISSION", False)
+    ) and fits("stage_admission", 240.0):
         # scheduling A/B (BENCH_r06): the same multi-stage TPC-H
         # queries on a real 2-process fleet under both admission
         # modes. PIPELINED should trade admission-wait for overlap at
@@ -333,7 +459,9 @@ def main(argv=None) -> None:
         finally:
             chaos_mod.stop_workers(procs)
 
-    if args.chaos or _section_enabled("BENCH_CHAOS", False):
+    if (
+        args.chaos or _section_enabled("BENCH_CHAOS", False)
+    ) and fits("chaos_soak", 300.0):
         # robustness gauge, not a perf number: the full seeded soak
         # (all six fault sites, TASK + QUERY tiers, oracle-checked
         # row-for-row inside run_chaos_soak) against a real 2-process
@@ -373,14 +501,8 @@ def main(argv=None) -> None:
         )
         detail["chaos_wall_s"] = round(chaos_wall, 1)
 
-    print(json.dumps({
-        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
-        "value": round(n_rows / ours["q01"], 1),
-        "unit": "rows/s",
-        "vs_baseline": round(vs, 3),
-        "detail": detail,
-    }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -1,0 +1,163 @@
+"""CompileService: the single compile thread + deserialize watchdog.
+
+The persistent XLA cache wedges when ``deserialize_executable`` runs
+from worker task threads, so workers route compilation through one
+dedicated thread with a deadline (trino_tpu.jit_cache). These tests
+pin the watchdog contract: a wedge (modeled by the
+``compile-deserialize`` fault site) must degrade the process to
+in-memory-only compilation WITHOUT failing the task, and degraded mode
+must be visible in ``/v1/metrics``.
+"""
+
+import os
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from trino_tpu import fault, jit_cache, telemetry
+from trino_tpu.testing import chaos
+
+BASE_PORT = 18910
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    prev_cache = jax.config.jax_compilation_cache_dir
+    yield
+    fault.deactivate()
+    # a degrade flips process-global state; undo it for later modules
+    # (reset_cache clears jax's memoized enablement so the restored
+    # dir actually takes effect on the next compile)
+    jax.config.update("jax_compilation_cache_dir", prev_cache)
+    try:
+        from jax._src import compilation_cache as cc
+
+        cc.reset_cache()
+    except Exception:
+        pass
+    telemetry.PERSISTENT_CACHE_DEGRADED.set(0)
+
+
+# ---------------------------------------------------------------------------
+# CompileService unit
+# ---------------------------------------------------------------------------
+
+
+def test_submit_runs_on_service_thread_and_returns():
+    svc = jit_cache.CompileService(deadline_s=10)
+    assert svc.submit(lambda: 41 + 1) == 42
+    assert not svc.degraded
+
+
+def test_submit_relays_exceptions():
+    svc = jit_cache.CompileService(deadline_s=10)
+    with pytest.raises(ZeroDivisionError):
+        svc.submit(lambda: 1 / 0)
+    # an exception is a normal outcome, not a wedge
+    assert not svc.degraded
+    assert svc.submit(lambda: "still alive") == "still alive"
+
+
+def test_reentrant_submit_runs_inline():
+    # a compile that itself reaches guarded code must not deadlock the
+    # single service thread
+    svc = jit_cache.CompileService(deadline_s=5)
+    assert svc.submit(lambda: svc.submit(lambda: 7)) == 7
+
+
+def test_guarded_is_inline_without_a_service():
+    prev = jit_cache._service
+    jit_cache._service = None
+    try:
+        assert jit_cache.get() is None
+        assert jit_cache.guarded(lambda: "inline") == "inline"
+    finally:
+        jit_cache._service = prev
+
+
+def test_wedged_deserialize_trips_watchdog_and_degrades():
+    inj = fault.FaultInjector()
+    inj.arm("compile-deserialize", times=1)
+    fault.activate(inj)
+    svc = jit_cache.CompileService(deadline_s=0.8)
+    f0 = telemetry.COMPILE_DESERIALIZE_FALLBACKS.total()
+    t0 = time.monotonic()
+    # the service thread blocks forever; the caller waits out the
+    # deadline, degrades, and still gets its result inline
+    assert svc.submit(lambda: "ok", tag="wedge-me") == "ok"
+    assert time.monotonic() - t0 >= 0.8
+    assert svc.degraded
+    assert telemetry.COMPILE_DESERIALIZE_FALLBACKS.total() - f0 == 1
+    assert telemetry.PERSISTENT_CACHE_DEGRADED.value() == 1
+    # degraded means in-memory-only: the persistent cache is off
+    assert not jax.config.jax_compilation_cache_dir
+    # and every later submit short-circuits inline, no deadline wait
+    t1 = time.monotonic()
+    assert svc.submit(lambda: 2) == 2
+    assert time.monotonic() - t1 < 0.5
+
+
+def test_wedged_submit_returns_explicit_fallback():
+    # the deserialize hop cannot fall back to running inline (inline
+    # IS the hazard) — it passes a miss sentinel instead
+    inj = fault.FaultInjector()
+    inj.arm("compile-deserialize", times=1)
+    fault.activate(inj)
+    svc = jit_cache.CompileService(deadline_s=0.5)
+    out = svc.submit(
+        lambda: "deserialized", tag="d", fallback=lambda: (None, None)
+    )
+    assert out == (None, None)
+    assert svc.degraded
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a real worker process survives the wedge
+# ---------------------------------------------------------------------------
+
+
+def _metric_value(text: str, name: str):
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_worker_wedge_degrades_without_failing_the_task(tmp_path_factory):
+    # short watchdog deadline so the trip costs ~2s, not 60
+    os.environ[jit_cache.DEADLINE_ENV] = "2"
+    try:
+        procs, uris = chaos.spawn_workers(1, base_port=BASE_PORT)
+    finally:
+        os.environ.pop(jit_cache.DEADLINE_ENV, None)
+    try:
+        fleet = chaos.make_fleet(
+            uris, str(tmp_path_factory.mktemp("spool"))
+        )
+        inj = fault.FaultInjector()
+        inj.arm("compile-deserialize", times=1)
+        fault.activate(inj)
+        try:
+            # the spec rides the stage-task request into the worker;
+            # its compile service wedges on the first job, the
+            # watchdog degrades it, and the task must still FINISH
+            result = fleet.execute(
+                "select l_returnflag, sum(l_quantity) from lineitem"
+                " group by l_returnflag"
+            )
+        finally:
+            fault.deactivate()
+        assert len(result.rows) == 3  # A/N/R — the query completed
+        with urllib.request.urlopen(
+            f"{uris[0]}/v1/metrics", timeout=5
+        ) as resp:
+            text = resp.read().decode()
+        assert _metric_value(text, "trino_persistent_cache_degraded") == 1.0
+        assert (
+            _metric_value(text, "trino_compile_deserialize_fallbacks_total")
+            >= 1.0
+        )
+    finally:
+        chaos.stop_workers(procs)

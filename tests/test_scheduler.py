@@ -337,8 +337,14 @@ def _spawn_worker(port: int) -> subprocess.Popen:
 
 @pytest.fixture(scope="module")
 def workers():
-    procs = [_spawn_worker(BASE_PORT + i) for i in range(2)]
-    yield [f"http://127.0.0.1:{BASE_PORT + i}" for i in range(2)]
+    # 3 workers for 4 producer tasks: one scan task always straggles
+    # into a second dispatch wave, so a consumer is admitted while its
+    # producer stage is still streaming — the overlap is structural,
+    # not an artifact of compile jitter (the persistent XLA cache
+    # removed that jitter and with 2 symmetric workers both producer
+    # stages could finish in the same poll as the consumer admission)
+    procs = [_spawn_worker(BASE_PORT + i) for i in range(3)]
+    yield [f"http://127.0.0.1:{BASE_PORT + i}" for i in range(3)]
     for p in procs:
         p.terminate()
     for p in procs:

@@ -22,7 +22,7 @@ import numpy as np
 from trino_tpu import memory, telemetry
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
-from trino_tpu.exec import stage
+from trino_tpu.exec import shapes, stage
 from trino_tpu.exec.aggregates import compute_aggregate
 from trino_tpu.expr.compiler import ColumnLayout, compile_expr
 from trino_tpu.expr.ir import AggCall, Call, Cast, InputRef, RowExpression
@@ -530,7 +530,7 @@ class LocalExecutor:
                     if valid is None or valid[i]
                 ]
             out_named[sym] = (nd.outputs[sym], lists, None)
-        cap = pad_capacity(max(len(groups), 1))
+        cap = shapes.bucket(max(len(groups), 1), site="agg-host")
         names, cols = [], []
         for s, (t, vals, valid) in out_named.items():
             names.append(s)
@@ -545,11 +545,33 @@ class LocalExecutor:
             return self._run_chain(chain[agg_i + 1:], out)
         return out
 
+    def _canon_view(self, chain, page: Page):
+        """(chain', page', out_map) with the chain rewritten to
+        nameless normal form and the page pruned/renamed to match —
+        the cache-key normalization that lets distinct queries sharing
+        an operator mix resolve to one compiled program. out_map is
+        None when bucketing is OFF or the chain has a construct the
+        rewriter does not cover (callers then key on original names)."""
+        if not shapes.enabled(self.session):
+            return chain, page, None
+        canon = shapes.canonicalize_chain(chain, list(page.names))
+        if canon is None:
+            return chain, page, None
+        cols = dict(zip(page.names, page.columns))
+        view = Page(
+            list(canon.in_map.values()),
+            [cols[o] for o in canon.in_map],
+            page.mask,
+            known_rows=page.known_rows, packed=page.packed,
+        )
+        return canon.chain, view, canon.out_map
+
     def _dispatch_chain(self, chain, page: Page, caps):
         """Compile (cached) + dispatch one fused chain program without
         waiting for the result — callers sync when they need the flags
         and live count (batched across independent chains where
         possible)."""
+        chain, page, out_map = self._canon_view(chain, page)
         key = (
             "chain",
             tuple(self._node_key(n) for n in chain),
@@ -589,6 +611,11 @@ class LocalExecutor:
             self._jit_cache[key] = hit
         fn, out_layout = hit
         env, mask, flags, n_live_dev = fn(self._env(page), page.mask)
+        if out_map is not None:
+            # the cached program speaks canonical names; translate its
+            # outputs back for this call (the cached out_layout is
+            # shared — never mutate it)
+            out_layout, env = _rename_out(out_layout, env, out_map)
         return env, mask, flags, n_live_dev, out_layout
 
     def _finalize_chain(self, chain, env, mask, n_live: int, out_layout):
@@ -622,12 +649,26 @@ class LocalExecutor:
         partial, final = _split_aggregate(chain[agg_i])
         pre = chain[:agg_i]
         post = chain[agg_i + 1:]
+        uniform = (
+            shapes.enabled(self.session) and page.capacity > chunk_rows
+        )
         partials = []
         for lo in range(0, page.capacity, chunk_rows):
             hi = min(lo + chunk_rows, page.capacity)
-            partials.append(
-                self._run_chain(pre + [partial], _slice_page(page, lo, hi))
-            )
+            if uniform and hi - lo < chunk_rows:
+                # back the final window up to full width so every chunk
+                # shares one shape (and one compiled partial program);
+                # mask off the rows the previous chunk already covered
+                sl = _slice_page(
+                    page, page.capacity - chunk_rows, page.capacity
+                )
+                overlap = chunk_rows - (hi - lo)
+                sl.mask = sl.mask.at[:overlap].set(False)
+                sl.known_rows = None
+                shapes.record_waste("agg-chunk", hi - lo, chunk_rows)
+            else:
+                sl = _slice_page(page, lo, hi)
+            partials.append(self._run_chain(pre + [partial], sl))
         combined = _concat_pages(partials)
         return self._run_chain([final] + post, combined)
 
@@ -721,7 +762,7 @@ class LocalExecutor:
                 n = len(first[0] if isinstance(first, tuple) else first)
             else:
                 n = connector.row_count(node.schema, node.table)
-            cap = pad_capacity(n)
+            cap = shapes.bucket(n, site="scan")
             if "" not in cache:
                 mask = np.zeros(cap, dtype=np.bool_)
                 mask[:n] = True
@@ -757,7 +798,7 @@ class LocalExecutor:
         )
         first = cols[next(iter(node.assignments.values()))]
         n = len(first[0] if isinstance(first, tuple) else first)
-        cap = pad_capacity(n)
+        cap = shapes.bucket(n, site="scan")
         hashed_syms = set(node.hash_varchar or [])
         names, columns = [], []
         for sym, cname in node.assignments.items():
@@ -786,7 +827,7 @@ class LocalExecutor:
             node.schema, node.table, list(node.assignments.values()),
             split=split,
         )
-        cap = pad_capacity(count)
+        cap = shapes.bucket(count, site="scan-split")
         hashed_syms = set(node.hash_varchar or [])
         names, columns = [], []
         for sym, cname in node.assignments.items():
@@ -808,11 +849,10 @@ class LocalExecutor:
         return self.execute(node.source)
 
     def _Values(self, node: P.Values) -> Page:
-        from trino_tpu.exec.stage import pad_capacity
         from trino_tpu.page import StringDictionary
 
         n = len(node.rows)
-        cap = pad_capacity(max(n, 8))
+        cap = shapes.bucket(max(n, 8), site="values")
         mask = np.zeros(cap, dtype=np.bool_)
         mask[:n] = True
         names, cols = [], []
@@ -899,11 +939,23 @@ class LocalExecutor:
         per (layout, capacity) so the device sees a single dispatch.
         Syncs the count only when the producer did not record it."""
         n_live = page.num_rows()
-        cap = pad_capacity(n_live + extra_capacity)
+        cap = shapes.bucket(n_live + extra_capacity, site="compact")
         if page.packed and cap >= page.capacity:
             return page
         limit = cap if cap < page.capacity else page.capacity
-        key = ("compact", self._layout_sig(page), limit)
+        if shapes.enabled(self.session):
+            # positional key: any two pages with the same dtype/lane/
+            # nullability vector share one gather program, whatever
+            # their column names
+            sig = tuple(
+                (str(c.data.dtype), c.data.shape[1:], c.valid is not None)
+                for c in page.columns
+            ) + (page.capacity,)
+            keys = [str(i) for i in range(len(page.columns))]
+        else:
+            sig = self._layout_sig(page)
+            keys = list(page.names)
+        key = ("compact", sig, limit)
         fn = self._jit_cache.get(key)
         if fn is None:
             def compact_fn(env, mask):
@@ -925,10 +977,11 @@ class LocalExecutor:
 
             fn = jax.jit(compact_fn)
             self._jit_cache[key] = fn
-        env2, mask2 = fn(self._env(page), page.mask)
+        env_in = {k: (c.data, c.valid) for k, c in zip(keys, page.columns)}
+        env2, mask2 = fn(env_in, page.mask)
         cols = [
-            Column(c.type, *env2[s], c.dictionary, c.hash_pool, c.array_pool)
-            for s, c in zip(page.names, page.columns)
+            Column(c.type, *env2[k], c.dictionary, c.hash_pool, c.array_pool)
+            for k, c in zip(keys, page.columns)
         ]
         out = Page(list(page.names), cols, mask2)
         out.known_rows = n_live
@@ -1173,7 +1226,7 @@ class LocalExecutor:
             if not runs:
                 runs = [spill._empty_run(node.outputs)]
             return spill.host_concat_to_page(self, runs)
-        cap = pad_capacity(max(n_l * n_r, 1))
+        cap = shapes.bucket(max(n_l * n_r, 1), site="cross-join")
         key = (
             "cross", n_l, n_r,
             self._layout_sig(left), self._layout_sig(right),
@@ -1536,7 +1589,7 @@ class LocalExecutor:
         self._unify_join_dicts(probe, build, node.criteria)
         probe = self._dynamic_filter(node, probe, build)
         order, lo, cnt, total = self._join_count(node.criteria, probe, build)
-        out_cap = pad_capacity(max(total, 1))
+        out_cap = shapes.bucket(max(total, 1), site="join")
         # reserve the join's whole device working set (probe + build +
         # expansion output + index arrays) against the memory pool —
         # the budget tier's tests rely on this being honest, and the
@@ -1720,7 +1773,7 @@ class LocalExecutor:
         src = self.execute(node.source)
         k = len(node.grouping_sets)
         in_cap = src.capacity
-        out_cap = pad_capacity(k * in_cap)
+        out_cap = shapes.bucket(k * in_cap, site="group-id")
         keyed = set(s for st in node.grouping_sets for s in st)
         pad = out_cap - k * in_cap
 
@@ -1960,7 +2013,7 @@ class LocalExecutor:
                 jnp.zeros((cap0,), dtype=jnp.bool_),
                 known_rows=0, packed=True,
             )
-        out_cap = pad_capacity(max(total, 1))
+        out_cap = shapes.bucket(max(total, 1), site="unnest")
         # source-row index per output row + within-array position
         src = np.repeat(sel, row_len)
         starts = np.concatenate([[0], np.cumsum(row_len)[:-1]])
@@ -2145,7 +2198,7 @@ class LocalExecutor:
             order, lo, cnt, total = self._join_count(
                 node.keys, source, filt
             )
-            out_cap = pad_capacity(max(total, 1))
+            out_cap = shapes.bucket(max(total, 1), site="semi-join")
             key = (
                 "semiB", tuple(node.keys), repr(node.filter), out_cap,
                 self._layout_sig(source), self._layout_sig(filt),
@@ -2304,6 +2357,23 @@ def _page_dev_bytes(page: Page) -> int:
         if c.valid is not None:
             total += c.valid.shape[0]
     return total
+
+
+def _rename_out(out_layout, env: dict, out_map: dict):
+    """Translate a canonical chain program's output layout + env back
+    to the caller's original symbol names (see shapes.canonicalize_chain).
+    Builds fresh structures — the cached layout is shared across calls."""
+    m = out_map
+    layout = stage.ChainLayout(
+        names=[m[n] for n in out_layout.names],
+        types={m[n]: out_layout.types[n] for n in out_layout.names},
+        dicts={m[n]: out_layout.dicts.get(n) for n in out_layout.names},
+        capacity=out_layout.capacity,
+        pools={m[n]: p for n, p in out_layout.pools.items() if n in m},
+        arrays={m[n]: a for n, a in out_layout.arrays.items() if n in m},
+    )
+    env2 = {m[n]: env[n] for n in out_layout.names}
+    return layout, env2
 
 
 def _slice_page(page: Page, lo: int, hi: int) -> Page:

@@ -34,9 +34,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from trino_tpu import telemetry, types as T
 from trino_tpu.exec import kernels as K
+from trino_tpu.exec import shapes as shape_policy
 from trino_tpu.exec import stage
 from trino_tpu.exec.failure import FailureInjector, InjectedFailure
-from trino_tpu.exec.local import LocalExecutor
+from trino_tpu.exec.local import LocalExecutor, _rename_out
 from trino_tpu.expr.compiler import compile_expr, ColumnLayout
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.page import Column, Page, pad_capacity, unify_dictionaries
@@ -445,6 +446,20 @@ class MeshExecutor(LocalExecutor):
         shard_cap = sp.shard_capacity
         caps = stage.plan_capacities(chain, shard_cap, n_shards=self.n_shards)
         axis = self.axis
+        out_map = None
+        if shape_policy.enabled(self.session):
+            canon = shape_policy.canonicalize_chain(chain, list(sp.names))
+            if canon is not None:
+                # nameless normal form: the shard program (and its
+                # cache key) goes independent of this query's symbol
+                # names — see exec.shapes
+                by_name = dict(zip(sp.names, sp.columns))
+                sp = ShardedPage(
+                    list(canon.in_map.values()),
+                    [by_name[o] for o in canon.in_map],
+                    sp.mask, self.n_shards,
+                )
+                chain, out_map = canon.chain, canon.out_map
         while True:
             key = (
                 "mesh-chain",
@@ -486,13 +501,13 @@ class MeshExecutor(LocalExecutor):
                     env, mask = _env_from_leaves(list(ls), _meta)
                     return _fn(env, mask)
 
-                shapes = [
+                leaf_shapes = [
                     jax.ShapeDtypeStruct(
                         (l.shape[0] // self.n_shards,) + l.shape[1:], l.dtype
                     )
                     for l in leaves
                 ]
-                out_shape = jax.eval_shape(flat_fn_shape, *shapes)
+                out_shape = jax.eval_shape(flat_fn_shape, *leaf_shapes)
                 out_specs = (
                     jax.tree.map(lambda _: PS(axis), out_shape[0]),
                     PS(axis),
@@ -526,6 +541,8 @@ class MeshExecutor(LocalExecutor):
                             )
                         caps[i][0] = min(cap * 8, mx)
                     continue
+            if out_map is not None:
+                out_layout, env = _rename_out(out_layout, env, out_map)
             cols = [
                 Column(
                     out_layout.types[s],
@@ -618,9 +635,7 @@ class MeshExecutor(LocalExecutor):
         retry (the OutputBuffer backpressure analog)."""
         shard_cap = sp.shard_capacity
         n = self.n_shards
-        bucket_cap = min(
-            pad_capacity(max(2 * shard_cap // n, 128)), shard_cap
-        )
+        bucket_cap = shape_policy.exchange_bucket(shard_cap, n)
         leaves, meta = _page_leaves(sp)
         self.exchange_stats["exchanges"] += 1
         moved = sum(

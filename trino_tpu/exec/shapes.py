@@ -1,0 +1,326 @@
+"""Canonical shape buckets + chain canonicalization: the one policy
+module deciding which XLA programs can exist.
+
+Per-shape compilation is this engine's analog of the reference's
+runtime bytecode generation (MAIN/sql/gen/) — and its compile tax.
+Two mechanisms keep the program population small enough that jit-cache
+hits are the common case across queries AND scale factors:
+
+1. **Capacity buckets.** Every operator input dimension (row
+   capacity, aggregate slot tables, exchange buckets, chunked-agg
+   chunk widths, TopN caps) quantizes onto ONE family — the
+   power-of-two / 1.5x-power-of-two ladder of ``page.pad_capacity`` —
+   through the helpers here, which also account padding overhead into
+   the ``trino_shape_bucket_pad_waste_ratio`` gauge so the cost of
+   bucketing stays observable.
+
+2. **Chain canonicalization.** The executors key their jit caches on
+   (plan structure, layout) tuples whose ``repr``s embed *symbol
+   names*: ``sum(l_quantity)`` and ``sum(l_extendedprice)`` built
+   byte-identical programs that compiled twice. ``canonicalize_chain``
+   rewrites a fused operator chain into a nameless normal form —
+   input columns pruned to the referenced set and renamed positionally
+   in first-use order, intermediate symbols renamed scope-aware — so
+   distinct queries sharing an operator mix (and the same query at
+   data sizes landing in the same buckets) resolve to the SAME cached
+   program. Dictionary identity stays in the layout signature:
+   compiled programs bake dictionary codes and content-hash tables, so
+   only columns sharing the dictionary object may share a program.
+
+The ``shape_bucketing`` session property (ON|OFF, default ON) is the
+escape hatch: OFF keeps the pre-canonicalization per-name cache keys.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, replace as dc_replace
+
+from trino_tpu.expr.ir import AggCall, Call, Cast, InputRef, Literal, RowExpression
+from trino_tpu.page import pad_capacity
+from trino_tpu.plan import nodes as P
+
+__all__ = [
+    "bucket", "table_bucket", "exchange_bucket", "record_waste",
+    "enabled", "canonicalize_chain", "CanonicalChain", "prewarm",
+]
+
+#: canonical-name prefix; U+00A7 cannot appear in parsed SQL symbols
+_CANON = "§"
+
+#: the minimum aggregate-table bucket: estimates below it all collapse
+#: onto one rung, so group-count jitter across scale factors does not
+#: mint new programs for small group-bys
+TABLE_FLOOR = 1024
+
+
+# ---------------------------------------------------------------------------
+# bucket family + waste accounting
+# ---------------------------------------------------------------------------
+
+_waste_lock = threading.Lock()
+#: site -> [requested_rows_sum, bucketed_rows_sum]
+_waste: dict[str, list[int]] = {}
+
+
+def record_waste(site: str, requested: int, bucketed: int) -> None:
+    """Account one padding decision into the waste gauge (cumulative
+    per site: gauge value = 1 - sum(requested)/sum(bucketed))."""
+    if bucketed <= 0:
+        return
+    from trino_tpu import telemetry
+
+    with _waste_lock:
+        acc = _waste.setdefault(site, [0, 0])
+        acc[0] += max(int(requested), 0)
+        acc[1] += int(bucketed)
+        ratio = 1.0 - (acc[0] / acc[1]) if acc[1] else 0.0
+    telemetry.SHAPE_PAD_WASTE.set(round(ratio, 6), site=site)
+
+
+def bucket(n: int, minimum: int = 8, site: str | None = None) -> int:
+    """Canonical row-capacity bucket (the pad_capacity family: power of
+    two or 1.5x a power of two, >= 96, divisible by 8; worst-case
+    padding 33%). ``site`` feeds the waste gauge."""
+    b = pad_capacity(n, minimum)
+    if site is not None:
+        record_waste(site, n, b)
+    return b
+
+
+def table_bucket(est: float, max_cap: int, site: str = "agg-table") -> int:
+    """Canonical aggregate slot-table capacity for an estimated group
+    count: 1.25x margin + floor, quantized onto the bucket family.
+    The floor collapses small-group estimates across scale factors and
+    queries onto one rung."""
+    want = max(int(est * 1.25) + TABLE_FLOOR, TABLE_FLOOR)
+    b = min(bucket(want), max_cap) if max_cap else bucket(want)
+    record_waste(site, min(want, b), b)
+    return b
+
+
+def exchange_bucket(shard_cap: int, n_shards: int, site: str = "exchange") -> int:
+    """Canonical per-destination bucket capacity for the all_to_all
+    exchange (2x mean occupancy margin, >= 128, capped at the shard
+    capacity so escalation can always terminate)."""
+    want = max(2 * shard_cap // max(n_shards, 1), 128)
+    b = min(bucket(want), shard_cap)
+    record_waste(site, min(want, b), b)
+    return b
+
+
+def enabled(session) -> bool:
+    """shape_bucketing session property (ON unless explicitly OFF)."""
+    try:
+        from trino_tpu import session_properties as SP
+
+        return str(SP.get(session, "shape_bucketing")).upper() != "OFF"
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# chain canonicalization
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CanonicalChain:
+    """A fused chain rewritten into nameless normal form."""
+
+    #: the renamed chain (original nodes are never mutated)
+    chain: list
+    #: original input column name -> canonical name, in first-use order
+    in_map: dict[str, str]
+    #: canonical output symbol -> original output symbol
+    out_map: dict[str, str]
+
+
+class _Bail(Exception):
+    """A construct canonicalization does not cover — callers fall back
+    to the per-name cache key (correct, just not shared)."""
+
+
+def _rename_expr(e: RowExpression, scope: dict[str, str], use) -> RowExpression:
+    if isinstance(e, InputRef):
+        return InputRef(e.type, use(e.name))
+    if isinstance(e, Literal):
+        return e
+    if isinstance(e, Call):
+        return Call(e.type, e.name, tuple(_rename_expr(a, scope, use) for a in e.args))
+    if isinstance(e, Cast):
+        return Cast(e.type, _rename_expr(e.arg, scope, use))
+    raise _Bail(type(e).__name__)
+
+
+def canonicalize_chain(chain: list, in_names: list[str]) -> CanonicalChain | None:
+    """Rewrite a fused operator chain so its cache key is independent
+    of symbol names: input columns prune to the referenced set (when a
+    Project/Aggregate rebuilds the environment downstream) and rename
+    positionally in first-use order; symbols introduced mid-chain
+    rename scope-aware. Returns None when the chain contains a
+    construct the rewriter does not cover (caller keeps the legacy
+    key)."""
+    in_set = set(in_names)
+    counter = [0]
+    scope: dict[str, str] = {}
+    in_map: dict[str, str] = {}
+    # a chain with no environment-rebuilding node passes every input
+    # column through to its output: pruning would change the result,
+    # so all inputs bind up front (still nameless — positional)
+    rebuilds = any(isinstance(n, (P.Project, P.Aggregate)) for n in chain)
+
+    def fresh() -> str:
+        nm = f"{_CANON}{counter[0]}"
+        counter[0] += 1
+        return nm
+
+    def use(name: str) -> str:
+        hit = scope.get(name)
+        if hit is not None:
+            return hit
+        if name in in_set and not any(
+            isinstance(n, (P.Project, P.Aggregate))
+            for n in canon_chain  # a rebuild already replaced the scope
+        ):
+            nm = fresh()
+            scope[name] = nm
+            in_map[name] = nm
+            return nm
+        raise _Bail(f"unbound symbol {name!r}")
+
+    canon_chain: list = []
+    if not rebuilds:
+        for name in in_names:
+            nm = fresh()
+            scope[name] = nm
+            in_map[name] = nm
+    try:
+        for nd in chain:
+            if isinstance(nd, P.Filter):
+                canon_chain.append(dc_replace(
+                    nd, source=None,
+                    predicate=_rename_expr(nd.predicate, scope, use),
+                ))
+            elif isinstance(nd, P.Project):
+                assigns = {}
+                new_scope = {}
+                for sym, e in nd.assignments.items():
+                    ce = _rename_expr(e, scope, use)
+                    nm = fresh()
+                    new_scope[sym] = nm
+                    assigns[nm] = ce
+                canon_chain.append(
+                    dc_replace(nd, source=None, assignments=assigns)
+                )
+                scope = new_scope
+            elif isinstance(nd, P.Aggregate):
+                gk = [use(s) for s in nd.group_keys]
+                aggs = {}
+                new_scope = {s: scope[s] for s in nd.group_keys}
+                for sym, call in nd.aggregates.items():
+                    c2 = AggCall(
+                        name=call.name,
+                        args=tuple(
+                            _rename_expr(a, scope, use) for a in call.args
+                        ),
+                        type=call.type,
+                        distinct=call.distinct,
+                        filter=(
+                            None if call.filter is None
+                            else _rename_expr(call.filter, scope, use)
+                        ),
+                    )
+                    nm = fresh()
+                    new_scope[sym] = nm
+                    aggs[nm] = c2
+                kr = (
+                    None if nd.key_ranges is None
+                    else {use(s): r for s, r in nd.key_ranges.items()}
+                )
+                outs = {
+                    new_scope[s]: t
+                    for s, t in nd.outputs.items() if s in new_scope
+                }
+                canon_chain.append(dc_replace(
+                    nd, source=None, group_keys=gk, aggregates=aggs,
+                    key_ranges=kr, outputs=outs,
+                ))
+                scope = new_scope
+            elif isinstance(nd, (P.Sort, P.TopN)):
+                keys = [
+                    P.SortKey(use(k.symbol), k.ascending, k.nulls_first)
+                    for k in nd.keys
+                ]
+                canon_chain.append(dc_replace(nd, source=None, keys=keys))
+            elif isinstance(nd, P.Limit):
+                canon_chain.append(dc_replace(nd, source=None))
+            else:
+                raise _Bail(type(nd).__name__)
+    except _Bail:
+        return None
+    out_map = {canon: orig for orig, canon in scope.items()}
+    return CanonicalChain(chain=canon_chain, in_map=in_map, out_map=out_map)
+
+
+# ---------------------------------------------------------------------------
+# pre-warm
+# ---------------------------------------------------------------------------
+
+#: default bucket set traced at server start: the canonical capacities
+#: tiny/test scans and typical aggregate tables land on. Small on
+#: purpose — with a warm persistent cache the whole set deserializes
+#: in well under a second; cold it costs a few seconds once per
+#: machine.
+PREWARM_BUCKETS = (8192, 65536)
+
+
+def prewarm(buckets=None, include_joins: bool = True) -> dict:
+    """Trace-compile the hot kernel entry points (exec.kernels jitted
+    functions) at the canonical bucket set, so the first query of a
+    given operator mix pays dispatch, not compilation. Idempotent and
+    persistent-cache-backed: on a machine with a warm ``.jax_cache``
+    this deserializes instead of compiling. Returns a summary dict
+    (buckets, seconds, compile count delta)."""
+    import time
+
+    import jax.numpy as jnp
+
+    from trino_tpu import telemetry
+    from trino_tpu.exec import kernels as K
+
+    telemetry.install_jax_compile_hook()
+    if buckets is None:
+        raw = os.environ.get("TRINO_TPU_PREWARM_BUCKETS", "")
+        buckets = (
+            tuple(int(x) for x in raw.split(",") if x.strip())
+            if raw.strip() else PREWARM_BUCKETS
+        )
+    t0 = time.perf_counter()
+    c0 = telemetry.compile_snapshot()
+    for cap in buckets:
+        cap = bucket(cap)
+        bits = jnp.zeros((cap,), dtype=jnp.uint64)
+        mask = jnp.zeros((cap,), dtype=jnp.bool_)
+        # grouping: single- and two-key sort_group at full key width
+        # (sort_group/join_ranges/expand_matches are the standalone
+        # jitted entry points; the fused chain programs inline their
+        # bodies and warm through the persistent cache instead)
+        K.sort_group((bits,), (None,), mask, TABLE_FLOOR, widths=(64,))
+        K.sort_group(
+            (bits, bits), (None, None), mask, TABLE_FLOOR, widths=(64, 64)
+        )
+        if include_joins:
+            # hash-join probe: searchsorted ranges + match expansion
+            order, lo, cnt = K.join_ranges(bits, mask, bits, mask)
+            K.expand_matches(order, lo, cnt, out_capacity=cap)
+    c1 = telemetry.compile_snapshot()
+    return {
+        "buckets": [int(b) for b in buckets],
+        "seconds": round(time.perf_counter() - t0, 3),
+        "compiles": int(c1["compiles"] - c0["compiles"]),
+        "compile_seconds": round(
+            c1["compile_seconds"] - c0["compile_seconds"], 3
+        ),
+    }

@@ -136,22 +136,27 @@ def plan_capacities(
                 caps[i] = [1, 1]
                 cap = 8
             else:
+                from trino_tpu.exec import shapes
+
                 max_cap = pad_capacity(max(2 * cap, 8))
                 if nd.est_groups is not None:
                     est = nd.est_groups
                     if n_shards > 1 and nd.step in ("FINAL", "SINGLE"):
                         est = est / n_shards * 1.5
-                    start = min(
-                        pad_capacity(int(est * 1.25) + 1024), max_cap
-                    )
+                    start = shapes.table_bucket(est, max_cap)
                 else:
                     start = min(
-                        pad_capacity(max(cap // 16, 1024)), max_cap
+                        shapes.bucket(
+                            max(cap // 16, 1024), site="agg-table"
+                        ),
+                        max_cap,
                     )
                 caps[i] = [start, max_cap]
                 cap = start
         elif isinstance(nd, P.TopN):
-            cap = pad_capacity(min(nd.count, cap))
+            from trino_tpu.exec import shapes
+
+            cap = shapes.bucket(min(nd.count, cap), site="topn")
     return caps
 
 

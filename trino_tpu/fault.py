@@ -47,7 +47,7 @@ __all__ = [
 #: the closed set of injection sites (typo'd arms fail fast)
 SITES = frozenset(
     ["rpc", "spool-write", "spool-read", "task-exec", "device-oom",
-     "planner"]
+     "planner", "compile-deserialize"]
 )
 
 

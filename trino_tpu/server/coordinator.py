@@ -218,6 +218,15 @@ class Coordinator:
     # ---- lifecycle -------------------------------------------------------
 
     def start(self) -> "Coordinator":
+        import os
+
+        if os.environ.get("TRINO_TPU_PREWARM", "") not in ("", "0"):
+            # trace-compile the canonical bucket set before serving
+            # (persistent-cache-backed: warm machines deserialize
+            # instead of compiling; off by default for fast test spins)
+            from trino_tpu.exec import shapes
+
+            shapes.prewarm()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )

@@ -674,17 +674,20 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    # NO persistent compile cache in worker processes:
-    # backend.deserialize_executable wedges permanently (observed
-    # repeatedly) when invoked from worker task threads — even
-    # single-threaded, even against a cache directory this same
-    # process just wrote. The in-memory jit cache still amortizes
-    # compiles across a worker's lifetime; only cross-restart warmth
-    # is lost.
-    import jax as _jax
+    # Persistent compile cache stays ON in workers — but only behind
+    # the compile service: backend.deserialize_executable wedges
+    # permanently when driven from worker task threads (observed
+    # repeatedly — even single-threaded, even against a cache
+    # directory this same process just wrote). install() reroutes
+    # exactly the cache-read/deserialize onto the service's one
+    # dedicated thread with a deadline watchdog — task threads keep
+    # compiling and executing in parallel; a wedged deserialize
+    # degrades this process to in-memory-only compilation (the old
+    # always-off behavior, now the fallback instead of the default)
+    # rather than hanging the task. See trino_tpu/jit_cache.py.
+    from trino_tpu import jit_cache
 
-    if _jax.config.jax_compilation_cache_dir:
-        _jax.config.update("jax_compilation_cache_dir", None)
+    jit_cache.install()
     mesh = None
     if args.mesh:
         from trino_tpu.parallel.core import make_mesh
@@ -694,6 +697,14 @@ def main():
         QueryRunner.tpcds if args.catalog == "tpcds" else QueryRunner.tpch
     )
     runner = factory(args.schema, mesh=mesh)
+    if os.environ.get("TRINO_TPU_PREWARM", "") not in ("", "0"):
+        # trace-compile the canonical bucket set before accepting
+        # tasks (cheap against a warm persistent cache; off by default
+        # so test fleets spawn fast)
+        from trino_tpu.exec import shapes
+
+        info = shapes.prewarm()
+        print(f"prewarm: {info}", flush=True)
     server = WorkerServer(runner, port=args.port)
     server.start()
     print(f"worker ready on port {server.port}", flush=True)

@@ -206,6 +206,16 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "bigint", 8_000_000, _positive("cross_join_chunk_rows"),
         ),
         _P(
+            "shape_bucketing",
+            "ON routes executor cache keys through exec.shapes: "
+            "operator capacities quantize onto the canonical bucket "
+            "family and fused chains canonicalize to nameless form, so "
+            "different queries sharing an operator mix (and the same "
+            "query across scale factors) reuse one XLA program; OFF "
+            "restores per-name, per-capacity cache keys",
+            "varchar", "ON", _one_of("shape_bucketing", {"ON", "OFF"}),
+        ),
+        _P(
             "dynamic_filtering_enabled",
             "Prune probe rows by build-side key bounds before "
             "joins (enable_dynamic_filtering analog)",

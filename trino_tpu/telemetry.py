@@ -503,6 +503,18 @@ SCHED_OVERLAP = REGISTRY.gauge(
     "trino_sched_overlap_seconds", "Producer/consumer overlap won by pipelined admission, last fleet query")
 SCHED_RESCINDS = REGISTRY.counter(
     "trino_sched_rescinds_total", "Pipelined admissions rescinded after a producer-attempt quarantine")
+SHAPE_PAD_WASTE = REGISTRY.gauge(
+    "trino_shape_bucket_pad_waste_ratio",
+    "Fraction of bucketed capacity lost to padding, by bucketing site")
+PERSISTENT_CACHE_DEGRADED = REGISTRY.gauge(
+    "trino_persistent_cache_degraded",
+    "1 when this process fell back to in-memory-only compilation after a wedged cache deserialize")
+COMPILE_DESERIALIZE_FALLBACKS = REGISTRY.counter(
+    "trino_compile_deserialize_fallbacks_total",
+    "Compile-service watchdog trips: cache-backed compilations abandoned past the deadline")
+PERSISTENT_CACHE_HITS = REGISTRY.counter(
+    "trino_persistent_cache_hits_total",
+    "XLA programs deserialized from the on-disk compilation cache instead of compiled")
 
 
 # ---------------------------------------------------------------------------
@@ -510,12 +522,24 @@ SCHED_RESCINDS = REGISTRY.counter(
 # ---------------------------------------------------------------------------
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_PCACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 _hook_installed = False
 _hook_lock = threading.Lock()
+#: per-thread flag: a persistent-cache hit event precedes the
+#: backend_compile_duration event of the SAME compile request (which,
+#: on this jax version, fires for retrievals too — counting it as a
+#: compile would make warm processes look cold)
+_hook_tls = threading.local()
 
 
 def install_jax_compile_hook() -> bool:
-    """Register a jax.monitoring listener feeding the compile counters.
+    """Register jax.monitoring listeners feeding the compile counters.
+
+    ``trino_xla_compile_total`` counts REAL backend compiles only:
+    ``backend_compile_duration`` fires for persistent-cache retrievals
+    as well, so a preceding ``cache_hits`` event (same thread, same
+    request) reroutes that sample to
+    ``trino_persistent_cache_hits_total`` instead.
 
     Idempotent; returns True when the hook is (already) active. Uses the
     private ``jax._src.monitoring`` registration API (present on jax
@@ -528,11 +552,20 @@ def install_jax_compile_hook() -> bool:
         try:
             from jax._src import monitoring as _mon
 
+            def _on_event(event: str, **kw: Any) -> None:
+                if event == _PCACHE_HIT_EVENT:
+                    _hook_tls.pcache_hit = True
+
             def _on_duration(event: str, duration: float, **kw: Any) -> None:
                 if event == _COMPILE_EVENT:
-                    XLA_COMPILES.inc()
-                    XLA_COMPILE_SECONDS.inc(duration)
+                    if getattr(_hook_tls, "pcache_hit", False):
+                        _hook_tls.pcache_hit = False
+                        PERSISTENT_CACHE_HITS.inc()
+                    else:
+                        XLA_COMPILES.inc()
+                        XLA_COMPILE_SECONDS.inc(duration)
 
+            _mon.register_event_listener(_on_event)
             _mon.register_event_duration_secs_listener(_on_duration)
             _hook_installed = True
         except Exception:
@@ -547,6 +580,7 @@ def compile_snapshot() -> Dict[str, float]:
         "compile_seconds": XLA_COMPILE_SECONDS.total(),
         "cache_hits": JIT_CACHE_HITS.total(),
         "cache_misses": JIT_CACHE_MISSES.total(),
+        "persistent_hits": PERSISTENT_CACHE_HITS.total(),
     }
 
 
