@@ -130,6 +130,12 @@ def main(argv=None) -> int:
         "JSON (<dir>/<qid>.trace.json — load in chrome://tracing or "
         "ui.perfetto.dev)",
     )
+    ap.add_argument(
+        "--profile-dir", default=os.environ.get("BENCH_PROFILE_DIR"),
+        help="save each warmup query's operator profile as JSON "
+        "(<dir>/<qid>.profile.json — the QueryInfo tree: per-operator "
+        "self time, rows, and roofline attribution)",
+    )
     args = ap.parse_args(argv)
     sf = float(os.environ.get("BENCH_SF", "1"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
@@ -198,6 +204,9 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
 
     if args.trace_dir:
         os.makedirs(args.trace_dir, exist_ok=True)
+    if args.profile_dir:
+        os.makedirs(args.profile_dir, exist_ok=True)
+    profile_results = {}
 
     ours = {}
     spread = {}
@@ -234,6 +243,8 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
                 )
                 with open(path, "w") as f:
                     f.write(result.trace.to_chrome_json())
+        if args.profile_dir:
+            profile_results[q] = result
         rowcounts[q] = len(result.rows)
         # memory governance observability: the warmup run's peak
         # reservation (trino_tpu.memory context tree) is free to record
@@ -252,6 +263,16 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         ours[q], lo, hi = timed_runs(lambda: runner.execute(sql), reps)
         spread[q] = (lo, hi)
     assert rowcounts["q01"] == 4, f"Q1 must yield 4 groups, got {rowcounts['q01']}"
+
+    if args.profile_dir:
+        # written only after the loop recorded every cold/warm compile
+        # delta: profile_json()'s lazy XLA cost analysis pays extra
+        # compiles (persistent-cache deserializes) that must not
+        # pollute the per-query compile bookkeeping above
+        for q, res in profile_results.items():
+            path = os.path.join(args.profile_dir, f"{q}.profile.json")
+            with open(path, "w") as f:
+                f.write(res.profile_json(indent=2))
 
     # north-star: rows/sec/chip through hash-join + aggregation
     runner.execute(JOIN_AGG_SQL)  # warmup

@@ -16,6 +16,7 @@ import os
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -415,5 +416,289 @@ def test_coordinator_metrics_endpoint():
         ):
             assert family in text, family
         assert _parse_sample(text, "trino_queries_total") >= 1
+    finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# exposition-format compliance (parse with the official client)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_prometheus_client_round_trip():
+    pytest.importorskip("prometheus_client")
+    from prometheus_client.parser import text_string_to_metric_families
+
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter(
+        "t_rt_requests_total", 'help with "quotes", a \\ and\na newline'
+    )
+    c.inc(3, state='o"k', path="a\\b\nc")
+    h = reg.histogram("t_rt_latency_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05, op="x")
+    reg.gauge("t_rt_pool_bytes", "pool").set(7)
+    text = reg.render()
+    assert text.endswith("\n")
+    samples = [
+        s
+        for fam in text_string_to_metric_families(text)
+        for s in fam.samples
+    ]
+    by_name = {}
+    for s in samples:
+        by_name.setdefault(s.name, []).append(s)
+    # escaped label values come back verbatim
+    (req,) = by_name["t_rt_requests_total"]
+    assert req.value == 3
+    assert req.labels == {"state": 'o"k', "path": "a\\b\nc"}
+    buckets = {
+        s.labels["le"]: s.value
+        for s in by_name["t_rt_latency_seconds_bucket"]
+    }
+    assert buckets["0.1"] == 1 and buckets["+Inf"] == 1
+    assert by_name["t_rt_latency_seconds_count"][0].value == 1
+    assert by_name["t_rt_pool_bytes"][0].value == 7
+
+    # the REAL process registry — every live family must parse too
+    fams = list(
+        text_string_to_metric_families(telemetry.REGISTRY.render())
+    )
+    assert fams
+
+
+def test_rpc_latency_histogram_has_submillisecond_buckets():
+    # the poll path sits well under 10ms; the default bucket ladder
+    # started at 1ms and lumped everything below it together
+    assert 0.0005 in telemetry.RPC_LATENCY.buckets
+    assert 0.0025 in telemetry.RPC_LATENCY.buckets
+    assert 0.0005 in telemetry.OPERATOR_SELF_TIME.buckets
+
+
+# ---------------------------------------------------------------------------
+# per-operator attribution: local engine
+# ---------------------------------------------------------------------------
+
+
+def _walk_ops(ops):
+    for op in ops:
+        yield op
+        yield from _walk_ops(op.get("children") or [])
+
+
+def test_local_query_info_operator_tree_and_roofline():
+    runner = QueryRunner.tpch("tiny")
+    res = runner.execute(
+        "select sum(l_extendedprice * (1 - l_discount)) from lineitem"
+    )
+    info = res.query_info
+    assert info["state"] == "FINISHED"
+    assert info["query_id"]
+    (stage,) = info["stages"]
+    (task,) = stage["tasks"]
+    flat = list(_walk_ops(task["operators"]))
+    assert flat
+    assert all(op["wall_ms"] >= 0 for op in flat)
+    assert any(op["wall_ms"] > 0 for op in flat)
+    # the lazy XLA cost join ran: some operator carries flops and the
+    # derived roofline attribution
+    costed = [op for op in flat if op.get("flops")]
+    assert costed, flat
+    assert any("achieved_gflops" in op for op in costed)
+    # profile_json is the same tree, serialized
+    doc = json.loads(res.profile_json())
+    assert doc["query_id"] == info["query_id"]
+
+
+def test_local_explain_analyze_prints_roofline():
+    runner = QueryRunner.tpch("tiny")
+    res = runner.execute(
+        "explain analyze select sum(l_extendedprice * (1 - l_discount)) "
+        "from lineitem"
+    )
+    text = "\n".join(r[0] for r in res.rows)
+    assert "self" in text
+    assert "xla:" in text, text
+    assert "GFLOP/s achieved" in text
+    assert "% of" in text and "roofline" in text
+
+
+def test_slow_query_log_writes_profile_summary(tmp_path):
+    runner = QueryRunner.tpch("tiny")
+    path = tmp_path / "slow.jsonl"
+    runner.metadata.event_listeners = [
+        StructuredLogListener(path=str(path))
+    ]
+    # default: disabled — nothing written
+    runner.execute("select count(*) from region")
+    recs = [
+        json.loads(line)
+        for line in path.read_text().splitlines()
+        if line
+    ] if path.exists() else []
+    assert not [r for r in recs if r.get("event") == "slow_query"]
+    # threshold below any real run: one slow_query line with the top-3
+    runner.session.properties["slow_query_log_threshold"] = "1ms"
+    runner.execute("select count(*) from nation")
+    recs = [
+        json.loads(line)
+        for line in path.read_text().splitlines()
+        if line
+    ]
+    slow = [r for r in recs if r.get("event") == "slow_query"]
+    assert len(slow) == 1
+    rec = slow[0]
+    assert rec["query_id"] and rec["sql"].startswith("select count")
+    assert rec["elapsed_ms"] > 1e-3
+    assert rec["top_operators"]
+    assert all("self_ms" in t for t in rec["top_operators"])
+
+
+# ---------------------------------------------------------------------------
+# per-operator attribution: live 2-worker fleet + QueryInfo API
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_operator_stats_sum_consistently_q3(fleet):
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.testing.golden import load_tpch_sqlite, to_sqlite
+
+    res = fleet.execute(QUERIES["q03"])
+    oracle = load_tpch_sqlite(TpchConnector().data("tiny"))
+    expect = oracle.execute(to_sqlite(QUERIES["q03"])).fetchall()
+    # query level agrees with the sqlite oracle
+    assert len(res.rows) == len(expect)
+
+    finished = [t for t in res.task_stats if t["state"] == "FINISHED"]
+    assert finished
+    tasks_with_ops = 0
+    for t in finished:
+        ops = t.get("operator_stats") or []
+        if not ops:
+            continue
+        tasks_with_ops += 1
+        # operator -> task: exactly one root, and its output IS the
+        # task's spooled output
+        roots = [o for o in ops if o.get("parent_id") is None]
+        assert len(roots) == 1
+        assert roots[0]["rows_out"] == t["rows_out"], (roots, t)
+        # non-zero host wall clock on every operator record
+        assert all(o["wall_ms"] >= 0 for o in ops)
+        assert any(o["wall_ms"] > 0 for o in ops)
+    assert tasks_with_ops > 0
+    # task -> stage -> query: already asserted by
+    # test_fleet_stage_stats_agree_with_task_stats; re-check the root
+    assert res.stage_stats[-1]["rows_out"] == len(res.rows)
+
+
+def test_fleet_query_info_tree(fleet):
+    res = fleet.execute(
+        "select o_orderpriority, count(*) from orders "
+        "group by o_orderpriority"
+    )
+    info = res.query_info
+    assert info is not None and info["state"] == "FINISHED"
+    assert info["stages"], info
+    ops_seen = 0
+    for st in info["stages"]:
+        assert st["tasks"]
+        for task in st["tasks"]:
+            for op in _walk_ops(task.get("operators") or []):
+                ops_seen += 1
+                assert "self_ms" in op
+    assert ops_seen > 0
+    doc = json.loads(res.profile_json())
+    assert doc["query_id"] == info["query_id"]
+
+
+def test_worker_scrape_mid_query_has_operator_families(fleet, workers):
+    import threading
+
+    saved = dict(fleet.session.properties)
+    fleet.session.properties["fleet_task_delay_ms"] = 150
+    try:
+        done = threading.Event()
+        results = {}
+
+        def run():
+            try:
+                results["res"] = fleet.execute(
+                    "select count(*) from customer"
+                )
+            finally:
+                done.set()
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        time.sleep(0.2)  # inside the delayed task window
+        mid = [_scrape(w) for w in workers]  # must answer mid-query
+        done.wait(timeout=120)
+        th.join(timeout=10)
+    finally:
+        fleet.session.properties = saved
+    assert results["res"].rows[0][0] > 0
+    for text in mid:
+        assert "trino_operator_self_time_seconds" in text
+    # after at least one profiled task, the histogram has samples
+    post = [_scrape(w) for w in workers]
+    assert sum(
+        _parse_sample(t, "trino_operator_self_time_seconds_count")
+        for t in post
+    ) > 0
+
+
+def test_coordinator_query_info_endpoints():
+    from trino_tpu.server.coordinator import Coordinator
+
+    coord = Coordinator().start()
+    try:
+        q = coord.submit(
+            "select sum(l_extendedprice) from lineitem"
+        )
+        deadline = time.monotonic() + 60
+        while q.state not in ("FINISHED", "FAILED"):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert q.state == "FINISHED", q.error
+        base = f"http://127.0.0.1:{coord.port}"
+        with urllib.request.urlopen(f"{base}/v1/query", timeout=10) as r:
+            listing = json.loads(r.read())
+        mine = [x for x in listing if x["query_id"] == q.query_id]
+        assert mine and mine[0]["state"] == "FINISHED"
+        assert "elapsed_ms" in mine[0]
+        with urllib.request.urlopen(
+            f"{base}/v1/query/{q.query_id}", timeout=10
+        ) as r:
+            info = json.loads(r.read())
+        assert info["query_id"] == q.query_id
+        assert info["state"] == "FINISHED"
+        ops = [
+            op
+            for st in info.get("stages") or []
+            for task in st["tasks"]
+            for op in _walk_ops(task.get("operators") or [])
+        ]
+        assert ops, info
+        assert any(op["wall_ms"] > 0 for op in ops)
+        # unknown id -> 404
+        try:
+            urllib.request.urlopen(
+                f"{base}/v1/query/nope", timeout=10
+            )
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        # system.runtime.queries grew user + peak_memory_bytes
+        q2 = coord.submit(
+            "select query_id, user, peak_memory_bytes, state "
+            "from system.runtime.queries"
+        )
+        deadline = time.monotonic() + 60
+        while q2.state not in ("FINISHED", "FAILED"):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert q2.state == "FINISHED", q2.error
+        ids = [r[0] for r in q2.result.rows]
+        assert q.query_id in ids
     finally:
         coord.stop()

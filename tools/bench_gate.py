@@ -1,0 +1,174 @@
+"""Bench regression gate: compare a fresh bench.py JSON line against a
+committed BENCH_r0x trajectory file.
+
+    python tools/bench_gate.py fresh.json [--baseline BENCH_r04.json]
+                               [--tolerance 0.25]
+
+Both inputs may be either shape the repo produces:
+  * the bare object bench.py prints (``{"metric", "value", "detail"}``)
+  * the committed wrapper (``{"n", "cmd", "rc", "tail", "parsed": {...}}``)
+The wrapper is unwrapped through ``parsed``; a wrapper whose run died
+before emitting JSON (``parsed: null`` — e.g. BENCH_r05's timeout) is
+rejected with exit code 2 so CI shows a config error, not a fake pass.
+
+Checked, each with the same fractional tolerance band:
+  * headline ``value`` (rows/s, higher is better)
+  * per-query wall clock ``detail.q01_ms/q03_ms/q18_ms`` (lower better)
+  * ``detail.join_agg_rows_per_sec_chip`` (higher is better)
+  * compile counts (``*_warmup_compiles``/``*_warm_compiles``, lower is
+    better) — counts get ``max(1, tol*baseline)`` absolute slack since
+    a band around 0 or 2 is meaningless
+
+A key missing from EITHER side is reported as SKIP, never a failure:
+older trajectories predate the compile-tax split and newer ones may
+drop sections, and the gate must stay useful across that drift.
+Improvements are reported but never fail. Exit 0 = no regressions,
+1 = at least one metric regressed past the band, 2 = unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["compare", "load_bench", "main"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(_HERE), "BENCH_r04.json"
+)
+
+#: (key, higher_is_better) — dotted keys index into detail
+_RATE_KEYS = [
+    ("value", True),
+    ("vs_baseline", True),
+    ("detail.q01_ms", False),
+    ("detail.q03_ms", False),
+    ("detail.q18_ms", False),
+    ("detail.join_agg_rows_per_sec_chip", True),
+]
+
+#: compile-count keys: lower is better, absolute slack not a pure band
+_COUNT_KEYS = [
+    f"detail.{q}_{kind}"
+    for q in ("q01", "q03", "q18")
+    for kind in ("warmup_compiles", "warm_compiles")
+]
+
+
+def load_bench(path: str) -> dict:
+    """Load a bench JSON file, unwrapping the committed
+    ``{"parsed": {...}}`` trajectory shape when present."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "parsed" in doc and "value" not in doc:
+        parsed = doc["parsed"]
+        if parsed is None:
+            raise ValueError(
+                f"{path}: wrapper has parsed=null (rc={doc.get('rc')})"
+                " — that run never emitted its JSON line"
+            )
+        doc = parsed
+    if "value" not in doc:
+        raise ValueError(f"{path}: no 'value' key — not a bench JSON")
+    return doc
+
+
+def _get(doc: dict, dotted: str):
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def compare(fresh: dict, baseline: dict, tolerance: float) -> list[dict]:
+    """One row per metric: {key, status, fresh, baseline, ratio}.
+    status in {OK, IMPROVED, REGRESSION, SKIP}."""
+    rows = []
+    for key, higher_better in _RATE_KEYS:
+        f, b = _get(fresh, key), _get(baseline, key)
+        if not isinstance(f, (int, float)) or not isinstance(b, (int, float)) or not b:
+            rows.append({"key": key, "status": "SKIP",
+                         "fresh": f, "baseline": b})
+            continue
+        ratio = f / b
+        if higher_better:
+            bad = ratio < 1.0 - tolerance
+            improved = ratio > 1.0 + tolerance
+        else:
+            bad = ratio > 1.0 + tolerance
+            improved = ratio < 1.0 - tolerance
+        rows.append({
+            "key": key,
+            "status": ("REGRESSION" if bad
+                       else "IMPROVED" if improved else "OK"),
+            "fresh": f, "baseline": b, "ratio": round(ratio, 3),
+        })
+    for key in _COUNT_KEYS:
+        f, b = _get(fresh, key), _get(baseline, key)
+        if not isinstance(f, (int, float)) or not isinstance(b, (int, float)):
+            rows.append({"key": key, "status": "SKIP",
+                         "fresh": f, "baseline": b})
+            continue
+        slack = max(1.0, tolerance * b)
+        rows.append({
+            "key": key,
+            "status": "REGRESSION" if f > b + slack
+            else "IMPROVED" if f < b else "OK",
+            "fresh": f, "baseline": b,
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("fresh", help="fresh bench JSON (bare or wrapped)")
+    ap.add_argument(
+        "--baseline", default=_DEFAULT_BASELINE,
+        help="committed trajectory to gate against "
+        "(default: BENCH_r04.json)",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.25,
+        help="fractional band; 0.25 = fail on >25%% regression",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        fresh = load_bench(args.fresh)
+        baseline = load_bench(args.baseline)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench-gate: unusable input: {e}", file=sys.stderr)
+        return 2
+
+    rows = compare(fresh, baseline, args.tolerance)
+    regressions = [r for r in rows if r["status"] == "REGRESSION"]
+    for r in rows:
+        if r["status"] == "SKIP":
+            print(f"  SKIP       {r['key']} (missing on one side)")
+        else:
+            extra = (
+                f" ({r['ratio']}x)" if "ratio" in r else ""
+            )
+            print(
+                f"  {r['status']:<10} {r['key']}: "
+                f"{r['fresh']} vs baseline {r['baseline']}{extra}"
+            )
+    checked = sum(1 for r in rows if r["status"] != "SKIP")
+    print(
+        f"bench-gate: {checked} checked, "
+        f"{len(regressions)} regression(s), "
+        f"tolerance ±{args.tolerance:.0%}, "
+        f"baseline {os.path.basename(args.baseline)}"
+    )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,8 @@ _QUERIES_SCHEMA = TableSchema("queries", [
     ("error", T.VARCHAR),
     ("elapsed_ms", T.DOUBLE),
     ("rows", T.BIGINT),
+    ("user", T.VARCHAR),
+    ("peak_memory_bytes", T.BIGINT),
 ])
 
 _NODES_SCHEMA = TableSchema("nodes", [
@@ -99,17 +101,40 @@ class SystemConnector(Connector):
         raise KeyError(f"{schema}.{table}")
 
     def _query_rows(self):
-        if self.coordinator is None:
-            return []
+        from trino_tpu import tracker
+
+        live = {
+            r["query_id"]: r for r in tracker.QUERY_INFO.list()
+        }
         out = []
-        with self.coordinator._lock:
-            states = list(self.coordinator._queries.values())
-        for q in states:
-            end = q.finished_at or time.time()
+        if self.coordinator is not None:
+            # the coordinator sees every statement: it IS the query
+            # list; the registry only enriches it (peak memory). The
+            # process-global registry may also hold other runners'
+            # queries — mixing those in here would double-count.
+            with self.coordinator._lock:
+                states = list(self.coordinator._queries.values())
+            for q in states:
+                end = q.finished_at or time.time()
+                r = live.get(q.query_id) or {}
+                out.append((
+                    q.query_id, q.state, q.sql, q.error or "",
+                    (end - q.created_at) * 1e3,
+                    len(q.result.rows) if q.result is not None else 0,
+                    q.user,
+                    int(r.get("peak_memory_bytes", 0)),
+                ))
+            return out
+        # runner-direct statements (no coordinator) come from the
+        # live registry — including the one reading this table
+        for r in live.values():
             out.append((
-                q.query_id, q.state, q.sql, q.error or "",
-                (end - q.created_at) * 1e3,
-                len(q.result.rows) if q.result is not None else 0,
+                r["query_id"], r["state"], r.get("sql") or "",
+                r.get("error") or "",
+                float(r.get("elapsed_ms", 0.0)),
+                int(r.get("rows") or 0),
+                r.get("user") or "",
+                int(r.get("peak_memory_bytes", 0)),
             ))
         return out
 

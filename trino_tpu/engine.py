@@ -72,6 +72,29 @@ class QueryResult:
     planning_ms: float = 0.0
     execution_ms: float = 0.0
 
+    @property
+    def query_info(self) -> dict | None:
+        """Post-hoc QueryInfo tree (stages → tasks → operators), the
+        same JSON ``GET /v1/query/{id}`` served live. Operator roofline
+        attribution resolves lazily on first access — XLA cost analysis
+        runs only for queries whose profile is actually read."""
+        info = getattr(self, "_query_info", None)
+        if info is None:
+            resolver = getattr(self, "_query_info_resolver", None)
+            if resolver is not None:
+                self._query_info = info = resolver()
+                self._query_info_resolver = None
+        return info
+
+    def profile_json(self, indent: int | None = None) -> str:
+        """The profile artifact bench.py --profile-dir writes."""
+        import json
+
+        return json.dumps(
+            self.query_info or {}, indent=indent, default=str,
+            sort_keys=True,
+        )
+
 
 class QueryRunner:
     """SQL in, rows out — the LocalQueryRunner analog. With a ``mesh``,
@@ -193,7 +216,9 @@ class QueryRunner:
         plan = self.plan_sql(sql)
         return plan, self.executor.execute(plan)
 
-    def execute(self, sql: str, cancel_event=None) -> QueryResult:
+    def execute(
+        self, sql: str, cancel_event=None, query_id: str | None = None,
+    ) -> QueryResult:
         from trino_tpu import session_properties
 
         with self._lock:
@@ -210,7 +235,7 @@ class QueryRunner:
             self.executor.deadline = (
                 time.monotonic() + max_exec_s if max_exec_s > 0 else None
             )
-            query_id = uuid.uuid4().hex[:12]
+            query_id = query_id or uuid.uuid4().hex[:12]
             # per-query memory context: all executor reservations made
             # by this statement attribute to this query's subtree of
             # the pool (restored afterwards so ad-hoc executor use
@@ -218,13 +243,19 @@ class QueryRunner:
             prev_ctx = self.executor.memory_ctx
             qctx = self.executor.memory_pool.query_context(query_id)
             self.executor.memory_ctx = qctx
-            from trino_tpu import telemetry
+            from trino_tpu import telemetry, tracker
+            from trino_tpu.profiler import OperatorProfiler
 
             prev_tracer = getattr(self, "_tracer", None)
             prev_plan_ms = getattr(self, "_plan_ms", 0.0)
             tracer = telemetry.Tracer(query_id)
             self._tracer = tracer
             self._plan_ms = 0.0
+            tracker.QUERY_INFO.begin(
+                query_id, sql=sql, user=self.session.user
+            )
+            prev_prof = self.executor.profiler
+            self.executor.profiler = prof = OperatorProfiler()
             t0 = time.perf_counter()
             error = None
             result = None
@@ -243,6 +274,7 @@ class QueryRunner:
                 self.executor.cancel_event = None
                 self.executor.deadline = None
                 self.executor.memory_ctx = prev_ctx
+                self.executor.profiler = prev_prof
                 plan_ms = self._plan_ms
                 self._tracer = prev_tracer
                 self._plan_ms = prev_plan_ms
@@ -250,6 +282,27 @@ class QueryRunner:
                 state = "FAILED" if error else "FINISHED"
                 telemetry.QUERIES_TOTAL.inc(state=state)
                 node_id = self.executor.memory_pool.node_id
+                # timings-only seal for the live registry; the lazy
+                # QueryResult.query_info resolver is the path that pays
+                # for XLA cost analysis
+                op_stats = prof.finish(None)
+                for _row in op_stats:
+                    telemetry.OPERATOR_SELF_TIME.observe(
+                        _row.get("self_ms", 0.0) / 1e3,
+                        operator=_row.get("node_type", "?"),
+                    )
+                tracker.QUERY_INFO.finish(
+                    query_id, state=state,
+                    rows=len(result.rows) if result else None,
+                    error=error,
+                    peak_memory_bytes=qctx.peak_bytes,
+                    operator_stats=op_stats,
+                )
+                if result is not None:
+                    _ex, _prof, _qid = self.executor, prof, query_id
+                    result._query_info_resolver = (
+                        lambda: _local_query_info(_ex, _prof, _qid)
+                    )
                 if result is not None:
                     result.trace = tracer.finish()
                     result.planning_ms = plan_ms
@@ -326,6 +379,12 @@ class QueryRunner:
                             result.workers_readmitted if result else 0
                         ),
                     ))
+                from trino_tpu.events import maybe_log_slow_query
+
+                maybe_log_slow_query(
+                    listeners, self.session, query_id, sql,
+                    elapsed_ms, op_stats, state=state,
+                )
 
     def _execute(self, sql: str) -> QueryResult:
         from trino_tpu import session_properties
@@ -720,6 +779,14 @@ class QueryRunner:
         x0 = dict(xstats) if xstats is not None else None
         skew0 = getattr(ex, "skew_joins", 0)
         esc0 = getattr(ex, "exchange_escalations", 0)
+        # per-operator XLA cost attribution rides on the profiler the
+        # surrounding execute() installed (EXPLAIN ANALYZE called
+        # directly on a bare runner installs its own)
+        own_prof = None
+        if ex.profiler is None:
+            from trino_tpu.profiler import OperatorProfiler
+
+            ex.profiler = own_prof = OperatorProfiler()
         try:
             t0 = time.perf_counter()
             page = ex.execute(plan)
@@ -727,6 +794,19 @@ class QueryRunner:
             total_ms = (time.perf_counter() - t0) * 1e3
         finally:
             del ex.execute
+        # seal records now (costs resolve through the persistent XLA
+        # cache) and key them by plan node for the annotated tree;
+        # EXPLAIN ANALYZE is an explicit profile request, so eager
+        # cost analysis is the point, not overhead
+        prof = ex.profiler
+        profile: dict[int, dict] = {}
+        try:
+            prof.finish(ex)
+            for rec in prof.records:
+                profile[rec.plan_node_id] = rec.to_dict()
+        finally:
+            if own_prof is not None:
+                ex.profiler = None
         # fold the per-node timings into the single local pseudo-stage's
         # aggregate: EXPLAIN ANALYZE's stage line, QueryResult.stage_stats
         # and system.runtime.tasks all render from this one dict
@@ -770,10 +850,37 @@ class QueryRunner:
                 f"bucket escalations: "
                 f"{getattr(ex, 'exchange_escalations', 0) - esc0}"
             )
-        lines.extend(_annotated_tree(plan, stats).splitlines())
+        lines.extend(
+            _annotated_tree(plan, stats, profile=profile).splitlines()
+        )
         out = QueryResult(["Query Plan"], [(line,) for line in lines])
         out.stage_stats = stage_stats
         return out
+
+
+def _local_query_info(executor, prof, query_id: str) -> dict:
+    """Resolve the local engine's post-hoc QueryInfo tree: seal the
+    profiler WITH the executor so operator records gain XLA cost /
+    roofline attribution (the lazily-paid step), then shape the same
+    single-pseudo-stage tree the live registry serves."""
+    from trino_tpu import tracker
+    from trino_tpu.profiler import tree_from_stats
+
+    stats = prof.finish(executor)
+    info = tracker.QUERY_INFO.get(query_id) or {
+        "query_id": query_id, "state": "FINISHED", "stages": [],
+    }
+    info["stages"] = [{
+        "stage_id": "local",
+        "tasks": [{
+            "task_id": "local-0",
+            "attempt": 0,
+            "state": info.get("state", "FINISHED"),
+            "worker": "local",
+            "operators": tree_from_stats(stats),
+        }],
+    }]
+    return info
 
 
 class _NullCtx:
@@ -832,7 +939,9 @@ def _rows_in(node: P.PlanNode, stats) -> int:
     return total
 
 
-def _annotated_tree(node: P.PlanNode, stats, indent: int = 0) -> str:
+def _annotated_tree(
+    node: P.PlanNode, stats, indent: int = 0, profile=None,
+) -> str:
     from trino_tpu.exec.spill import row_bytes
 
     own = stats.get(id(node))
@@ -844,11 +953,27 @@ def _annotated_tree(node: P.PlanNode, stats, indent: int = 0) -> str:
         out_bytes = n_rows * row_bytes(node.outputs)
         base += (
             f"   [in: {n_in} rows, out: {n_rows} rows"
-            f" ({_fmt_bytes(out_bytes)}), {max(ms - child_ms, 0.0):.1f} ms]"
+            f" ({_fmt_bytes(out_bytes)}), "
+            f"self: {max(ms - child_ms, 0.0):.1f} ms]"
         )
+        prow = (profile or {}).get(id(node))
+        if prow and prow.get("achieved_gflops") is not None:
+            # the TPU-native column: measured rate vs the XLA cost
+            # model's roofline ceiling for this compiled program
+            util = prow.get("roofline_utilization")
+            base += (
+                f" [xla: {prow['flops'] / 1e6:.1f} MFLOPs, "
+                f"{prow['achieved_gflops']:.2f} GFLOP/s achieved"
+            )
+            if util is not None:
+                base += (
+                    f", {util * 100:.1f}% of "
+                    f"{prow['roofline_gflops']:.0f} GFLOP/s roofline"
+                )
+            base += "]"
     lines = [base]
     for s in node.sources:
-        lines.append(_annotated_tree(s, stats, indent + 1))
+        lines.append(_annotated_tree(s, stats, indent + 1, profile))
     return "\n".join(lines)
 
 

@@ -25,6 +25,8 @@ __all__ = [
     "EventListener",
     "StructuredLogListener",
     "fire_query_completed",
+    "fire_slow_query",
+    "maybe_log_slow_query",
 ]
 
 _log = logging.getLogger("trino_tpu.events")
@@ -74,6 +76,11 @@ class EventListener:
     def query_completed(self, event: QueryCompletedEvent) -> None:
         pass
 
+    def slow_query(self, record: dict) -> None:
+        """One query crossed ``slow_query_log_threshold``; ``record``
+        is the profile summary (top operators by self time)."""
+        pass
+
 
 class StructuredLogListener(EventListener):
     """Writes one JSON line per completed query — the reference's
@@ -91,12 +98,82 @@ class StructuredLogListener(EventListener):
         rec["peak_memory_per_node"] = [
             list(kv) for kv in event.peak_memory_per_node
         ]
+        self._write(rec)
+
+    def slow_query(self, record: dict) -> None:
+        self._write(record)
+
+    def _write(self, rec: dict) -> None:
         line = json.dumps(rec, sort_keys=True, default=str)
         if self._path is not None:
             with open(self._path, "a") as f:
                 f.write(line + "\n")
         else:
             self._stream.write(line + "\n")
+
+
+def fire_slow_query(listeners, record: dict) -> None:
+    """Deliver one slow-query record, isolating listener failures the
+    same way as ``fire_query_completed``."""
+    for lst in listeners:
+        try:
+            lst.slow_query(record)
+        except Exception:
+            telemetry.LISTENER_FAILURES.inc(listener=type(lst).__name__)
+            _log.debug(
+                "event listener %s raised in slow_query for %s",
+                type(lst).__name__, record.get("query_id"),
+                exc_info=True,
+            )
+
+
+def maybe_log_slow_query(
+    listeners, session, query_id: str, sql: str, elapsed_ms: float,
+    operator_stats: list | None, state: str = "FINISHED",
+) -> None:
+    """Fire one structured slow-query record when the statement ran
+    past the ``slow_query_log_threshold`` session property (0 = off).
+    The record is a profile *summary* — the top-3 operators by self
+    time — not the full tree; ``GET /v1/query/{id}`` and
+    ``profile_json()`` serve the rest."""
+    if not listeners:
+        return
+    from trino_tpu import session_properties as SP
+
+    try:
+        threshold_s = SP.parse_duration(
+            SP.get(session, "slow_query_log_threshold")
+        )
+    except Exception:
+        return
+    if threshold_s <= 0 or elapsed_ms < threshold_s * 1e3:
+        return
+    top = sorted(
+        operator_stats or [],
+        key=lambda r: r.get("self_ms", 0.0), reverse=True,
+    )[:3]
+    fire_slow_query(listeners, {
+        "event": "slow_query",
+        "query_id": query_id,
+        "user": getattr(session, "user", None),
+        "sql": sql,
+        "state": state,
+        "elapsed_ms": round(elapsed_ms, 3),
+        "threshold": f"{threshold_s:g}s",
+        "operators": len(operator_stats or []),
+        "top_operators": [
+            {
+                k: r.get(k)
+                for k in (
+                    "name", "node_type", "self_ms", "wall_ms",
+                    "rows_out", "achieved_gflops",
+                    "roofline_utilization",
+                )
+                if k in r
+            }
+            for r in top
+        ],
+    })
 
 
 def fire_query_completed(listeners, event: QueryCompletedEvent) -> None:

@@ -138,6 +138,16 @@ class LocalExecutor:
         #: flags/count sync into the result transfer (one fewer host
         #: round trip per query — material on a remote-device tunnel)
         self._defer_sync_for: P.PlanNode | None = None
+        #: per-operator profiler (trino_tpu.profiler.OperatorProfiler)
+        #: set for the duration of one query/task; None = no profiling
+        self.profiler = None
+        #: jit-cache key -> abstract (env, mask) avals captured at
+        #: dispatch time, feeding lazy XLA cost analysis
+        self._chain_avals: dict = {}
+        #: jit-cache key -> {"flops", "bytes_accessed"} | None (the
+        #: lazy cost cache; None records an analysis that failed so it
+        #: is never retried)
+        self._chain_costs: dict = {}
 
     def hbm_budget(self) -> int:
         """Device-memory budget in bytes (session ``hbm_budget_bytes``;
@@ -191,6 +201,34 @@ class LocalExecutor:
             )
 
     def execute(self, node: P.PlanNode) -> Page:
+        prof = self.profiler
+        if prof is None:
+            return self._execute_impl(node)
+        rec = prof.open(self._op_label(node), type(node).__name__, id(node))
+        try:
+            out = self._execute_impl(node)
+        except BaseException:
+            prof.close(rec, None)
+            raise
+        prof.close(rec, out)
+        return out
+
+    @staticmethod
+    def _op_label(node: P.PlanNode) -> str:
+        """Display label for one profiled operator. A fused chain
+        executes as one XLA program, so its head labels the whole
+        chain; everything else is its node type."""
+        if isinstance(node, stage.FUSABLE):
+            names = []
+            cur = node
+            while isinstance(cur, stage.FUSABLE):
+                names.append(type(cur).__name__)
+                cur = cur.sources[0]
+            if len(names) > 1:
+                return "→".join(reversed(names))
+        return type(node).__name__
+
+    def _execute_impl(self, node: P.PlanNode) -> Page:
         self._check_cancel()
         if isinstance(node, P.Output):
             # top of a query: drop any prefetch leftovers of a prior
@@ -610,13 +648,56 @@ class LocalExecutor:
             hit = (jax.jit(counted), out_layout)
             self._jit_cache[key] = hit
         fn, out_layout = hit
-        env, mask, flags, n_live_dev = fn(self._env(page), page.mask)
+        env_in = self._env(page)
+        if key not in self._chain_avals:
+            # shape metadata only — feeds lazy cost analysis without
+            # touching device data or the dispatch hot path
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                (env_in, page.mask),
+            )
+            self._chain_avals[key] = abstract
+        if self.profiler is not None:
+            self.profiler.note_dispatch(key)
+        env, mask, flags, n_live_dev = fn(env_in, page.mask)
         if out_map is not None:
             # the cached program speaks canonical names; translate its
             # outputs back for this call (the cached out_layout is
             # shared — never mutate it)
             out_layout, env = _rename_out(out_layout, env, out_map)
         return env, mask, flags, n_live_dev, out_layout
+
+    def chain_cost(self, key) -> dict | None:
+        """XLA cost model ({'flops', 'bytes_accessed'}) for one cached
+        chain program, computed lazily on first request. The extra
+        ``lower().compile()`` resolves through the persistent
+        compilation cache as a deserialize of the program the dispatch
+        path already built — never a second real compile. A failed
+        analysis caches as None so it is not retried per query."""
+        if key in self._chain_costs:
+            return self._chain_costs[key]
+        cost = None
+        # plain dict.get: a cost lookup is not a cache hit/miss event
+        # (CountingCache feeds trino_jit_cache_* counters tests pin)
+        hit = dict.get(self._jit_cache, key)
+        abstract = self._chain_avals.get(key)
+        if hit is not None and abstract is not None:
+            try:
+                fn = hit[0]
+                analysis = fn.lower(*abstract).compile().cost_analysis()
+                if isinstance(analysis, (list, tuple)):  # older jax
+                    analysis = analysis[0] if analysis else {}
+                if analysis:
+                    cost = {
+                        "flops": float(analysis.get("flops", 0.0)),
+                        "bytes_accessed": float(
+                            analysis.get("bytes accessed", 0.0)
+                        ),
+                    }
+            except Exception:
+                cost = None
+        self._chain_costs[key] = cost
+        return cost
 
     def _finalize_chain(self, chain, env, mask, n_live: int, out_layout):
         cols = [

@@ -184,6 +184,20 @@ class Coordinator:
                 if self.path == "/v1/queries":
                     self._send(200, coordinator.list_queries())
                     return
+                if self.path == "/v1/query":
+                    # live QueryInfo list (QueryResource analog): one
+                    # light row per known query
+                    self._send(200, coordinator.query_info_list())
+                    return
+                if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                    # full stage -> task -> operator tree, served live
+                    # while the query is still running
+                    info = coordinator.query_info(parts[2])
+                    if info is None:
+                        self._send(404, {"error": "query not found"})
+                    else:
+                        self._send(200, info)
+                    return
                 if (
                     len(parts) == 6
                     and parts[:3] == ["v1", "statement", "executing"]
@@ -337,9 +351,21 @@ class Coordinator:
                 try:
                     # cooperative cancellation: DELETE sets the event
                     # and the executor aborts at its next boundary
-                    result = self.runner.execute(
-                        sql, cancel_event=q.cancel_event
-                    )
+                    # the coordinator's id IS the runner's id: live
+                    # QueryInfo published under it joins QueryState
+                    # (tests substitute runners whose execute() has no
+                    # query_id parameter — probe before passing it)
+                    kwargs = {"cancel_event": q.cancel_event}
+                    try:
+                        import inspect
+
+                        if "query_id" in inspect.signature(
+                            self.runner.execute
+                        ).parameters:
+                            kwargs["query_id"] = q.query_id
+                    except (TypeError, ValueError):
+                        pass
+                    result = self.runner.execute(sql, **kwargs)
                     if q.cancelled or q.state == "FAILED":
                         q.state = "FAILED"
                     else:
@@ -385,6 +411,62 @@ class Coordinator:
             # resource-group condition variable — poke it so the cancel
             # takes effect now, not at the next poll tick
             self.resource_groups.wakeup()
+
+    def query_info_list(self) -> list[dict]:
+        """``GET /v1/query``: one light row per known query, joining
+        coordinator lifecycle state with the live registry's runtime
+        stats (rows, peak memory). Queries executed through a runner
+        directly (no QueryState) still appear from the registry."""
+        from trino_tpu import tracker
+
+        live = {r["query_id"]: r for r in tracker.QUERY_INFO.list()}
+        with self._lock:
+            snapshot = list(self._queries.values())
+        out = []
+        for q in snapshot:
+            r = live.pop(q.query_id, None) or {}
+            out.append({
+                "query_id": q.query_id,
+                "state": q.state,
+                "user": q.user,
+                "query": q.sql,
+                "elapsed_ms": round(
+                    ((q.finished_at or time.time()) - q.created_at)
+                    * 1e3, 3,
+                ),
+                "peak_memory_bytes": r.get("peak_memory_bytes", 0),
+                "rows": r.get("rows"),
+                "error": q.error,
+            })
+        out.extend(live.values())
+        return out
+
+    def query_info(self, qid: str) -> dict | None:
+        """``GET /v1/query/{id}``: the full stage → task → operator
+        JSON tree. Coordinator lifecycle state overrides the
+        registry's (it is authoritative for QUEUED/cancel races)."""
+        from trino_tpu import tracker
+
+        info = tracker.QUERY_INFO.get(qid)
+        q = self._queries.get(qid)
+        if info is None and q is None:
+            return None
+        if info is None:
+            info = {
+                "query_id": qid, "state": q.state, "user": q.user,
+                "sql": q.sql, "elapsed_ms": round(
+                    ((q.finished_at or time.time()) - q.created_at)
+                    * 1e3, 3,
+                ),
+                "peak_memory_bytes": 0, "rows": None,
+                "error": q.error, "stages": [],
+            }
+        elif q is not None:
+            info["state"] = q.state
+            info["user"] = q.user
+            if q.error:
+                info["error"] = q.error
+        return info
 
     def list_queries(self) -> list[dict]:
         with self._lock:

@@ -45,7 +45,7 @@ import uuid
 from collections import deque
 from dataclasses import dataclass
 
-from trino_tpu import fault, memory, telemetry
+from trino_tpu import fault, memory, profiler, telemetry, tracker
 from trino_tpu import session_properties as sp
 from trino_tpu.engine import (
     QueryResult,
@@ -255,6 +255,10 @@ class FleetRunner:
         #: current query id (stamped on stage-task requests so worker
         #: pools attribute reservations to the right query)
         self._query_id: str | None = None
+        #: externally-assigned id (the coordinator's) under which this
+        #: statement publishes live QueryInfo; attempt-local
+        #: ``_query_id`` values keep naming spool epochs
+        self._public_query_id: str | None = None
         #: per-attempt telemetry state (set by _execute_attempt)
         self._tracer = None
         self._stage_spans: dict[str, telemetry.Span] = {}
@@ -287,7 +291,9 @@ class FleetRunner:
 
     # ---- query entry -----------------------------------------------------
 
-    def execute(self, sql: str, cancel_event=None) -> QueryResult:
+    def execute(
+        self, sql: str, cancel_event=None, query_id: str | None = None,
+    ) -> QueryResult:
         stmt = parse_statement(sql)
         if isinstance(stmt, ast.Explain) and not stmt.analyze:
             # plan rendering only; the embedded planner shares the
@@ -297,6 +303,14 @@ class FleetRunner:
         explain_analyze = isinstance(stmt, ast.Explain)
         if explain_analyze:
             stmt = stmt.statement
+        # one public id per statement: query-level retries re-execute
+        # under fresh attempt/spool ids but publish live QueryInfo
+        # under this one (the id the coordinator hands out, when any)
+        public_qid = query_id or uuid.uuid4().hex[:12]
+        self._public_query_id = public_qid
+        tracker.QUERY_INFO.begin(
+            public_qid, sql=sql, user=self.session.user
+        )
         t0 = time.perf_counter()
         error = None
         result = None
@@ -310,6 +324,22 @@ class FleetRunner:
             raise
         finally:
             state = "FAILED" if error else "FINISHED"
+            tracker.QUERY_INFO.finish(
+                public_qid,
+                state=state,
+                rows=len(result.rows) if result else 0,
+                error=error,
+                peak_memory_bytes=(
+                    result.peak_memory_bytes if result else 0
+                ),
+            )
+            self._maybe_log_slow_query(
+                sql, (time.perf_counter() - t0) * 1e3, result, public_qid
+            )
+            if result is not None:
+                # post-hoc profile == the live tree, sealed
+                result._query_info = tracker.QUERY_INFO.get(public_qid)
+            self._public_query_id = None
             telemetry.QUERIES_TOTAL.inc(state=state)
             listeners = getattr(self.metadata, "event_listeners", ())
             if listeners:
@@ -355,6 +385,21 @@ class FleetRunner:
                     ),
                 ))
 
+    def _maybe_log_slow_query(
+        self, sql: str, elapsed_ms: float, result, query_id: str,
+    ) -> None:
+        from trino_tpu.events import maybe_log_slow_query
+
+        flat = [
+            row
+            for ts in (result.task_stats if result else [])
+            for row in ts.get("operator_stats") or []
+        ]
+        maybe_log_slow_query(
+            getattr(self.metadata, "event_listeners", ()),
+            self.session, query_id, sql, elapsed_ms, flat,
+        )
+
     def _render_fleet_analyze(self, res: QueryResult) -> QueryResult:
         """EXPLAIN ANALYZE rendering for distributed runs.
 
@@ -390,8 +435,43 @@ class FleetRunner:
                 f"Peak memory: {_fmt_bytes(res.peak_memory_bytes)} "
                 f"({per_node})"
             )
+        ops_by_stage: dict[str, dict] = {}
+        for ts in res.task_stats:
+            if ts.get("state") != "FINISHED":
+                continue
+            agg = ops_by_stage.setdefault(ts["stage_id"], {})
+            for row in ts.get("operator_stats") or []:
+                o = agg.setdefault(row.get("name", "?"), {
+                    "self_ms": 0.0, "rows_out": 0, "flops": 0.0,
+                    "bytes_accessed": 0.0,
+                })
+                o["self_ms"] += float(row.get("self_ms", 0.0) or 0)
+                o["rows_out"] += int(row.get("rows_out") or 0)
+                o["flops"] += float(row.get("flops", 0.0) or 0)
+                o["bytes_accessed"] += float(
+                    row.get("bytes_accessed", 0.0) or 0
+                )
         for st in stats:
             lines.append(_stage_stats_line(f"Stage {st['stage_id']}", st))
+            for name, o in sorted(
+                ops_by_stage.get(st["stage_id"], {}).items(),
+                key=lambda kv: kv[1]["self_ms"], reverse=True,
+            ):
+                line = (
+                    f"  {name}: {o['self_ms']:.1f} ms self, "
+                    f"out: {o['rows_out']} rows"
+                )
+                roof = profiler.roofline(
+                    o["flops"], o["bytes_accessed"], o["self_ms"]
+                )
+                if roof.get("achieved_gflops") is not None:
+                    line += (
+                        f", {roof['achieved_gflops']:.2f} GFLOP/s"
+                    )
+                    util = roof.get("roofline_utilization")
+                    if util is not None:
+                        line += f" ({util * 100:.1f}% of roofline)"
+                lines.append(line)
         plan = getattr(self, "_last_plan", None)
         if plan is not None:
             lines.extend(P.plan_tree_str(plan).splitlines())
@@ -1170,7 +1250,7 @@ class FleetRunner:
                     # per-task stats + worker-side span subtree ride on
                     # the FINISHED status response
                     tstats = state.get("stats") or {}
-                    self._task_stats.append({
+                    task_row = {
                         "query_id": self._query_id,
                         "stage_id": sid, "task_id": tid, "attempt": a,
                         "state": "FINISHED", "worker": w.uri,
@@ -1181,10 +1261,20 @@ class FleetRunner:
                         "peak_memory_bytes": tstats.get(
                             "peak_memory_bytes", 0
                         ),
+                        "operator_stats": profiler.attach_roofline(
+                            tstats.get("operator_stats") or []
+                        ),
                         "admission_wait_ms": sched.admission_wait_ms(
                             tid
                         ),
-                    })
+                    }
+                    self._task_stats.append(task_row)
+                    # live introspection: GET /v1/query/{id} serves
+                    # this tree while later stages are still running
+                    tracker.QUERY_INFO.update_task(
+                        self._public_query_id or self._query_id,
+                        task_row,
+                    )
                     if self._tracer is not None and state.get("spans"):
                         self._tracer.attach(state["spans"])
                     runtimes.setdefault(sid, []).append(

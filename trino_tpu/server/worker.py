@@ -409,6 +409,7 @@ class WorkerServer:
             rows_in = 0
             out_stats = {"rows": 0, "bytes": 0}
             peak_bytes = 0
+            op_stats: list = []
             try:
                 if req.get("fail"):
                     raise InjectedTaskFailure(
@@ -496,8 +497,18 @@ class WorkerServer:
                             qid
                         ).child(tkey)
                         ex.memory_ctx = task_ctx
+                        from trino_tpu.profiler import OperatorProfiler
+
+                        ex.profiler = prof = OperatorProfiler()
+                        from trino_tpu import jit_cache
+
                         try:
                             exec_sp = tspan.child("execute", "execution")
+                            # compile/deserialize hops to the
+                            # CompileService thread attach here, not
+                            # to a detached root (trace anchor is
+                            # read on THIS thread by the reroute)
+                            jit_cache.set_active_span(exec_sp)
                             if self.runner.mesh is not None:
                                 # fleet x mesh: the fragment runs SPMD
                                 # over this worker's device mesh
@@ -512,6 +523,12 @@ class WorkerServer:
                             else:
                                 page = ex.execute(plan)
                             exec_sp.finish()
+                            # seal operator records while the runner
+                            # lock is still held: cost resolution may
+                            # lower+compile through the persistent
+                            # cache, which is XLA work
+                            jit_cache.set_active_span(tspan)
+                            op_stats = prof.finish(ex)
                             # a cancelled speculative loser should not
                             # burn spool writes; a cancel arriving after
                             # this check commits anyway, which
@@ -537,6 +554,8 @@ class WorkerServer:
                                 write_sp.finish()
                                 write_sp.attrs.update(out_stats)
                         finally:
+                            jit_cache.set_active_span(None)
+                            ex.profiler = None
                             peak_bytes = task_ctx.peak_bytes
                             ex.cancel_event = None
                             ex.remote_pages = {}
@@ -546,6 +565,16 @@ class WorkerServer:
                     finally:
                         if inj is not None:
                             fault.deactivate()
+                # the root record's rows_out can be unknown when the
+                # final chain deferred its count sync — the spool
+                # write already resolved it
+                if op_stats and op_stats[0].get("rows_out") is None:
+                    op_stats[0]["rows_out"] = int(out_stats.get("rows", 0))
+                for row in op_stats:
+                    telemetry.OPERATOR_SELF_TIME.observe(
+                        row.get("self_ms", 0.0) / 1e3,
+                        operator=row.get("node_type", "?"),
+                    )
                 with self._lock:
                     if not task.cancel.is_set():
                         task.stats = {
@@ -556,6 +585,7 @@ class WorkerServer:
                                 (_time.perf_counter() - t_task) * 1e3
                             ),
                             "peak_memory_bytes": int(peak_bytes),
+                            "operator_stats": op_stats,
                         }
                         task.spans = tspan.finish().to_dict()
                         task.state = "FINISHED"

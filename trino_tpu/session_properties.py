@@ -338,6 +338,15 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "varchar", "PIPELINED",
             _one_of("stage_admission", {"BARRIER", "PIPELINED"}),
         ),
+        # ---- observability --------------------------------------------
+        _P(
+            "slow_query_log_threshold",
+            "Statements slower than this duration ('5s') emit one "
+            "structured slow-query JSON line (profile summary, top-3 "
+            "operators by self time) through the EventListener path; "
+            "0 = disabled",
+            "varchar", "0s", _duration("slow_query_log_threshold"),
+        ),
         # ---- test/failure injection (hidden) --------------------------
         _P(
             "task_delay_ms",

@@ -291,7 +291,9 @@ class _Metric:
         return []
 
     def header(self) -> List[str]:
-        return [f"# HELP {self.name} {self.help}",
+        # exposition format 0.0.4: HELP text escapes backslash+newline
+        help_esc = self.help.replace("\\", "\\\\").replace("\n", "\\n")
+        return [f"# HELP {self.name} {help_esc}",
                 f"# TYPE {self.name} {self.kind}"]
 
 
@@ -480,7 +482,17 @@ MEMORY_PEAK = REGISTRY.gauge(
 MEMORY_KILLS = REGISTRY.counter(
     "trino_memory_kills_total", "Queries killed by the cluster memory manager")
 RPC_LATENCY = REGISTRY.histogram(
-    "trino_rpc_latency_seconds", "Coordinator-side fleet RPC latency by op")
+    "trino_rpc_latency_seconds", "Coordinator-side fleet RPC latency by op",
+    # the poll path lives under 10ms on a local fleet — the default
+    # buckets put every sample in the first two and hide the tail
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 10.0))
+OPERATOR_SELF_TIME = REGISTRY.histogram(
+    "trino_operator_self_time_seconds",
+    "Per-operator self time on workers, by operator node type",
+    # operators span sub-ms (cached dispatch) to whole-query seconds
+    buckets=(0.0005, 0.002, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0, 15.0, 60.0))
 XLA_COMPILES = REGISTRY.counter(
     "trino_xla_compile_total", "XLA backend compilations observed via jax.monitoring")
 XLA_COMPILE_SECONDS = REGISTRY.counter(

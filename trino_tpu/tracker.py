@@ -26,7 +26,7 @@ import time
 
 __all__ = [
     "QueryDeadlineExceededError", "QueryRetriesExhaustedError",
-    "QueryTracker",
+    "QueryTracker", "QueryInfoRegistry", "QUERY_INFO",
 ]
 
 
@@ -122,3 +122,174 @@ class QueryTracker:
         wakeup = getattr(self.coordinator.resource_groups, "wakeup", None)
         if wakeup is not None:
             wakeup()
+
+
+class QueryInfoRegistry:
+    """Live QueryInfo trees: the registry behind ``GET /v1/query``.
+
+    The analog of the reference coordinator's QueryTracker-as-registry
+    role (MAIN/execution/QueryTracker.java holds the QueryInfo every
+    UI/API surface reads): runners push per-task operator stats as
+    FINISHED task-status responses arrive, so ``GET /v1/query/{id}``
+    serves the stage → task → operator tree *while later stages are
+    still running*. Finished queries stay visible for a retention
+    window (``min.query.expire-age`` analog), then sweep.
+
+    Thread-safe: the coordinator's HTTP threads read while runner
+    threads write.
+    """
+
+    def __init__(self, retention_s: float = 300.0,
+                 max_finished: int = 200):
+        self.retention_s = retention_s
+        self.max_finished = max_finished
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+
+    def _entry(self, query_id: str) -> dict:
+        e = self._entries.get(query_id)
+        if e is None:
+            e = self._entries[query_id] = {
+                "query_id": query_id,
+                "state": "RUNNING",
+                "user": None,
+                "sql": None,
+                "created_at": time.time(),
+                "finished_at": None,
+                "error": None,
+                "rows": None,
+                "peak_memory_bytes": 0,
+                #: (stage_id, task_id, attempt) -> task row (with
+                #: operator_stats); latest attempt wins per task
+                "tasks": {},
+            }
+        return e
+
+    def begin(self, query_id: str, sql: str | None = None,
+              user: str | None = None) -> None:
+        if not query_id:
+            return
+        with self._lock:
+            e = self._entry(query_id)
+            if sql is not None:
+                e["sql"] = sql
+            if user is not None:
+                e["user"] = user
+
+    def update_task(self, query_id: str, task_row: dict) -> None:
+        if not query_id:
+            return
+        with self._lock:
+            e = self._entry(query_id)
+            key = (
+                str(task_row.get("stage_id")),
+                str(task_row.get("task_id")),
+                int(task_row.get("attempt", 0) or 0),
+            )
+            e["tasks"][key] = task_row
+            e["peak_memory_bytes"] = max(
+                e["peak_memory_bytes"],
+                int(task_row.get("peak_memory_bytes", 0) or 0),
+            )
+
+    def finish(self, query_id: str, state: str, rows: int | None = None,
+               error: str | None = None,
+               peak_memory_bytes: int = 0,
+               operator_stats: list | None = None) -> None:
+        """Seal a query. ``operator_stats`` covers the local engine,
+        whose single-process execution reports one synthetic task."""
+        if not query_id:
+            return
+        with self._lock:
+            e = self._entry(query_id)
+            e["state"] = state
+            e["finished_at"] = time.time()
+            e["error"] = error
+            if rows is not None:
+                e["rows"] = int(rows)
+            e["peak_memory_bytes"] = max(
+                e["peak_memory_bytes"], int(peak_memory_bytes or 0)
+            )
+            if operator_stats and not e["tasks"]:
+                e["tasks"][("local", "local-0", 0)] = {
+                    "stage_id": "local", "task_id": "local-0",
+                    "attempt": 0, "state": state, "worker": "local",
+                    "operator_stats": operator_stats,
+                }
+            self._sweep_locked()
+
+    # -- read side ------------------------------------------------------
+
+    def _elapsed_ms(self, e: dict) -> float:
+        end = e["finished_at"] or time.time()
+        return (end - e["created_at"]) * 1e3
+
+    def list(self) -> list[dict]:
+        """Light rows for ``GET /v1/query`` / system.runtime.queries."""
+        with self._lock:
+            return [
+                {
+                    "query_id": e["query_id"],
+                    "state": e["state"],
+                    "user": e["user"],
+                    "elapsed_ms": round(self._elapsed_ms(e), 3),
+                    "peak_memory_bytes": e["peak_memory_bytes"],
+                    "rows": e["rows"],
+                    "error": e["error"],
+                }
+                for e in self._entries.values()
+            ]
+
+    def get(self, query_id: str) -> dict | None:
+        """Full stage → task → operator tree for one query."""
+        from trino_tpu.profiler import tree_from_stats
+
+        with self._lock:
+            e = self._entries.get(query_id)
+            if e is None:
+                return None
+            stages: dict[str, dict] = {}
+            for (sid, tid, att), row in sorted(e["tasks"].items()):
+                st = stages.setdefault(sid, {"stage_id": sid, "tasks": []})
+                task = {
+                    k: v for k, v in row.items()
+                    if k not in ("operator_stats", "query_id", "stage_id")
+                }
+                task["operators"] = tree_from_stats(
+                    row.get("operator_stats") or []
+                )
+                st["tasks"].append(task)
+            return {
+                "query_id": e["query_id"],
+                "state": e["state"],
+                "user": e["user"],
+                "sql": e["sql"],
+                "elapsed_ms": round(self._elapsed_ms(e), 3),
+                "peak_memory_bytes": e["peak_memory_bytes"],
+                "rows": e["rows"],
+                "error": e["error"],
+                "stages": list(stages.values()),
+            }
+
+    def _sweep_locked(self) -> None:
+        now = time.time()
+        finished = [
+            qid for qid, e in self._entries.items()
+            if e["finished_at"] is not None
+        ]
+        for qid in finished:
+            e = self._entries[qid]
+            if now - e["finished_at"] > self.retention_s:
+                del self._entries[qid]
+        finished = [
+            qid for qid in self._entries
+            if self._entries[qid]["finished_at"] is not None
+        ]
+        while len(finished) > self.max_finished:
+            del self._entries[finished.pop(0)]
+
+
+#: process-wide registry: the coordinator, the fleet runner, and the
+#: local engine all live in one coordinator process, so one registry
+#: serves every entry point (worker stats arrive via the poll channel)
+QUERY_INFO = QueryInfoRegistry()
