@@ -117,6 +117,14 @@ def main(argv=None) -> int:
         " fired and how many retries each tier absorbed",
     )
     ap.add_argument(
+        "--serving", action="store_true",
+        help="also run the multi-query serving benchmark: "
+        "BENCH_SERVING_CLIENTS (default 8) closed-loop clients drive "
+        "a TPC-H mix through one ServingRunner over a live 2-worker "
+        "fleet; records serving_qps and p50/p95/p99 latency next to "
+        "the 1-client sequential QPS over the same statements",
+    )
+    ap.add_argument(
         "--stage-admission", choices=["both", "BARRIER", "PIPELINED"],
         default=None,
         help="also run the fleet stage-admission A/B: TPC-H q3/q5/q9 "
@@ -481,6 +489,20 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
             chaos_mod.stop_workers(procs)
 
     if (
+        args.serving or _section_enabled("BENCH_SERVING", False)
+    ) and fits("serving", 240.0):
+        # multi-query serving (BENCH_r08): N closed-loop clients
+        # against ONE ServingRunner over a real 2-process fleet —
+        # admission through resource groups, worker slots dealt by the
+        # shared dispatcher, all RPC polling on the O(workers) reactor.
+        # The 1-client sequential pass over the same statement list is
+        # timed first so the concurrency win (overlapping one query's
+        # coordinator-side planning/result read with another's device
+        # execution) is auditable, not asserted. Ports 18970+ (bench
+        # chaos owns 18980+, stage-admission 18990+).
+        _serving_section(detail)
+
+    if (
         args.chaos or _section_enabled("BENCH_CHAOS", False)
     ) and fits("chaos_soak", 300.0):
         # robustness gauge, not a perf number: the full seeded soak
@@ -523,6 +545,85 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         detail["chaos_wall_s"] = round(chaos_wall, 1)
 
     return 0
+
+
+def _serving_section(detail) -> None:
+    import tempfile
+    import threading
+
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.dispatcher import ServingRunner
+    from trino_tpu.testing import chaos as chaos_mod
+
+    n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "8"))
+    per_client = int(os.environ.get("BENCH_SERVING_STATEMENTS", "4"))
+    # TPC-H tiny mix: scan+agg (q01), 3-way join (q03), filter+sum
+    # (q06) — the distributed-safe subset on every supported jax
+    mix = [QUERIES["q01"], QUERIES["q03"], QUERIES["q06"]]
+    procs, uris = chaos_mod.spawn_workers(2, base_port=18970)
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench-serving-") as spool:
+            serving = chaos_mod.make_serving(uris, spool)
+            try:
+                for sql in mix:  # warmup: compile + scan residency
+                    serving.execute(sql)
+                stmts = [
+                    mix[(c * per_client + i) % len(mix)]
+                    for c in range(n_clients)
+                    for i in range(per_client)
+                ]
+                # 1-client sequential floor over the SAME statements
+                t0 = time.perf_counter()
+                for sql in stmts:
+                    serving.execute(sql)
+                seq_s = time.perf_counter() - t0
+                # closed loop: each client runs its slice back-to-back
+                lat = []
+                lat_lock = threading.Lock()
+                errors = []
+
+                def client(cid: int):
+                    try:
+                        for i in range(per_client):
+                            sql = mix[(cid * per_client + i) % len(mix)]
+                            t = time.perf_counter()
+                            serving.execute(sql)
+                            dt = time.perf_counter() - t
+                            with lat_lock:
+                                lat.append(dt)
+                    except Exception as e:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+                threads = [
+                    threading.Thread(target=client, args=(c,))
+                    for c in range(n_clients)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall_s = time.perf_counter() - t0
+            finally:
+                serving.stop()
+        if errors:
+            detail["serving_errors"] = errors[:5]
+            return
+        lat.sort()
+
+        def pct(p: float) -> float:
+            return lat[min(int(round(p * (len(lat) - 1))), len(lat) - 1)]
+
+        detail["serving_clients"] = n_clients
+        detail["serving_statements"] = len(lat)
+        detail["serving_qps"] = round(len(lat) / wall_s, 2)
+        detail["serving_seq_qps"] = round(len(stmts) / seq_s, 2)
+        detail["serving_p50_ms"] = round(pct(0.50) * 1e3, 1)
+        detail["serving_p95_ms"] = round(pct(0.95) * 1e3, 1)
+        detail["serving_p99_ms"] = round(pct(0.99) * 1e3, 1)
+        detail["serving_wall_s"] = round(wall_s, 1)
+    finally:
+        chaos_mod.stop_workers(procs)
 
 
 if __name__ == "__main__":

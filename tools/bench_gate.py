@@ -40,7 +40,9 @@ _DEFAULT_BASELINE = os.path.join(
     os.path.dirname(_HERE), "BENCH_r04.json"
 )
 
-#: (key, higher_is_better) — dotted keys index into detail
+#: (key, higher_is_better) — dotted keys index into detail. The
+#: serving keys (BENCH_r08+) SKIP against older baselines that
+#: predate ``bench.py --serving`` — SKIP-not-fail is the contract.
 _RATE_KEYS = [
     ("value", True),
     ("vs_baseline", True),
@@ -48,6 +50,9 @@ _RATE_KEYS = [
     ("detail.q03_ms", False),
     ("detail.q18_ms", False),
     ("detail.join_agg_rows_per_sec_chip", True),
+    ("detail.serving_qps", True),
+    ("detail.serving_p95_ms", False),
+    ("detail.serving_p99_ms", False),
 ]
 
 #: compile-count keys: lower is better, absolute slack not a pure band

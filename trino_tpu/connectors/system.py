@@ -27,6 +27,8 @@ _QUERIES_SCHEMA = TableSchema("queries", [
     ("rows", T.BIGINT),
     ("user", T.VARCHAR),
     ("peak_memory_bytes", T.BIGINT),
+    ("resource_group", T.VARCHAR),
+    ("queued_time_ms", T.DOUBLE),
 ])
 
 _NODES_SCHEMA = TableSchema("nodes", [
@@ -116,6 +118,7 @@ class SystemConnector(Connector):
                 states = list(self.coordinator._queries.values())
             for q in states:
                 end = q.finished_at or time.time()
+                queued_end = q.started_at or q.finished_at or time.time()
                 r = live.get(q.query_id) or {}
                 out.append((
                     q.query_id, q.state, q.sql, q.error or "",
@@ -123,6 +126,8 @@ class SystemConnector(Connector):
                     len(q.result.rows) if q.result is not None else 0,
                     q.user,
                     int(r.get("peak_memory_bytes", 0)),
+                    q.resource_group,
+                    (queued_end - q.created_at) * 1e3,
                 ))
             return out
         # runner-direct statements (no coordinator) come from the
@@ -135,6 +140,8 @@ class SystemConnector(Connector):
                 int(r.get("rows") or 0),
                 r.get("user") or "",
                 int(r.get("peak_memory_bytes", 0)),
+                r.get("resource_group") or "",
+                float(r.get("queued_time_ms", 0.0)),
             ))
         return out
 

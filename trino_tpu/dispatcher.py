@@ -1,0 +1,651 @@
+"""Multi-query serving: a cluster-wide worker-slot pool with
+fair-share dispatch and one shared status-poll reactor.
+
+The analog of the reference's dispatch layer
+(MAIN/dispatcher/DispatchManager.java:146 feeding resource groups and
+QueryExecution): every PR so far ran one statement at a time through a
+FleetRunner that assumed it owned the fleet. This module makes the
+fleet a SHARED resource:
+
+- ``Dispatcher`` owns the worker slots. Stage tasks from every running
+  query request slots through one queue; grants are dealt
+  deficit-round-robin across resource groups in proportion to
+  ``ResourceGroup.weight``, so one heavy query (or group) cannot
+  starve the mix while a high-weight group still gets its share.
+- One poll reactor thread PER WORKER multiplexes task-status polls for
+  all in-flight attempts on that worker — coordinator RPC-polling
+  thread count is O(workers), not O(queries). The reactor also owns
+  hung-worker detection (consecutive-timeout eviction) and dead-worker
+  re-admission probing, which used to live inside each query's
+  dispatch loop. Queries read cached statuses; an attempt on a worker
+  declared dead surfaces as a synthetic ``LOST`` status.
+- ``ServingRunner`` is the QueryRunner-compatible facade a Coordinator
+  (or N embedded threads) drives concurrently: per statement it builds
+  a lightweight FleetRunner wired to the shared workers, dispatcher
+  and ClusterMemoryManager, so the memory-kill policy picks its victim
+  among ALL live queries (the reference's low-memory killer), not just
+  the one that noticed the breach.
+
+Determinism note for chaos runs: reactor polls are free-running, so
+call-count-based (``nth``) fault schedules are NOT stable under
+serving concurrency; seeded ``times``/``prob`` schedules hash
+(seed, site, tag, attempt) and stay order-independent — concurrent
+chaos tests use those.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+
+from trino_tpu import memory, telemetry
+
+__all__ = ["Dispatcher", "ServingRunner"]
+
+#: synthetic status the reactor publishes for attempts stranded on a
+#: worker it declared dead — the per-query loop treats it exactly like
+#: the legacy "poll raised, worker evicted" path
+LOST = {"state": "LOST", "error": "worker died"}
+
+
+@dataclass
+class _SlotTicket:
+    """One outstanding request for one worker slot."""
+
+    handle: "QueryHandle"
+    enqueued_at: float
+
+
+@dataclass
+class Grant:
+    """A slot grant: the query may post exactly one stage task to
+    ``worker``; it must then bind() the posted attempt or release()."""
+
+    worker: object  # FleetWorker
+    ticket: _SlotTicket
+
+
+@dataclass
+class QueryHandle:
+    """Per-query dispatch registration (the per-query admission queue
+    on top of the group-level fair share)."""
+
+    query_id: str
+    group: str
+    weight: int = 1
+    #: grants dealt to this query, not yet consumed by its loop
+    grants: deque = field(default_factory=deque)
+    #: outstanding tickets of this handle still in the fair queue
+    pending: int = 0
+    #: set whenever something this query is waiting on happened (a
+    #: grant was dealt, a tracked attempt went terminal/LOST); the
+    #: query loop waits on this instead of fixed-cadence polling, so
+    #: N queries blocked on 2 slots cost ~no CPU
+    wake: threading.Event = field(default_factory=threading.Event)
+
+
+class Dispatcher:
+    """Shared fleet slot pool + fair-share grant queue + poll reactor.
+
+    Thread model: all bookkeeping happens under one lock; the grant
+    pump runs inline on the events that can unblock a grant (request,
+    release, readmission) — no dedicated pump thread. Reactor threads
+    (one per worker, named ``dispatch-poll-*``) only touch the status
+    cache, worker liveness and slot releases for LOST attempts.
+    """
+
+    def __init__(
+        self,
+        workers,
+        slots_per_worker: int = 1,
+        poll_s: float = 0.02,
+        rpc_timeout_s: float = 15.0,
+        max_poll_fails: int = 4,
+        readmit_initial_s: float = 0.5,
+        readmit_max_s: float = 8.0,
+        readmit_probe_timeout_s: float = 1.0,
+        on_pool=None,
+    ):
+        self.workers = list(workers)
+        #: one slot per worker by default: workers serialize all
+        #: XLA/device work under their runner lock, so extra slots buy
+        #: queueing on the worker, not parallelism
+        self.slots_per_worker = max(int(slots_per_worker), 1)
+        self.poll_s = poll_s
+        self.rpc_timeout_s = rpc_timeout_s
+        self.max_poll_fails = max_poll_fails
+        self.readmit_initial_s = readmit_initial_s
+        self.readmit_max_s = readmit_max_s
+        self.readmit_probe_timeout_s = readmit_probe_timeout_s
+        #: callback(worker_uri, pool_snapshot) for every status poll
+        #: that carried a memory-pool snapshot (the heartbeat surface)
+        self.on_pool = on_pool
+        self._lock = threading.Lock()
+        #: worker uri -> slots in use
+        self._in_use: dict[str, int] = {u.uri: 0 for u in self.workers}
+        #: group -> FIFO of slot tickets (fair share happens BETWEEN
+        #: groups; within a group, requests stay FIFO — per-query
+        #: interleave comes from each query keeping only as many
+        #: tickets as it has dispatchable tasks)
+        self._queues: dict[str, deque] = {}
+        self._deficit: dict[str, float] = {}
+        self._weights: dict[str, int] = {}
+        self._rr: deque[str] = deque()
+        #: (task_id, attempt) -> worker uri, for attempts being polled
+        self._tracked: dict[tuple[str, int], str] = {}
+        #: (task_id, attempt) -> owning QueryHandle, so an abnormally
+        #: unwinding query's pinned slots can be swept at unregister
+        self._owner: dict[tuple[str, int], QueryHandle] = {}
+        #: attempts per worker still needing polls (terminal statuses
+        #: drop out so the reactor never re-polls a finished task)
+        self._active_by_worker: dict[str, set] = {
+            u.uri: set() for u in self.workers
+        }
+        #: (task_id, attempt) -> latest status dict
+        self._status: dict[tuple[str, int], dict] = {}
+        self._stop = threading.Event()
+        self._threads: dict[str, threading.Thread] = {}
+        for w in self.workers:
+            t = threading.Thread(
+                target=self._worker_loop, args=(w,),
+                name=f"dispatch-poll-{w.uri.split('//')[-1]}",
+                daemon=True,
+            )
+            self._threads[w.uri] = t
+            t.start()
+
+    # ---- query registration ----------------------------------------------
+
+    def register_query(
+        self, query_id: str, group: str = "global", weight: int = 1
+    ) -> QueryHandle:
+        h = QueryHandle(query_id=query_id, group=group, weight=weight)
+        with self._lock:
+            if group not in self._queues:
+                self._queues[group] = deque()
+                self._deficit[group] = 0.0
+                self._rr.append(group)
+            self._weights[group] = max(int(weight), 1)
+        return h
+
+    def unregister_query(self, h: QueryHandle) -> None:
+        """Drop the query's pending tickets and return any unconsumed
+        grants; tracked attempts are finished by the query loop itself
+        — any left over (abnormal unwind) are swept here so their
+        slots return to the pool."""
+        with self._lock:
+            q = self._queues.get(h.group)
+            if q is not None:
+                kept = deque(t for t in q if t.handle is not h)
+                self._queues[h.group] = kept
+            h.pending = 0
+            while h.grants:
+                g = h.grants.popleft()
+                self._release_locked(g.worker.uri)
+            for key, owner in list(self._owner.items()):
+                if owner is not h:
+                    continue
+                del self._owner[key]
+                uri = self._tracked.pop(key, None)
+                self._status.pop(key, None)
+                if uri is not None:
+                    self._active_by_worker[uri].discard(key)
+                    self._release_locked(uri)
+            self._publish_depth_locked()
+            self._pump_locked()
+
+    # ---- slot requests / grants ------------------------------------------
+
+    def want(self, h: QueryHandle, n: int) -> None:
+        """Declare that the query currently has ``n`` dispatchable
+        tasks: tickets are topped up (or trimmed) so outstanding
+        requests + unconsumed grants equals ``n``. Called every loop
+        iteration, so a task leaving backoff raises the ask and a
+        completed stage lowers it."""
+        now = time.monotonic()
+        with self._lock:
+            have = h.pending + len(h.grants)
+            q = self._queues[h.group]
+            if n > have:
+                for _ in range(n - have):
+                    q.append(_SlotTicket(handle=h, enqueued_at=now))
+                    h.pending += 1
+            elif n < have and h.pending:
+                # trim newest-first: the oldest ticket keeps its queue
+                # position (and its slot-wait clock)
+                drop = min(have - n, h.pending)
+                kept: deque = deque()
+                while q and drop:
+                    t = q.pop()
+                    if t.handle is h:
+                        h.pending -= 1
+                        drop -= 1
+                    else:
+                        kept.appendleft(t)
+                q.extend(kept)
+            self._publish_depth_locked()
+            self._pump_locked()
+
+    def take_grants(self, h: QueryHandle) -> list[Grant]:
+        with self._lock:
+            out = list(h.grants)
+            h.grants.clear()
+        return out
+
+    def release_grant(self, g: Grant) -> None:
+        with self._lock:
+            self._release_locked(g.worker.uri)
+            self._pump_locked()
+
+    def bind(self, g: Grant, task_id: str, attempt: int) -> None:
+        """The grant's slot now belongs to a posted attempt: the
+        reactor polls it until a terminal status, and finish()
+        releases the slot."""
+        key = (task_id, attempt)
+        with self._lock:
+            self._tracked[key] = g.worker.uri
+            self._active_by_worker[g.worker.uri].add(key)
+            if g.ticket.handle is not None:
+                self._owner[key] = g.ticket.handle
+
+    def try_grab_idle(self, exclude=None, handle=None) -> Grant | None:
+        """Immediate slot grab bypassing the fair queue — used for
+        speculative hedges, which are opportunistic by design: they
+        only ever consume capacity nobody queued for. ``handle``
+        attributes the bound attempt for unregister-time sweeping."""
+        with self._lock:
+            for w in self.workers:
+                if w is exclude or not w.alive or w.draining:
+                    continue
+                if self._in_use[w.uri] >= self.slots_per_worker:
+                    continue
+                self._in_use[w.uri] += 1
+                return Grant(
+                    worker=w,
+                    ticket=_SlotTicket(
+                        handle=handle, enqueued_at=time.monotonic()
+                    ),
+                )
+        return None
+
+    def finish(self, task_id: str, attempt: int) -> None:
+        """Attempt reached a terminal state (or its post failed after
+        bind): stop polling, drop the cached status, free the slot.
+        Idempotent — LOST sweeps and loser-cancels may race it."""
+        key = (task_id, attempt)
+        with self._lock:
+            uri = self._tracked.pop(key, None)
+            self._status.pop(key, None)
+            self._owner.pop(key, None)
+            if uri is None:
+                return
+            self._active_by_worker[uri].discard(key)
+            self._release_locked(uri)
+            self._pump_locked()
+
+    def status(self, task_id: str, attempt: int) -> dict | None:
+        return self._status.get((task_id, attempt))
+
+    def mark_dead(self, w) -> None:
+        """A query's POST saw this worker die; evict it and strand its
+        tracked attempts as LOST (same as a reactor-observed death)."""
+        with self._lock:
+            self._mark_dead_locked(w)
+
+    def poll_thread_count(self) -> int:
+        """Live RPC-poll reactor threads — the O(workers) invariant
+        tests assert against."""
+        return sum(1 for t in self._threads.values() if t.is_alive())
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads.values():
+            t.join(timeout=5)
+
+    # ---- internals (lock held) -------------------------------------------
+
+    def _release_locked(self, uri: str) -> None:
+        self._in_use[uri] = max(self._in_use[uri] - 1, 0)
+
+    def _publish_depth_locked(self) -> None:
+        for g, q in self._queues.items():
+            telemetry.DISPATCH_QUEUE_DEPTH.set(len(q), group=g)
+
+    def _free_worker_locked(self):
+        for w in self.workers:
+            if not w.alive or w.draining:
+                continue
+            if self._in_use[w.uri] < self.slots_per_worker:
+                return w
+        return None
+
+    def _pump_locked(self) -> None:
+        """Match free slots to queued tickets, fair-share order."""
+        while True:
+            w = self._free_worker_locked()
+            if w is None:
+                return
+            t = self._next_ticket_locked()
+            if t is None:
+                return
+            self._in_use[w.uri] += 1
+            t.handle.pending -= 1
+            t.handle.grants.append(Grant(worker=w, ticket=t))
+            t.handle.wake.set()
+            telemetry.SLOT_WAIT.observe(
+                max(time.monotonic() - t.enqueued_at, 0.0)
+            )
+            self._publish_depth_locked()
+
+    def _next_ticket_locked(self) -> _SlotTicket | None:
+        """Deficit round-robin over groups, cost 1 per grant: a group
+        is dealt ``weight`` grants per round while backlogged, but
+        every backlogged group is visited each round — weights shape
+        shares, they never starve."""
+        if not any(self._queues[g] for g in self._rr):
+            return None
+        for _ in range(2 * len(self._rr) + 1):
+            g = self._rr[0]
+            q = self._queues[g]
+            if not q:
+                # no banking while idle: an empty group's unused
+                # deficit does not entitle it to a later burst
+                self._deficit[g] = 0.0
+                self._rr.rotate(-1)
+                continue
+            if self._deficit[g] < 1.0:
+                self._deficit[g] += self._weights.get(g, 1)
+            self._deficit[g] -= 1.0
+            t = q.popleft()
+            if self._deficit[g] < 1.0 or not q:
+                self._rr.rotate(-1)
+            return t
+        return None
+
+    def _mark_dead_locked(self, w) -> None:
+        if not w.alive:
+            return
+        w.alive = False
+        w.fails = 0
+        # strand every attempt the dead worker held: LOST statuses let
+        # each owning query run its own worker-died retry path, and
+        # the slots those attempts pinned come back to the pool
+        for key in list(self._active_by_worker[w.uri]):
+            self._active_by_worker[w.uri].discard(key)
+            self._status[key] = dict(LOST)
+            if self._tracked.pop(key, None) is not None:
+                self._release_locked(w.uri)
+            owner = self._owner.get(key)
+            if owner is not None:
+                owner.wake.set()
+        self._pump_locked()
+
+    # ---- reactor ---------------------------------------------------------
+
+    def _worker_loop(self, w) -> None:
+        """One thread per worker: poll every active attempt on it,
+        publish statuses, count consecutive failures toward eviction,
+        probe for re-admission while dead."""
+        probe_delay = self.readmit_initial_s
+        next_probe = 0.0
+        while not self._stop.is_set():
+            if not w.alive:
+                now = time.monotonic()
+                if now < next_probe:
+                    self._stop.wait(
+                        min(self.poll_s, next_probe - now)
+                    )
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                        f"{w.uri}/v1/info",
+                        timeout=self.readmit_probe_timeout_s,
+                    ) as r:
+                        info = json.loads(r.read())
+                except Exception:
+                    probe_delay = min(
+                        probe_delay * 2.0, self.readmit_max_s
+                    )
+                    next_probe = time.monotonic() + probe_delay
+                    continue
+                with self._lock:
+                    w.alive = True
+                    w.fails = 0
+                    w.draining = info.get("state") != "ACTIVE"
+                    probe_delay = self.readmit_initial_s
+                    next_probe = 0.0
+                    telemetry.WORKERS_READMITTED.inc()
+                    self._pump_locked()
+                continue
+            with self._lock:
+                keys = list(self._active_by_worker[w.uri])
+            if not keys:
+                self._stop.wait(self.poll_s)
+                continue
+            for key in keys:
+                if self._stop.is_set() or not w.alive:
+                    break
+                tid, attempt = key
+                t_rpc = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(
+                        f"{w.uri}/v1/stagetask/{tid}.{attempt}",
+                        timeout=self.rpc_timeout_s,
+                    ) as resp:
+                        st = json.loads(resp.read())
+                except Exception as e:
+                    refused = isinstance(
+                        getattr(e, "reason", None),
+                        ConnectionRefusedError,
+                    ) or isinstance(e, ConnectionRefusedError)
+                    with self._lock:
+                        if key not in self._tracked:
+                            continue  # finished under us
+                        w.fails += 1
+                        if refused or w.fails >= self.max_poll_fails:
+                            self._mark_dead_locked(w)
+                    continue
+                finally:
+                    telemetry.RPC_LATENCY.observe(
+                        time.perf_counter() - t_rpc, op="poll"
+                    )
+                w.fails = 0
+                if self.on_pool is not None and st.get("pool"):
+                    try:
+                        self.on_pool(w.uri, st.get("pool"))
+                    except Exception:
+                        pass
+                with self._lock:
+                    if key not in self._tracked:
+                        continue
+                    self._status[key] = st
+                    if st.get("state") in (
+                        "FINISHED", "FAILED", "CANCELED"
+                    ):
+                        # terminal: stop polling; the slot stays held
+                        # until the owning query consumes the status
+                        # and calls finish()
+                        self._active_by_worker[w.uri].discard(key)
+                        owner = self._owner.get(key)
+                        if owner is not None:
+                            owner.wake.set()
+            self._stop.wait(self.poll_s)
+
+
+class ServingRunner:
+    """QueryRunner-compatible facade serving MANY statements at once
+    over one shared fleet (the DispatchManager -> QueryExecution layer
+    collapsed to a class): each execute() builds a lightweight
+    per-query FleetRunner wired to the shared worker list, Dispatcher
+    and ClusterMemoryManager. Drop it in as ``Coordinator(runner=...)``
+    and the existing thread-per-query submit path becomes genuinely
+    concurrent — those threads are lifecycle state machines; all RPC
+    polling stays on the dispatcher's O(workers) reactor."""
+
+    def __init__(
+        self,
+        worker_uris,
+        metadata,
+        session,
+        spool_root,
+        n_partitions: int = 4,
+        resource_groups=None,
+        slots_per_worker: int = 1,
+        **fleet_kwargs,
+    ):
+        from trino_tpu.server.fleet import FleetRunner, FleetWorker
+        from trino_tpu.server.resource_groups import (
+            ResourceGroup,
+            ResourceGroupManager,
+        )
+
+        self.metadata = metadata
+        self.session = session
+        self.spool_root = spool_root
+        self.n_partitions = n_partitions
+        self._fleet_kwargs = dict(fleet_kwargs)
+        self.workers = [FleetWorker(u.rstrip("/")) for u in worker_uris]
+        #: shared admission/fair-share config; a Coordinator built on
+        #: this runner adopts the same manager so admission counts and
+        #: slot-level weights come from one place. The serving default
+        #: bounds concurrently-RUNNING statements at 2x the worker
+        #: count: enough live queries to keep every slot pipelined
+        #: (one running + one next-up per worker) while the rest park
+        #: on the admission queue at ~no cost — unbounded admission
+        #: just multiplies runnable coordinator threads fighting for
+        #: the same cores
+        self.resource_groups = resource_groups or ResourceGroupManager(
+            groups=[ResourceGroup(
+                "global", max_running=max(2 * len(self.workers), 2)
+            )]
+        )
+        self.cluster_memory = memory.ClusterMemoryManager()
+        self.dispatcher = Dispatcher(
+            self.workers,
+            slots_per_worker=slots_per_worker,
+            poll_s=fleet_kwargs.get("poll_s", 0.02),
+            rpc_timeout_s=fleet_kwargs.get("rpc_timeout_s", 15.0),
+            max_poll_fails=fleet_kwargs.get("max_poll_fails", 4),
+            readmit_initial_s=fleet_kwargs.get("readmit_initial_s", 0.5),
+            readmit_max_s=fleet_kwargs.get("readmit_max_s", 8.0),
+            readmit_probe_timeout_s=fleet_kwargs.get(
+                "readmit_probe_timeout_s", 1.0
+            ),
+            on_pool=self.cluster_memory.observe,
+        )
+        #: probe each worker's device count ONCE; per-query runners
+        #: reuse it instead of re-probing per statement
+        self.worker_devices = {
+            w.uri: FleetRunner._probe_devices(w.uri)
+            for w in self.workers
+        }
+        self._lock = threading.Lock()
+        #: public query id -> its live per-query FleetRunner
+        self._active: dict[str, "FleetRunner"] = {}
+        self.mesh = None  # duck-typing parity with QueryRunner
+
+    # -- per-query machinery ------------------------------------------------
+
+    def _make_runner(self, group) -> object:
+        from trino_tpu.server.fleet import FleetRunner
+
+        return FleetRunner(
+            [w.uri for w in self.workers],
+            self.metadata,
+            self.session,
+            self.spool_root,
+            n_partitions=self.n_partitions,
+            dispatcher=self.dispatcher,
+            workers=self.workers,
+            worker_devices=self.worker_devices,
+            cluster_memory=self.cluster_memory,
+            serving=self,
+            resource_group=group.name,
+            group_weight=group.weight,
+            **self._fleet_kwargs,
+        )
+
+    def execute(
+        self,
+        sql: str,
+        cancel_event=None,
+        query_id: str | None = None,
+        user: str | None = None,
+        inject_failures=None,
+        admitted: bool = False,
+    ):
+        """Run one statement through the shared fleet. ``admitted``
+        marks a caller (the Coordinator) that already holds a
+        running slot in the SAME adopted group manager; embedded
+        callers gate here — FIFO admission within the selected group,
+        blocking while the group is at ``max_running``."""
+        group = self.resource_groups.select(user or self.session.user)
+        pub = query_id or uuid.uuid4().hex[:12]
+        if not admitted:
+            direct = self.resource_groups.enqueue(group, pub)
+            ok = self.resource_groups.acquire(
+                group, pub,
+                cancelled=(
+                    cancel_event.is_set if cancel_event is not None
+                    else lambda: False
+                ),
+                admitted=direct,
+            )
+            if not ok:
+                raise RuntimeError(
+                    f"query {pub} cancelled while queued for "
+                    f"resource group {group.name!r}"
+                )
+        fr = self._make_runner(group)
+        if inject_failures:
+            fr.inject_failures = set(inject_failures)
+        with self._lock:
+            self._active[pub] = fr
+        try:
+            return fr.execute(
+                sql, cancel_event=cancel_event, query_id=pub
+            )
+        finally:
+            with self._lock:
+                self._active.pop(pub, None)
+            if not admitted:
+                self.resource_groups.release(group)
+
+    def running_queries(self) -> list[str]:
+        with self._lock:
+            return list(self._active)
+
+    # -- cluster memory governance across queries ---------------------------
+
+    def enforce_memory(self, cap_bytes: int, my_attempt_qid: str) -> None:
+        """Called from each query's dispatch loop: the kill victim is
+        picked among ALL live queries; when it is someone else, a kill
+        is requested on that query's loop and the caller proceeds."""
+        if not cap_bytes:
+            return
+        with self._lock:
+            running = {
+                fr._query_id: fr
+                for fr in self._active.values()
+                if fr._query_id
+            }
+        picked = self.cluster_memory.pick_victim(
+            cap_bytes, set(running)
+        )
+        if picked is None:
+            return
+        qid, msg = picked
+        fr = running.get(qid)
+        if fr is None or qid == my_attempt_qid:
+            telemetry.MEMORY_KILLS.inc()
+            raise memory.ExceededMemoryLimitError(msg)
+        if fr.request_kill(msg):
+            telemetry.MEMORY_KILLS.inc()
+
+    def stop(self) -> None:
+        self.dispatcher.stop()

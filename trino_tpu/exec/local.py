@@ -98,8 +98,6 @@ class LocalExecutor:
         #: structural key -> (jitted fn, host metadata); hit/miss rates
         #: surface as trino_jit_cache_{hits,misses}_total{cache="local"}
         self._jit_cache: dict = telemetry.CountingCache("local")
-        #: (catalog, schema, table) -> {column name: Column}; "" -> mask
-        self._scan_cache: dict = {}
         #: dynamic-filter effectiveness log (tests + EXPLAIN ANALYZE):
         #: [{rows_in, rows_kept, pairs}] per join probe this executor ran
         self.df_log: list[dict] = []
@@ -179,10 +177,20 @@ class LocalExecutor:
     def invalidate_scan(self, catalog: str, schema: str, table: str):
         """Drop cached device pages for a table (called after writes —
         the reference's memory connector versions table handles the
-        same way). Learned statistics (filter selectivities, group-by
-        capacities) are dropped with it: they were observed against the
-        pre-write data and would otherwise persist stale forever."""
-        self._scan_cache.pop((catalog, schema, table), None)
+        same way). Pages live in the process-wide shared cache, so a
+        write through this executor also invalidates every concurrent
+        reader of the same connector. Learned statistics (filter
+        selectivities, group-by capacities) are dropped with it: they
+        were observed against the pre-write data and would otherwise
+        persist stale forever."""
+        from trino_tpu.exec import scan_cache
+
+        try:
+            connector = self.metadata.connector(catalog)
+        except KeyError:
+            connector = None
+        if connector is not None:
+            scan_cache.SHARED.invalidate(connector, schema, table)
         for k in [
             k for k in self._jit_cache
             if isinstance(k, tuple) and k and k[0] in ("selectivity", "caps")
@@ -768,9 +776,13 @@ class LocalExecutor:
         )
 
     def _layout_sig(self, page: Page) -> tuple:
+        # dictionary identity is its CONTENT fingerprint, not id():
+        # spool-read pages rebuild equal dictionaries per statement,
+        # and id-keyed programs would never be shared across them
         return tuple(
             (
-                n, repr(c.type), id(c.dictionary),
+                n, repr(c.type),
+                None if c.dictionary is None else c.dictionary.fingerprint,
                 None if c.hash_pool is None else c.hash_pool.token,
                 None if c.array_pool is None else c.array_pool.token,
                 c.valid is not None,
@@ -815,11 +827,16 @@ class LocalExecutor:
             # domain-pruned scans bypass the device cache (the pruned
             # row set is filter-specific, not the table)
             return self._scan_pruned(node, connector)
-        key = (node.catalog, node.schema, node.table)
-        if not self.metadata.connector(node.catalog).cacheable:
+        if not connector.cacheable:
             cache = {}  # live views (system tables) re-scan per query
         else:
-            cache = self._scan_cache.setdefault(key, {})
+            # process-wide shared pages: concurrent queries (and other
+            # executors over the same connector) reuse one resident copy
+            from trino_tpu.exec import scan_cache
+
+            cache = scan_cache.SHARED.table(
+                connector, node.schema, node.table
+            )
         hashed_syms = set(node.hash_varchar or [])
         # hash-coded and dictionary-coded variants of a column cache
         # under distinct keys (a symbol's encoding is plan-dependent)

@@ -1,0 +1,95 @@
+"""Process-wide device-resident scan-page cache.
+
+The serving layer runs many concurrent queries in one process, and the
+hot TPC tables they scan are identical. Historically each executor
+owned its own ``_scan_cache`` dict, so every executor paid its own
+host->HBM transfer for the same table — fine when one long-lived
+executor served every statement, wasteful the moment several engines
+coexist (a coordinator's embedded runner, per-group fleet planners,
+test fixtures). This module hoists that storage to a single
+process-wide cache, the analog of the reference's worker-shared memory
+connector pages: scanned device pages are keyed by the *connector
+instance* that produced them, so any executor scanning the same
+connector reuses the resident pages.
+
+Identity keying is the isolation contract: two TpchConnector instances
+with independently mutated tables (different test fixtures, different
+catalogs) never share entries because the connector object itself is
+the key. A ``WeakKeyDictionary`` makes the connector's lifetime the
+cache's lifetime — dropping the last metadata reference frees its
+device pages without an explicit close hook.
+
+DML invalidation routes here too: a write through ANY executor drops
+the shared entry, so a concurrent reader re-scans instead of serving
+pages observed before the write.
+
+Hit/miss traffic surfaces as ``trino_scan_cache_{hits,misses}_total``.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+__all__ = ["ScanPageCache", "SHARED"]
+
+
+class ScanPageCache:
+    """connector instance -> (schema, table) -> per-table page dict.
+
+    The per-table dict is the same shape executors always used:
+    column-cache-key -> device Column, ``""`` -> validity mask,
+    ``"#rows"`` -> row count. Callers mutate it in place under the
+    engine's execution serialization; this class only guards the
+    *map* structure with its own lock so concurrent executors can
+    resolve tables without racing the weak map.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_connector: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+
+    def table(self, connector, schema: str, table: str) -> dict:
+        """The live page dict for one table (created empty on first
+        use). Records a hit when the table is already device-resident
+        (mask present — columns may still be added lazily), a miss
+        when this call created the entry."""
+        from trino_tpu import telemetry
+
+        with self._lock:
+            tables = self._by_connector.get(connector)
+            if tables is None:
+                tables = {}
+                self._by_connector[connector] = tables
+            cache = tables.get((schema, table))
+            if cache is not None and "" in cache:
+                telemetry.SCAN_CACHE_HITS.inc(table=table)
+            else:
+                telemetry.SCAN_CACHE_MISSES.inc(table=table)
+            if cache is None:
+                cache = tables[(schema, table)] = {}
+            return cache
+
+    def invalidate(self, connector, schema: str, table: str) -> None:
+        """Drop one table's pages (after DML through any executor)."""
+        with self._lock:
+            tables = self._by_connector.get(connector)
+            if tables is not None:
+                tables.pop((schema, table), None)
+
+    def resident_tables(self, connector) -> list[tuple[str, str]]:
+        """(schema, table) pairs currently device-resident for one
+        connector (observability/tests)."""
+        with self._lock:
+            tables = self._by_connector.get(connector) or {}
+            return [k for k, v in tables.items() if "" in v]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_connector.clear()
+
+
+#: the process-wide cache every LocalExecutor scans through
+SHARED = ScanPageCache()

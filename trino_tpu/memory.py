@@ -281,6 +281,39 @@ class ClusterMemoryManager:
                     )
         return totals
 
+    def pick_victim(
+        self, cap_bytes: int, running=None
+    ) -> tuple[str, str] | None:
+        """Low-memory kill policy, selection only: among ``running``
+        queries (all observed ones when None), find the query with the
+        LARGEST cluster-total reservation; return ``(query_id, error
+        message with per-worker attribution)`` when it exceeds the
+        cap, else None. Selection is separated from the raise so a
+        multi-query serving layer can kill a victim OTHER than the
+        query whose dispatch loop noticed the breach — the reference's
+        ClusterMemoryManager kills the biggest query on the cluster,
+        not necessarily the one that tripped the check."""
+        if not cap_bytes:
+            return None
+        totals = self.query_totals()
+        if running is not None:
+            totals = {q: t for q, t in totals.items() if q in running}
+        if not totals:
+            return None
+        victim = max(totals, key=lambda q: totals[q])
+        if totals[victim] <= cap_bytes:
+            return None
+        per = self.per_worker(victim)
+        attribution = ", ".join(
+            f"{node}={format_bytes(b)}" for node, b in sorted(per.items())
+        )
+        return victim, (
+            f"Query {victim} killed by the cluster memory manager: "
+            f"total reservation {format_bytes(totals[victim])} across "
+            f"{len(per)} worker(s) exceeds query_max_memory "
+            f"{format_bytes(cap_bytes)} ({attribution})"
+        )
+
     def enforce(self, cap_bytes: int, running=None) -> None:
         """Cluster-wide ``query_max_memory`` + low-memory kill policy:
         when any query's cluster-total reservation exceeds the cap,
@@ -289,27 +322,11 @@ class ClusterMemoryManager:
         query ids) restricts the kill candidates: worker pools retain
         finished queries' peaks for observability, and a finished
         query cannot be killed."""
-        if not cap_bytes:
+        picked = self.pick_victim(cap_bytes, running)
+        if picked is None:
             return
-        totals = self.query_totals()
-        if running is not None:
-            totals = {q: t for q, t in totals.items() if q in running}
-        if not totals:
-            return
-        victim = max(totals, key=lambda q: totals[q])
-        if totals[victim] <= cap_bytes:
-            return
-        per = self.per_worker(victim)
-        attribution = ", ".join(
-            f"{node}={format_bytes(b)}" for node, b in sorted(per.items())
-        )
         telemetry.MEMORY_KILLS.inc()
-        raise ExceededMemoryLimitError(
-            f"Query {victim} killed by the cluster memory manager: "
-            f"total reservation {format_bytes(totals[victim])} across "
-            f"{len(per)} worker(s) exceeds query_max_memory "
-            f"{format_bytes(cap_bytes)} ({attribution})"
-        )
+        raise ExceededMemoryLimitError(picked[1])
 
 
 def validate_session_limits(session) -> None:

@@ -93,12 +93,32 @@ class StringDictionary:
     VARCHAR run entirely on device codes.
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_fp")
 
     def __init__(self, values: np.ndarray):
         # values must be sorted & unique (callers use from_strings)
         self.values = values
         self._index: dict[str, int] | None = None
+        self._fp: bytes | None = None
+
+    @property
+    def fingerprint(self) -> bytes:
+        """Content digest — the cache identity for compiled programs.
+        Programs bake dictionary-dependent constants (encoded literal
+        codes), so equal CONTENTS means an equal program; object
+        identity (``id``) is too strict and makes every spool-rebuilt
+        dictionary a fresh jit key (unbounded retrace + retained
+        jaxprs under multi-statement serving)."""
+        fp = self._fp
+        if fp is None:
+            import hashlib
+
+            arr = np.asarray(self.values, dtype=str)
+            h = hashlib.blake2b(digest_size=16)
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+            fp = self._fp = h.digest()
+        return fp
 
     @staticmethod
     def from_strings(strings: Sequence[str]) -> tuple["StringDictionary", np.ndarray]:

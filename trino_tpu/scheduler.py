@@ -224,6 +224,23 @@ class EventDrivenScheduler:
                     ).add(tid)
         return pins
 
+    def ready_count(self, queues, by_id, eligible_at, now) -> int:
+        """How many queued specs are dispatchable RIGHT NOW — ready on
+        their input edges and past any retry backoff. Serving-mode
+        dispatch keeps exactly this many slot tickets outstanding with
+        the shared Dispatcher (its "want"), so a query never holds
+        fleet capacity for work it cannot yet post."""
+        n = 0
+        for sid, q in queues.items():
+            stage = by_id[sid]
+            for sp in q:
+                if (
+                    now >= eligible_at.get(sp.task_id, 0.0)
+                    and self.task_ready(stage, sp)
+                ):
+                    n += 1
+        return n
+
     # ---- read-side surfaces ------------------------------------------------
 
     def admission_wait_ms(self, tid: str) -> float:

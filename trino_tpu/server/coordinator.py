@@ -104,18 +104,34 @@ class Coordinator:
         self.runner = runner or QueryRunner.tpch("tiny")
         self._queries: dict[str, QueryState] = {}
         self._lock = threading.Lock()
+        #: query-state transitions notify this condition so protocol
+        #: threads parked in page() wake immediately (the reference's
+        #: asyncResponse completion, not a sleep-poll)
+        self._state_cond = threading.Condition()
         self._seq = 0
         #: finished queries stay fetchable at least this long
         self.history_grace_s = 60.0
-        #: admission control (InternalResourceGroupManager analog)
-        self.resource_groups = resource_groups or ResourceGroupManager()
+        #: admission control (InternalResourceGroupManager analog).
+        #: A serving runner carries its own manager (fair-share weights
+        #: feed fleet-slot dispatch) — adopt it so admission and slot
+        #: scheduling read one group tree, like the reference where
+        #: DispatchManager and the scheduler share one
+        #: InternalResourceGroupManager.
+        self.resource_groups = (
+            resource_groups
+            or getattr(self.runner, "resource_groups", None)
+            or ResourceGroupManager()
+        )
         #: cluster-wide memory view (ClusterMemoryManager analog): in
         #: the embedded single-node shape it observes the local pool
-        #: after every statement; a FleetRunner-backed coordinator
-        #: would feed it worker snapshots the same way
+        #: after every statement; a serving/fleet-backed coordinator
+        #: shares the runner's manager, which is fed worker snapshots
         from trino_tpu.memory import ClusterMemoryManager
 
-        self.cluster_memory = ClusterMemoryManager()
+        self.cluster_memory = (
+            getattr(self.runner, "cluster_memory", None)
+            or ClusterMemoryManager()
+        )
         #: deadline governance: background reaper enforcing
         #: query_max_queued_time / query_max_execution_time
         #: (MAIN/execution/QueryTracker.java enforceTimeLimits analog)
@@ -257,6 +273,12 @@ class Coordinator:
     def uri(self) -> str:
         return f"http://127.0.0.1:{self.port}"
 
+    def _signal_state(self) -> None:
+        """Wake every protocol thread blocked in ``page()``. Called on
+        every query-state transition (run(), cancel(), the reaper)."""
+        with self._state_cond:
+            self._state_cond.notify_all()
+
     # ---- query management ------------------------------------------------
 
     def submit(self, sql: str, user: str = "user") -> QueryState:
@@ -338,6 +360,7 @@ class Coordinator:
                 if q.error is None:
                     q.error = "Query was canceled while queued"
                 q.finished_at = time.time()
+                self._signal_state()
                 return
             try:
                 if q.cancelled:
@@ -345,24 +368,37 @@ class Coordinator:
                     if q.error is None:
                         q.error = "Query was canceled while queued"
                     q.finished_at = time.time()
+                    self._signal_state()
                     return
                 q.state = "RUNNING"
                 q.started_at = time.time()
+                self._signal_state()
                 try:
                     # cooperative cancellation: DELETE sets the event
                     # and the executor aborts at its next boundary
                     # the coordinator's id IS the runner's id: live
                     # QueryInfo published under it joins QueryState
                     # (tests substitute runners whose execute() has no
-                    # query_id parameter — probe before passing it)
+                    # query_id parameter — probe before passing it;
+                    # same probe for user=, which a serving runner
+                    # consumes for per-identity group selection)
                     kwargs = {"cancel_event": q.cancel_event}
                     try:
                         import inspect
 
-                        if "query_id" in inspect.signature(
+                        params = inspect.signature(
                             self.runner.execute
-                        ).parameters:
+                        ).parameters
+                        if "query_id" in params:
                             kwargs["query_id"] = q.query_id
+                        if "user" in params:
+                            kwargs["user"] = q.user
+                        # this thread already holds a resource-group
+                        # running slot (acquired above, same adopted
+                        # manager) — a serving runner must not gate a
+                        # second time
+                        if "admitted" in params:
+                            kwargs["admitted"] = True
                     except (TypeError, ValueError):
                         pass
                     result = self.runner.execute(sql, **kwargs)
@@ -393,6 +429,7 @@ class Coordinator:
                     q.finished_at = time.time()
             finally:
                 self.resource_groups.release(group)
+                self._signal_state()
 
         threading.Thread(target=run, daemon=True).start()
         return q
@@ -411,6 +448,7 @@ class Coordinator:
             # resource-group condition variable — poke it so the cancel
             # takes effect now, not at the next poll tick
             self.resource_groups.wakeup()
+            self._signal_state()
 
     def query_info_list(self) -> list[dict]:
         """``GET /v1/query``: one light row per known query, joining
@@ -425,14 +463,22 @@ class Coordinator:
         out = []
         for q in snapshot:
             r = live.pop(q.query_id, None) or {}
+            # time spent QUEUED: until the RUNNING transition, or (for
+            # queries that died in the queue) until the terminal time;
+            # still-QUEUED queries report a live, growing value
+            queued_end = q.started_at or q.finished_at or time.time()
             out.append({
                 "query_id": q.query_id,
                 "state": q.state,
                 "user": q.user,
                 "query": q.sql,
+                "resource_group": q.resource_group,
                 "elapsed_ms": round(
                     ((q.finished_at or time.time()) - q.created_at)
                     * 1e3, 3,
+                ),
+                "queued_time_ms": round(
+                    (queued_end - q.created_at) * 1e3, 3
                 ),
                 "peak_memory_bytes": r.get("peak_memory_bytes", 0),
                 "rows": r.get("rows"),
@@ -466,6 +512,12 @@ class Coordinator:
             info["user"] = q.user
             if q.error:
                 info["error"] = q.error
+        if q is not None:
+            info["resource_group"] = q.resource_group
+            info["queued_time_ms"] = round(
+                ((q.started_at or q.finished_at or time.time())
+                 - q.created_at) * 1e3, 3,
+            )
         return info
 
     def list_queries(self) -> list[dict]:
@@ -490,11 +542,19 @@ class Coordinator:
         q = self._queries.get(qid)
         if q is None or q.slug != slug:
             return {"error": "query not found"}, 404
-        # long-poll-lite: wait briefly for results like the reference's
-        # asyncResponse (ExecutingStatementResource waits server-side)
+        # long-poll: wait server-side for a state transition like the
+        # reference's asyncResponse (ExecutingStatementResource). The
+        # condition is notified by run()/cancel()/the reaper, so a
+        # finishing query releases its waiting client immediately —
+        # under high concurrency the old 10 ms sleep-poll added a
+        # half-tick of latency per page to every client.
         deadline = time.time() + 1.0
-        while q.state in ("QUEUED", "RUNNING") and time.time() < deadline:
-            time.sleep(0.01)
+        with self._state_cond:
+            while q.state in ("QUEUED", "RUNNING"):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._state_cond.wait(timeout=remaining)
         return self.proto_response(q, token, base), 200
 
     def proto_response(self, q: QueryState, token: int, base: str) -> dict:

@@ -189,8 +189,35 @@ class FleetRunner:
         readmit_initial_s: float = 0.5,
         readmit_max_s: float = 8.0,
         readmit_probe_timeout_s: float = 1.0,
+        dispatcher=None,
+        workers: list[FleetWorker] | None = None,
+        worker_devices: dict[str, int] | None = None,
+        cluster_memory=None,
+        serving=None,
+        resource_group: str = "global",
+        group_weight: int = 1,
     ):
-        self.workers = [FleetWorker(u.rstrip("/")) for u in worker_uris]
+        #: serving mode: a shared trino_tpu.dispatcher.Dispatcher owns
+        #: worker slots, fair-share grants and ALL status polling; this
+        #: runner is then one query among many on a shared fleet. When
+        #: None (the default), the legacy single-query path runs: this
+        #: loop owns the fleet, posts and polls inline — byte-identical
+        #: behavior to every prior PR (including call-order-sensitive
+        #: ``nth`` chaos schedules, which a free-running reactor breaks)
+        self.dispatcher = dispatcher
+        self._serving = serving
+        self.resource_group = resource_group
+        self.group_weight = group_weight
+        #: cross-query memory kill: another query's dispatch loop (via
+        #: ServingRunner.enforce_memory) names this query the victim;
+        #: our own loop notices and unwinds with the typed error
+        self._kill_error: str | None = None
+        #: shared FleetWorker objects make liveness/draining state
+        #: fleet-global across concurrent queries
+        self.workers = (
+            workers if workers is not None
+            else [FleetWorker(u.rstrip("/")) for u in worker_uris]
+        )
         self.metadata = metadata
         self.session = session
         self.spool_root = spool_root
@@ -251,10 +278,17 @@ class FleetRunner:
         #: coordinator-side memory governor: aggregates the per-worker
         #: pool snapshots shipped on task-status responses, enforces
         #: query_max_memory, and kills the largest query on breach
-        self.cluster_memory = memory.ClusterMemoryManager()
+        #: (shared across queries in serving mode, so the kill policy
+        #: sees every live query's reservations)
+        self.cluster_memory = (
+            cluster_memory if cluster_memory is not None
+            else memory.ClusterMemoryManager()
+        )
         #: current query id (stamped on stage-task requests so worker
         #: pools attribute reservations to the right query)
         self._query_id: str | None = None
+        #: serving-mode dispatch registration of the attempt in flight
+        self._dispatch_handle = None
         #: externally-assigned id (the coordinator's) under which this
         #: statement publishes live QueryInfo; attempt-local
         #: ``_query_id`` values keep naming spool epochs
@@ -272,14 +306,29 @@ class FleetRunner:
         self._cluster_cap = 0
         self._planner = QueryRunner(metadata, session)
         #: per-worker device counts from /v1/info (1 when unreachable
-        #: or mesh-less); the planner's shard count is the fleet total
-        self.worker_devices = {
-            w.uri: self._probe_devices(w.uri) for w in self.workers
-        }
+        #: or mesh-less); the planner's shard count is the fleet total.
+        #: ServingRunner passes the probed map in so per-statement
+        #: runner construction costs no RPCs.
+        self.worker_devices = (
+            dict(worker_devices) if worker_devices is not None
+            else {
+                w.uri: self._probe_devices(w.uri) for w in self.workers
+            }
+        )
         per_worker = max(self.worker_devices.values(), default=1)
         self._planner.mesh = _FleetParallelism(
             max(n_partitions, 2) * per_worker
         )
+
+    def request_kill(self, error: str) -> bool:
+        """Cross-query memory kill (serving mode): mark this query as
+        the cluster memory manager's victim. Its dispatch loop raises
+        ExceededMemoryLimitError at the next iteration. Returns False
+        when a kill is already pending (kills are counted once)."""
+        if self._kill_error is not None:
+            return False
+        self._kill_error = error
+        return True
 
     @staticmethod
     def _probe_devices(uri: str) -> int:
@@ -309,7 +358,11 @@ class FleetRunner:
         public_qid = query_id or uuid.uuid4().hex[:12]
         self._public_query_id = public_qid
         tracker.QUERY_INFO.begin(
-            public_qid, sql=sql, user=self.session.user
+            public_qid, sql=sql, user=self.session.user,
+            resource_group=(
+                self.resource_group if self.dispatcher is not None
+                else None
+            ),
         )
         t0 = time.perf_counter()
         error = None
@@ -637,6 +690,15 @@ class FleetRunner:
             return res
         finally:
             self._tracer = None
+            if (
+                self.dispatcher is not None
+                and self._dispatch_handle is not None
+            ):
+                # drop pending slot requests AND sweep any slots still
+                # pinned by attempts of this query (abnormal unwind:
+                # retries exhausted, deadline, memory kill)
+                self.dispatcher.unregister_query(self._dispatch_handle)
+                self._dispatch_handle = None
             if not self.keep_spool:
                 import shutil
 
@@ -775,11 +837,20 @@ class FleetRunner:
 
     def _make_tasks(self, stage: Stage) -> list[_TaskSpec]:
         sid = stage.stage_id
+        # serving mode: workers key live tasks by "task_id.attempt", so
+        # concurrent queries sharing a fleet need query-unique task ids
+        # — prefix with the attempt-level query id. Single-query mode
+        # keeps the bare ids every existing test and trace knows.
+        pfx = (
+            f"{self._query_id[:6]}." if (
+                self.dispatcher is not None and self._query_id
+            ) else ""
+        )
         if stage.aligned:
             wire = plan_to_json(stage.root)
             return [
                 _TaskSpec(
-                    f"s{sid}p{p}", wire, p,
+                    f"{pfx}s{sid}p{p}", wire, p,
                     fail_first=f"{sid}:{p}" in self.inject_failures,
                 )
                 for p in range(self.n_partitions)
@@ -795,14 +866,14 @@ class FleetRunner:
                 bound = _bind_split(stage.root, scan, (spl.start, spl.count))
                 specs.append(
                     _TaskSpec(
-                        f"s{sid}t{i}", plan_to_json(bound), None,
+                        f"{pfx}s{sid}t{i}", plan_to_json(bound), None,
                         fail_first=f"{sid}:{i}" in self.inject_failures,
                     )
                 )
             return specs
         return [
             _TaskSpec(
-                f"s{sid}t0", plan_to_json(stage.root), None,
+                f"{pfx}s{sid}t0", plan_to_json(stage.root), None,
                 fail_first=f"{sid}:0" in self.inject_failures,
             )
         ]
@@ -880,6 +951,20 @@ class FleetRunner:
         pipelined = mode == "PIPELINED"
         sched = EventDrivenScheduler(stages, mode=mode)
         self._scheduler = sched
+
+        # serving mode: register with the shared dispatcher — slot
+        # grants arrive fair-share across resource groups, and ALL
+        # status polling happens on its O(workers) reactor threads.
+        # The handle is unregistered in _execute_attempt's finally (it
+        # sweeps any slots this query still pins on abnormal unwind).
+        handle = None
+        if self.dispatcher is not None:
+            handle = self.dispatcher.register_query(
+                self._query_id or "q",
+                self.resource_group,
+                self.group_weight,
+            )
+            self._dispatch_handle = handle
 
         retry_init_ms = float(sp.get(self.session, "retry_initial_delay_ms"))
         retry_max_ms = float(sp.get(self.session, "retry_max_delay_ms"))
@@ -1017,6 +1102,8 @@ class FleetRunner:
                 for k2 in vkeys:
                     (w2, _, _, _) = inflight.pop(k2)
                     cancel_attempt(w2, vtid, k2[1])
+                    if self.dispatcher is not None:
+                        self.dispatcher.finish(vtid, k2[1])
                 sched.rescinds += 1
                 telemetry.SCHED_RESCINDS.inc()
                 self.failure_log.append(
@@ -1081,35 +1168,42 @@ class FleetRunner:
                 and self._cancel_event.is_set()
             ):
                 raise QueryCancelled("Query was canceled")
-            # re-admission probes: evicted workers that answer
-            # /v1/info again rejoin the placement pool
-            now = time.monotonic()
-            for w in self.workers:
-                if w.alive or now < self._probe_at.get(w.uri, 0.0):
-                    continue
-                try:
-                    with urllib.request.urlopen(
-                        f"{w.uri}/v1/info",
-                        timeout=self.readmit_probe_timeout_s,
-                    ) as r:
-                        info = json.loads(r.read())
-                except Exception:
-                    d = min(
-                        self._probe_delay.get(
-                            w.uri, self.readmit_initial_s
-                        ) * 2.0,
-                        self.readmit_max_s,
-                    )
-                    self._probe_delay[w.uri] = d
-                    self._probe_at[w.uri] = time.monotonic() + d
-                    continue
-                w.alive = True
-                w.fails = 0
-                w.draining = info.get("state") != "ACTIVE"
-                self._probe_delay.pop(w.uri, None)
-                self._probe_at.pop(w.uri, None)
-                self.stats["workers_readmitted"] += 1
-                telemetry.WORKERS_READMITTED.inc()
+            if self._kill_error is not None:
+                # named the victim by the cluster memory manager from
+                # ANOTHER query's dispatch loop (serving mode)
+                msg, self._kill_error = self._kill_error, None
+                raise memory.ExceededMemoryLimitError(msg)
+            if self.dispatcher is None:
+                # re-admission probes: evicted workers that answer
+                # /v1/info again rejoin the placement pool (in serving
+                # mode the dispatcher's per-worker reactor probes)
+                now = time.monotonic()
+                for w in self.workers:
+                    if w.alive or now < self._probe_at.get(w.uri, 0.0):
+                        continue
+                    try:
+                        with urllib.request.urlopen(
+                            f"{w.uri}/v1/info",
+                            timeout=self.readmit_probe_timeout_s,
+                        ) as r:
+                            info = json.loads(r.read())
+                    except Exception:
+                        d = min(
+                            self._probe_delay.get(
+                                w.uri, self.readmit_initial_s
+                            ) * 2.0,
+                            self.readmit_max_s,
+                        )
+                        self._probe_delay[w.uri] = d
+                        self._probe_at[w.uri] = time.monotonic() + d
+                        continue
+                    w.alive = True
+                    w.fails = 0
+                    w.draining = info.get("state") != "ACTIVE"
+                    self._probe_delay.pop(w.uri, None)
+                    self._probe_at.pop(w.uri, None)
+                    self.stats["workers_readmitted"] += 1
+                    telemetry.WORKERS_READMITTED.inc()
             # admit newly-startable stages (under BARRIER, task
             # construction sees current worker liveness, so it happens
             # at admission, not upfront)
@@ -1146,96 +1240,174 @@ class FleetRunner:
                     "all remaining workers are draining; tasks cannot "
                     "be placed"
                 )
-            busy = {id(w) for (w, _, _, _) in inflight.values()}
-            for _ in range(n_pending()):
-                # NOTE: no busy-count early-out — `busy` includes
-                # draining/hung workers holding in-flight tasks, which
-                # are not in `postable`; counting them would idle free
-                # workers. The `w is None` probe below is the real
-                # "no free worker" exit.
-                nxt = take_next(time.monotonic())
-                if nxt is None:
-                    break
-                stage, spec = nxt
-                w = next(
-                    (w for w in postable if id(w) not in busy), None
-                )
-                if w is None:
-                    queues[stage.stage_id].appendleft(spec)
-                    break
-                a = next_attempt_no[spec.task_id]
-                try:
-                    self._post_task(
-                        w, stage, spec, a, qroot, tasks_by_stage,
-                        pins=sched.admit(stage, spec),
+            if self.dispatcher is None:
+                busy = {id(w) for (w, _, _, _) in inflight.values()}
+                for _ in range(n_pending()):
+                    # NOTE: no busy-count early-out — `busy` includes
+                    # draining/hung workers holding in-flight tasks,
+                    # which are not in `postable`; counting them would
+                    # idle free workers. The `w is None` probe below is
+                    # the real "no free worker" exit.
+                    nxt = take_next(time.monotonic())
+                    if nxt is None:
+                        break
+                    stage, spec = nxt
+                    w = next(
+                        (w for w in postable if id(w) not in busy), None
                     )
-                    next_attempt_no[spec.task_id] = a + 1
-                    inflight[(spec.task_id, a)] = (
-                        w, stage, spec, time.monotonic()
-                    )
-                    busy.add(id(w))
-                    if self.post_hook is not None:
-                        self.post_hook(stage.stage_id, spec.task_id, w)
-                except urllib.error.HTTPError as e:
-                    if e.code == 409:
-                        # 409 = draining: alive, just not accepting —
-                        # reschedule elsewhere, keep polling its tasks
-                        w.draining = True
-                        postable = [x for x in postable if x is not w]
-                    else:
+                    if w is None:
+                        queues[stage.stage_id].appendleft(spec)
+                        break
+                    a = next_attempt_no[spec.task_id]
+                    try:
+                        self._post_task(
+                            w, stage, spec, a, qroot, tasks_by_stage,
+                            pins=sched.admit(stage, spec),
+                        )
+                        next_attempt_no[spec.task_id] = a + 1
+                        inflight[(spec.task_id, a)] = (
+                            w, stage, spec, time.monotonic()
+                        )
+                        busy.add(id(w))
+                        if self.post_hook is not None:
+                            self.post_hook(
+                                stage.stage_id, spec.task_id, w
+                            )
+                    except urllib.error.HTTPError as e:
+                        if e.code == 409:
+                            # 409 = draining: alive, just not accepting
+                            # — reschedule elsewhere, keep polling its
+                            # tasks
+                            w.draining = True
+                            postable = [x for x in postable if x is not w]
+                        else:
+                            mark_dead(w)
+                            postable = [x for x in postable if x is not w]
+                        queues[stage.stage_id].appendleft(spec)
+                    except Exception:
                         mark_dead(w)
                         postable = [x for x in postable if x is not w]
-                    queues[stage.stage_id].appendleft(spec)
-                except Exception:
-                    mark_dead(w)
-                    postable = [x for x in postable if x is not w]
-                    queues[stage.stage_id].appendleft(spec)
+                        queues[stage.stage_id].appendleft(spec)
+            else:
+                # serving mode: keep one slot request outstanding per
+                # currently-dispatchable task (ready + past backoff);
+                # consume fair-share grants by posting from THIS thread
+                # so all RPC error handling stays in the query loop
+                n_want = sched.ready_count(
+                    queues, by_id, eligible_at, time.monotonic()
+                )
+                self.dispatcher.want(handle, n_want)
+                granted = False
+                for grant in self.dispatcher.take_grants(handle):
+                    granted = True
+                    nxt = take_next(time.monotonic())
+                    if nxt is None:
+                        # readiness regressed between request and
+                        # grant (backoff, retraction): hand it back
+                        self.dispatcher.release_grant(grant)
+                        continue
+                    stage, spec = nxt
+                    w = grant.worker
+                    if not w.alive or w.draining:
+                        self.dispatcher.release_grant(grant)
+                        queues[stage.stage_id].appendleft(spec)
+                        continue
+                    a = next_attempt_no[spec.task_id]
+                    try:
+                        self._post_task(
+                            w, stage, spec, a, qroot, tasks_by_stage,
+                            pins=sched.admit(stage, spec),
+                        )
+                        next_attempt_no[spec.task_id] = a + 1
+                        inflight[(spec.task_id, a)] = (
+                            w, stage, spec, time.monotonic()
+                        )
+                        self.dispatcher.bind(grant, spec.task_id, a)
+                        if self.post_hook is not None:
+                            self.post_hook(
+                                stage.stage_id, spec.task_id, w
+                            )
+                    except urllib.error.HTTPError as e:
+                        if e.code == 409:
+                            w.draining = True
+                        else:
+                            self.dispatcher.mark_dead(w)
+                        self.dispatcher.release_grant(grant)
+                        queues[stage.stage_id].appendleft(spec)
+                    except Exception:
+                        self.dispatcher.mark_dead(w)
+                        self.dispatcher.release_grant(grant)
+                        queues[stage.stage_id].appendleft(spec)
             for key, entry in list(inflight.items()):
                 if key not in inflight:
                     continue  # removed by a dead-worker sweep below
                 (w, stage, spec, t0) = entry
                 tid, a = key
-                try:
-                    state = self._poll_task(w, tid, a)
-                    w.fails = 0
-                    # pool snapshots ride on every task-status response
-                    # (the heartbeat surface): aggregate them and apply
-                    # the cluster-wide cap + kill policy
-                    self.cluster_memory.observe(w.uri, state.get("pool"))
-                    self.cluster_memory.enforce(
-                        self._cluster_cap, running={self._query_id}
-                    )
-                except memory.ExceededMemoryLimitError:
-                    raise  # killed by the cluster memory manager
-                except Exception as e:
-                    # crash/kill -9 refuses the connection: dead now.
-                    # A hung-but-alive worker (SIGSTOP) keeps the
-                    # socket open and times out: N consecutive short
-                    # timeouts declare it dead — detection latency
-                    # rpc_timeout_s * max_poll_fails, not one long RPC
-                    # timeout (VERDICT r4 missing #8)
-                    refused = isinstance(
-                        getattr(e, "reason", None), ConnectionRefusedError
-                    ) or isinstance(e, ConnectionRefusedError)
-                    w.fails += 1
-                    if not (refused or w.fails >= self.max_poll_fails):
-                        continue  # transient: re-poll next loop
-                    mark_dead(w)
-                    # sweep EVERY attempt the dead worker held; a task
-                    # whose sibling attempt survives elsewhere is not
-                    # re-queued (the sibling may still win)
-                    for k2, e2 in list(inflight.items()):
-                        if e2[0] is not w:
+                if self.dispatcher is None:
+                    try:
+                        state = self._poll_task(w, tid, a)
+                        w.fails = 0
+                        # pool snapshots ride on every task-status
+                        # response (the heartbeat surface): aggregate
+                        # them and apply the cluster cap + kill policy
+                        self.cluster_memory.observe(
+                            w.uri, state.get("pool")
+                        )
+                        self.cluster_memory.enforce(
+                            self._cluster_cap, running={self._query_id}
+                        )
+                    except memory.ExceededMemoryLimitError:
+                        raise  # killed by the cluster memory manager
+                    except Exception as e:
+                        # crash/kill -9 refuses the connection: dead
+                        # now. A hung-but-alive worker (SIGSTOP) keeps
+                        # the socket open and times out: N consecutive
+                        # short timeouts declare it dead — detection
+                        # latency rpc_timeout_s * max_poll_fails, not
+                        # one long RPC timeout (VERDICT r4 missing #8)
+                        refused = isinstance(
+                            getattr(e, "reason", None),
+                            ConnectionRefusedError,
+                        ) or isinstance(e, ConnectionRefusedError)
+                        w.fails += 1
+                        if not (
+                            refused or w.fails >= self.max_poll_fails
+                        ):
+                            continue  # transient: re-poll next loop
+                        mark_dead(w)
+                        # sweep EVERY attempt the dead worker held; a
+                        # task whose sibling attempt survives elsewhere
+                        # is not re-queued (the sibling may still win)
+                        for k2, e2 in list(inflight.items()):
+                            if e2[0] is not w:
+                                continue
+                            del inflight[k2]
+                            st2, sp2 = e2[1], e2[2]
+                            tid2 = sp2.task_id
+                            if tid2 in done_of[st2.stage_id]:
+                                continue
+                            if other_attempt_inflight(tid2):
+                                continue
+                            record_failure(st2, sp2, "worker died")
+                        continue
+                else:
+                    # serving mode: statuses come from the shared
+                    # reactor's cache — no RPC from this thread. Worker
+                    # death surfaces as a synthetic LOST status per
+                    # stranded attempt (memory observation also rides
+                    # the reactor, via Dispatcher.on_pool).
+                    state = self.dispatcher.status(tid, a)
+                    if state is None:
+                        continue  # not polled yet
+                    if state.get("state") == "LOST":
+                        del inflight[key]
+                        self.dispatcher.finish(tid, a)
+                        if tid in done_of[stage.stage_id]:
                             continue
-                        del inflight[k2]
-                        st2, sp2 = e2[1], e2[2]
-                        tid2 = sp2.task_id
-                        if tid2 in done_of[st2.stage_id]:
+                        if other_attempt_inflight(tid):
                             continue
-                        if other_attempt_inflight(tid2):
-                            continue
-                        record_failure(st2, sp2, "worker died")
-                    continue
+                        record_failure(stage, spec, "worker died")
+                        continue
                 sid = stage.stage_id
                 # committed-partition sets ride on every status
                 # response: the event feed of pipelined admission
@@ -1243,6 +1415,8 @@ class FleetRunner:
                     sched.on_partition_commit(sid, tid, a, int(p))
                 if state["state"] == "FINISHED":
                     del inflight[key]
+                    if self.dispatcher is not None:
+                        self.dispatcher.finish(tid, a)
                     if tid in done_of[sid]:
                         continue  # duplicate commit of a raced attempt
                     done_of[sid].add(tid)
@@ -1287,6 +1461,8 @@ class FleetRunner:
                     for k2 in [k for k in inflight if k[0] == tid]:
                         (w2, _, _, _) = inflight.pop(k2)
                         cancel_attempt(w2, tid, k2[1])
+                        if self.dispatcher is not None:
+                            self.dispatcher.finish(tid, k2[1])
                     if len(done_of[sid]) == len(specs_of[sid]):
                         tasks_by_stage[sid] = [
                             s.task_id for s in specs_of[sid]
@@ -1300,6 +1476,8 @@ class FleetRunner:
                             self.stage_hook(sid)
                 elif state["state"] == "FAILED":
                     del inflight[key]
+                    if self.dispatcher is not None:
+                        self.dispatcher.finish(tid, a)
                     error = state.get("error", "task failed")
                     self._task_stats.append({
                         "query_id": self._query_id,
@@ -1321,19 +1499,40 @@ class FleetRunner:
                     # a cancelled losing attempt we no longer track,
                     # or a racing cancel — never a failure
                     del inflight[key]
+                    if self.dispatcher is not None:
+                        self.dispatcher.finish(tid, a)
+            # serving mode: cross-query memory governance — the kill
+            # victim is picked among ALL live queries (possibly not
+            # this one); legacy mode enforced per poll above
+            if self.dispatcher is not None:
+                if self._serving is not None:
+                    self._serving.enforce_memory(
+                        self._cluster_cap, self._query_id
+                    )
+                else:
+                    self.cluster_memory.enforce(
+                        self._cluster_cap, running={self._query_id}
+                    )
             # speculation: hedge stragglers with a backup attempt on
-            # an idle worker (first committed attempt wins)
+            # an idle worker (first committed attempt wins). Under a
+            # shared fleet, "idle" means a FREE SLOT grabbed outside
+            # the fair queue — hedges are opportunistic and only ever
+            # consume capacity nobody queued for.
             if spec_enabled and inflight:
                 now = time.monotonic()
-                busy = {
-                    id(w) for (w, _, _, _) in inflight.values()
-                }
-                idle = [
-                    x for x in self.workers
-                    if x.alive and not x.draining and id(x) not in busy
-                ]
+                if self.dispatcher is None:
+                    busy = {
+                        id(w) for (w, _, _, _) in inflight.values()
+                    }
+                    idle = [
+                        x for x in self.workers
+                        if x.alive and not x.draining
+                        and id(x) not in busy
+                    ]
+                else:
+                    idle = None
                 for key, (w, stage, spec, t0) in list(inflight.items()):
-                    if not idle:
+                    if idle is not None and not idle:
                         break
                     tid = spec.task_id
                     sid = stage.stage_id
@@ -1348,9 +1547,18 @@ class FleetRunner:
                     )
                     if now - t0 < threshold:
                         continue
-                    x = next((c for c in idle if c is not w), None)
-                    if x is None:
-                        continue
+                    grant = None
+                    if idle is not None:
+                        x = next((c for c in idle if c is not w), None)
+                        if x is None:
+                            continue
+                    else:
+                        grant = self.dispatcher.try_grab_idle(
+                            exclude=w, handle=handle
+                        )
+                        if grant is None:
+                            continue
+                        x = grant.worker
                     a2 = next_attempt_no[tid]
                     try:
                         # the hedge re-pins from current commit state;
@@ -1362,25 +1570,51 @@ class FleetRunner:
                     except urllib.error.HTTPError as e:
                         if e.code == 409:
                             x.draining = True
+                        elif grant is not None:
+                            self.dispatcher.mark_dead(x)
                         else:
                             mark_dead(x)
-                        idle.remove(x)
+                        if grant is not None:
+                            self.dispatcher.release_grant(grant)
+                        else:
+                            idle.remove(x)
                         continue
                     except Exception:
-                        mark_dead(x)
-                        idle.remove(x)
+                        if grant is not None:
+                            self.dispatcher.mark_dead(x)
+                            self.dispatcher.release_grant(grant)
+                        else:
+                            mark_dead(x)
+                            idle.remove(x)
                         continue
                     next_attempt_no[tid] = a2 + 1
                     inflight[(tid, a2)] = (x, stage, spec, now)
+                    if grant is not None:
+                        self.dispatcher.bind(grant, tid, a2)
                     speculative.add((tid, a2))
                     speculated_tids.add(tid)
                     self.stats["tasks_speculated"] += 1
                     telemetry.TASKS_SPECULATED.inc()
-                    idle.remove(x)
+                    if idle is not None:
+                        idle.remove(x)
                     if self.post_hook is not None:
                         self.post_hook(sid, tid, x)
-            if inflight or not n_pending():
-                time.sleep(self.poll_s)
+            # serving mode must ALSO wait while blocked on slot grants
+            # (pending tasks, nothing inflight, no grant this round) —
+            # otherwise 8 queries contending for 2 slots busy-spin on
+            # want()/take_grants() and starve the reactor threads. The
+            # wait is event-driven: the dispatcher sets handle.wake on
+            # a grant or a terminal status, so the coarse fallback only
+            # paces backoff/speculation checks and N blocked queries
+            # cost ~no CPU between events.
+            if inflight or not n_pending() or (
+                self.dispatcher is not None and not granted
+            ):
+                if self.dispatcher is not None:
+                    handle.wake.wait(self.poll_s * 5)
+                    handle.wake.clear()
+                else:
+                    time.sleep(self.poll_s)
         self._last_specs = dict(spec_by_tid)
         # the pipelining win, as one number: seconds of consumer
         # runtime that overlapped a still-streaming producer stage

@@ -41,6 +41,19 @@ class ResourceGroup:
     max_running: int = 8
     max_queued: int = 100
     user: str = "*"
+    #: fair-share weight for cluster-slot dispatch (the reference's
+    #: schedulingWeight): when queries from several groups contend for
+    #: fleet worker slots, grants are dealt deficit-round-robin in
+    #: proportion to group weights — a weight-8 group gets ~8 slots
+    #: for every 1 a weight-1 group gets, but the low-weight group is
+    #: never starved (every group is visited each DRR round)
+    weight: int = 1
+
+    def __post_init__(self):
+        if self.weight < 1:
+            raise ValueError(
+                f"resource group {self.name!r}: weight must be >= 1"
+            )
 
     def matches(self, user: str) -> bool:
         return fnmatch.fnmatchcase(user, self.user)
@@ -65,6 +78,18 @@ class ResourceGroupManager:
     def __post_init__(self):
         self._cond = threading.Condition()
         self._state = {g.name: _GroupState() for g in self.groups}
+        self._publish()
+
+    def _publish(self) -> None:
+        """Export per-group running/queued counts as gauges (call with
+        ``_cond`` held or before threads exist). One writer: admission
+        state lives here, so the gauges can never disagree with it."""
+        from trino_tpu import telemetry
+
+        for g in self.groups:
+            st = self._state[g.name]
+            telemetry.QUERIES_RUNNING.set(st.running, group=g.name)
+            telemetry.QUERIES_QUEUED.set(len(st.queue), group=g.name)
 
     def select(self, user: str) -> ResourceGroup:
         for g in self.groups:
@@ -84,6 +109,7 @@ class ResourceGroupManager:
             st = self._state[group.name]
             if not st.queue and st.running < group.max_running:
                 st.running += 1
+                self._publish()
                 return True
             if len(st.queue) >= group.max_queued:
                 raise QueryQueueFullError(
@@ -91,6 +117,7 @@ class ResourceGroupManager:
                     f"(max {group.max_queued})"
                 )
             st.queue.append(qid)
+            self._publish()
             return False
 
     def acquire(
@@ -110,6 +137,7 @@ class ResourceGroupManager:
                         st.queue.remove(qid)
                     except ValueError:
                         pass
+                    self._publish()
                     self._cond.notify_all()
                     return False
                 if (
@@ -119,6 +147,7 @@ class ResourceGroupManager:
                 ):
                     st.queue.popleft()
                     st.running += 1
+                    self._publish()
                     self._cond.notify_all()
                     return True
                 # long timeout: cancellation/reaping promptness comes
@@ -137,11 +166,12 @@ class ResourceGroupManager:
         with self._cond:
             st = self._state[group.name]
             st.running = max(st.running - 1, 0)
+            self._publish()
             self._cond.notify_all()
 
     def stats(self) -> dict:
-        """name -> {running, queued, max_running, max_queued} (the
-        resource-group JMX/system-table view)."""
+        """name -> {running, queued, max_running, max_queued, weight}
+        (the resource-group JMX/system-table view)."""
         with self._cond:
             return {
                 g.name: {
@@ -149,6 +179,7 @@ class ResourceGroupManager:
                     "queued": len(self._state[g.name].queue),
                     "max_running": g.max_running,
                     "max_queued": g.max_queued,
+                    "weight": g.weight,
                 }
                 for g in self.groups
             }

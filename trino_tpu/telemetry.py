@@ -527,6 +527,28 @@ COMPILE_DESERIALIZE_FALLBACKS = REGISTRY.counter(
 PERSISTENT_CACHE_HITS = REGISTRY.counter(
     "trino_persistent_cache_hits_total",
     "XLA programs deserialized from the on-disk compilation cache instead of compiled")
+DISPATCH_QUEUE_DEPTH = REGISTRY.gauge(
+    "trino_dispatch_queue_depth",
+    "Fleet slot requests waiting in the fair-share dispatch queue, by resource group")
+SLOT_WAIT = REGISTRY.histogram(
+    "trino_slot_wait_seconds",
+    "Wait from slot request to fleet-slot grant under fair-share dispatch",
+    # slot waits range from instant (idle fleet) to whole-query
+    # runtimes under saturation — match the sched-admission spread
+    buckets=(0.0005, 0.002, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0, 15.0, 60.0))
+QUERIES_RUNNING = REGISTRY.gauge(
+    "trino_queries_running",
+    "Queries currently holding a running slot, by resource group")
+QUERIES_QUEUED = REGISTRY.gauge(
+    "trino_queries_queued",
+    "Queries waiting in admission queues, by resource group")
+SCAN_CACHE_HITS = REGISTRY.counter(
+    "trino_scan_cache_hits_total",
+    "Table-scan page materializations served from the shared scan-page cache")
+SCAN_CACHE_MISSES = REGISTRY.counter(
+    "trino_scan_cache_misses_total",
+    "Table-scan page materializations that had to hit the connector")
 
 
 # ---------------------------------------------------------------------------

@@ -46,7 +46,7 @@ from trino_tpu.testing.golden import (
 
 __all__ = [
     "CHAOS_BASE_PORT", "spawn_workers", "stop_workers",
-    "make_fleet", "run_chaos_soak", "fired_sites",
+    "make_fleet", "make_serving", "run_chaos_soak", "fired_sites",
 ]
 
 CHAOS_BASE_PORT = 18960
@@ -121,6 +121,19 @@ def make_fleet(worker_uris, spool_root: str, **kwargs) -> FleetRunner:
     md = Metadata()
     md.register_catalog("tpch", TpchConnector())
     return FleetRunner(
+        list(worker_uris), md, Session(catalog="tpch", schema="tiny"),
+        spool_root=spool_root, n_partitions=4, **kwargs
+    )
+
+
+def make_serving(worker_uris, spool_root: str, **kwargs):
+    """A ServingRunner over TPC-H tiny (the multi-query counterpart of
+    :func:`make_fleet` — shared slot pool, fair-share admission)."""
+    from trino_tpu.dispatcher import ServingRunner
+
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    return ServingRunner(
         list(worker_uris), md, Session(catalog="tpch", schema="tiny"),
         spool_root=spool_root, n_partitions=4, **kwargs
     )

@@ -122,6 +122,10 @@ class QueryTracker:
         wakeup = getattr(self.coordinator.resource_groups, "wakeup", None)
         if wakeup is not None:
             wakeup()
+        # clients long-polling page() must see the reap immediately
+        signal = getattr(self.coordinator, "_signal_state", None)
+        if signal is not None:
+            signal()
 
 
 class QueryInfoRegistry:
@@ -154,6 +158,8 @@ class QueryInfoRegistry:
                 "state": "RUNNING",
                 "user": None,
                 "sql": None,
+                "resource_group": None,
+                "queued_ms": 0.0,
                 "created_at": time.time(),
                 "finished_at": None,
                 "error": None,
@@ -166,7 +172,9 @@ class QueryInfoRegistry:
         return e
 
     def begin(self, query_id: str, sql: str | None = None,
-              user: str | None = None) -> None:
+              user: str | None = None,
+              resource_group: str | None = None,
+              queued_ms: float | None = None) -> None:
         if not query_id:
             return
         with self._lock:
@@ -175,6 +183,10 @@ class QueryInfoRegistry:
                 e["sql"] = sql
             if user is not None:
                 e["user"] = user
+            if resource_group is not None:
+                e["resource_group"] = resource_group
+            if queued_ms is not None:
+                e["queued_ms"] = float(queued_ms)
 
     def update_task(self, query_id: str, task_row: dict) -> None:
         if not query_id:
@@ -232,7 +244,9 @@ class QueryInfoRegistry:
                     "query_id": e["query_id"],
                     "state": e["state"],
                     "user": e["user"],
+                    "resource_group": e["resource_group"],
                     "elapsed_ms": round(self._elapsed_ms(e), 3),
+                    "queued_time_ms": round(e["queued_ms"], 3),
                     "peak_memory_bytes": e["peak_memory_bytes"],
                     "rows": e["rows"],
                     "error": e["error"],
