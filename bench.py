@@ -125,6 +125,15 @@ def main(argv=None) -> int:
         "the 1-client sequential QPS over the same statements",
     )
     ap.add_argument(
+        "--storage", action="store_true",
+        help="also run the out-of-core storage benchmark: a synthetic "
+        "partitioned parquet table streamed row-group-by-row-group "
+        "under a tight budget, with and without predicate pushdown; "
+        "records storage_stream_rows_per_s, storage_pushdown_rows_per_s"
+        ", row-group/partition prune counts, and the streamed peak "
+        "(skips cleanly when pyarrow is absent)",
+    )
+    ap.add_argument(
         "--stage-admission", choices=["both", "BARRIER", "PIPELINED"],
         default=None,
         help="also run the fleet stage-admission A/B: TPC-H q3/q5/q9 "
@@ -442,6 +451,20 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
             r10.executor.tracked_bytes_hwm
         )
     if (
+        args.storage or _section_enabled("BENCH_STORAGE", False)
+    ) and fits("storage", 180.0):
+        # out-of-core storage (BENCH_r06): how fast the streamed tier
+        # moves real parquet bytes, and what footer-stats + partition
+        # pushdown saves. Numbers are rates over the LOGICAL table
+        # (pruned row groups count as scanned — pushdown's win IS the
+        # higher effective rate). Skips when pyarrow is missing so the
+        # default CI matrix still runs every other section.
+        try:
+            _storage_section(detail)
+        except ImportError:
+            detail["storage_skipped"] = "pyarrow not installed"
+
+    if (
         args.stage_admission
         or _section_enabled("BENCH_STAGE_ADMISSION", False)
     ) and fits("stage_admission", 240.0):
@@ -545,6 +568,71 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         detail["chaos_wall_s"] = round(chaos_wall, 1)
 
     return 0
+
+
+def _storage_section(detail) -> None:
+    import tempfile
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.connectors.base import TableSchema
+    from trino_tpu.connectors.parquet import write_parquet_table
+    from trino_tpu.engine import QueryRunner
+
+    n = int(os.environ.get("BENCH_STORAGE_ROWS", str(1_200_000)))
+    budget = 8 << 20  # tight enough that the scan MUST stream
+    with tempfile.TemporaryDirectory(prefix="bench-storage-") as root:
+        rng = np.random.default_rng(7)
+        # k sorted -> narrow per-row-group footer stats, so the
+        # selective pass shows what min/max pruning is worth
+        k = np.arange(n, dtype=np.int64)
+        v = rng.integers(0, 1000, n, dtype=np.int64)
+        p = (k * 13) % 4
+        write_parquet_table(
+            root, "default", "events",
+            TableSchema(
+                "events",
+                [("k", T.BIGINT), ("v", T.BIGINT), ("p", T.BIGINT)],
+            ),
+            {"k": k, "v": v, "p": p},
+            row_group_size=100_000, partition_by=["p"],
+        )
+        runner = QueryRunner.parquet(root)
+        runner.session.properties["hbm_budget_bytes"] = budget
+        full_sql = (
+            "select p, count(*), sum(v) from events group by p"
+        )
+        runner.execute(full_sql)  # warmup: compile the stream chain
+        med, _, _ = timed_runs(lambda: runner.execute(full_sql), 3)
+        entry = runner.executor.scan_log[-1]
+        detail["storage_rows"] = n
+        detail["storage_budget_bytes"] = budget
+        detail["storage_stream_rows_per_s"] = round(n / med, 1)
+        detail["storage_stream_batches"] = entry["batches"]
+        # selective pass: ~5% of k -> most row groups pruned before
+        # any page decode; the rate stays over the LOGICAL n rows
+        lo, hi = int(n * 0.50), int(n * 0.55)
+        sel_sql = (
+            "select p, count(*), sum(v) from events "
+            f"where k >= {lo} and k < {hi} group by p"
+        )
+        runner.execute(sel_sql)
+        med_sel, _, _ = timed_runs(lambda: runner.execute(sel_sql), 3)
+        entry = runner.executor.scan_log[-1]
+        detail["storage_pushdown_rows_per_s"] = round(n / med_sel, 1)
+        detail["storage_rowgroups_total"] = entry["rowgroups_total"]
+        detail["storage_rowgroups_pruned"] = entry["rowgroups_pruned"]
+        # partition-directory pruning: a p=… equality skips 3/4 files
+        runner.execute(
+            "select count(*), sum(v) from events where p = 2"
+        )
+        detail["storage_partitions_pruned"] = (
+            runner.executor.scan_log[-1]["partitions_pruned"]
+        )
+        detail["storage_peak_bytes"] = int(
+            runner.executor.memory_pool.peak_bytes
+        )
 
 
 def _serving_section(detail) -> None:

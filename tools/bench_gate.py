@@ -53,6 +53,10 @@ _RATE_KEYS = [
     ("detail.serving_qps", True),
     ("detail.serving_p95_ms", False),
     ("detail.serving_p99_ms", False),
+    # storage keys (BENCH_r06+, ``bench.py --storage``): SKIP against
+    # baselines that predate the out-of-core streamed scan tier
+    ("detail.storage_stream_rows_per_s", True),
+    ("detail.storage_pushdown_rows_per_s", True),
 ]
 
 #: compile-count keys: lower is better, absolute slack not a pure band

@@ -41,11 +41,29 @@ class TableSchema:
 @dataclass(frozen=True)
 class Split:
     """A row range of a table — the unit of source parallelism
-    (SPI/connector/ConnectorSplit.java analog)."""
+    (SPI/connector/ConnectorSplit.java analog).
+
+    ``size_bytes`` is the estimated storage footprint of the range
+    (0 = unknown) so schedulers can balance by bytes, not rows.
+    ``stats`` carries per-column (name, lo, hi) storage-domain bounds
+    from file footers — the scheduler-side pruning surface: a consumer
+    holding a ColumnDomain may drop a split whose bounds are disjoint
+    without ever opening the file."""
 
     table: str
     start: int
     count: int
+    size_bytes: int = 0
+    stats: tuple = ()
+
+    def disjoint(self, domains: dict) -> bool:
+        """True when any domain is provably disjoint with this split's
+        column bounds (pruning-safe: unknown columns never prune)."""
+        for col, lo, hi in self.stats:
+            dom = domains.get(col)
+            if dom is not None and dom.disjoint(lo, hi):
+                return True
+        return False
 
 
 @dataclass(frozen=True)
@@ -195,7 +213,13 @@ class Connector:
         Default: delegate to table_stats."""
         return self.table_stats(schema, table).columns.get(column)
 
-    def splits(self, schema: str, table: str, target_splits: int) -> list[Split]:
+    def splits(
+        self, schema: str, table: str, target_splits: int,
+        domains: dict | None = None,
+    ) -> list[Split]:
+        """Enumerate splits. ``domains`` (column -> ColumnDomain) lets a
+        supports_domains connector prune storage units at enumeration
+        time; the default row-range division ignores it."""
         n = self.row_count(schema, table)
         target_splits = max(1, target_splits)
         per = -(-n // target_splits)

@@ -1,25 +1,48 @@
-"""Parquet files connector: columnar file ingest to device pages.
+"""Parquet files connector: out-of-core columnar storage scans.
 
 The analog of the reference's Hive-style file connectors sitting on
 lib/trino-parquet (ParquetReader,
-lib/trino-parquet/.../reader/ParquetReader.java:85): a directory tree
-``root/<schema>/<table>.parquet`` is exposed as catalog tables; scans
-read only the projected columns (projection pushdown into the arrow
-reader), nulls become validity masks, decimals become unscaled int64,
-dates become int32 days — the engine's device page layout.
+lib/trino-parquet/.../reader/ParquetReader.java:85). Two layouts are
+exposed as catalog tables:
 
-Row counts come from file metadata without touching data pages, the
-footer-stats analog of the reference's stripe/rowgroup pruning.
+- ``root/<schema>/<table>.parquet`` — a single file (legacy layout);
+- ``root/<schema>/<table>/<key>=<value>/.../*.parquet`` — a Hive-style
+  partitioned directory tree; the ``key=value`` path segments become
+  synthesized partition columns appended to the file schema.
+
+A per-table *manifest* (file list + per-row-group footer stats, global
+row offsets) is built once from metadata only — no data page is
+touched. The manifest defines a global row order (files sorted by
+relative path), so a ``Split`` stays a plain ``(start, count)`` row
+range and the whole engine's split plumbing (serde, fleet binding,
+streamed chunking) works unchanged; the connector maps any row range
+back to the covering row groups at read time.
+
+Pushdown happens at three levels, mirroring the reference:
+- projection: only requested columns are decoded (ParquetReader column
+  projection);
+- partition pruning: ``key=value`` directories disjoint with a column
+  domain are skipped without opening any file (HivePartitionManager);
+- row-group pruning: footer min/max statistics disjoint with a domain
+  skip the row group (TupleDomain → ParquetPredicate stripe pruning).
+
+Nulls become validity masks, short decimals unscaled int64, decimals
+with precision > 18 the engine's two-limb ``[n, 2]`` int64 layout,
+dates int32 days, timestamps int64 micros — the device page layout.
 """
 
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass, field
 
 import numpy as np
 
+from trino_tpu import telemetry
 from trino_tpu import types as T
-from trino_tpu.connectors.base import Connector, Split, TableSchema
+from trino_tpu.connectors.base import (
+    ColumnStats, Connector, Split, TableSchema, TableStats,
+)
 
 __all__ = ["ParquetConnector", "write_parquet_table"]
 
@@ -49,10 +72,8 @@ def _type_from_arrow(t) -> T.DataType:
     if pa.types.is_float64(t):
         return T.DOUBLE
     if pa.types.is_decimal(t):
-        if t.precision > 18:
-            raise NotImplementedError(
-                f"decimal precision {t.precision} > 18"
-            )
+        # precision > 18 maps onto the engine's two-limb decimal(38)
+        # host layout; DecimalType itself validates precision <= 38
         return T.DecimalType(t.precision, t.scale)
     if pa.types.is_date32(t):
         return T.DATE
@@ -67,21 +88,64 @@ def _type_from_arrow(t) -> T.DataType:
     raise NotImplementedError(f"parquet type {t}")
 
 
+@dataclass
+class _RowGroup:
+    """One row group of one file, addressed in GLOBAL row order."""
+
+    index: int          #: row-group index within its file
+    start: int          #: global row offset
+    count: int
+    size_bytes: int
+    #: column -> (lo, hi) in storage domain, footer min/max only
+    stats: dict = field(default_factory=dict)
+
+
+@dataclass
+class _FileEntry:
+    path: str
+    start: int          #: global row offset of the file's first row
+    count: int
+    #: partition column -> typed value parsed from key=value segments
+    partition: dict = field(default_factory=dict)
+    row_groups: list = field(default_factory=list)
+
+
+@dataclass
+class _Manifest:
+    files: list
+    row_count: int
+    #: [(name, DataType)] for synthesized partition columns
+    partition_cols: list
+    total_bytes: int
+    rowgroups_total: int
+
+
 class ParquetConnector(Connector):
-    #: scan() accepts ColumnDomains and prunes rowgroups by footer
-    #: min/max statistics (ParquetReader's predicate pushdown,
-    #: lib/trino-parquet/.../reader/ParquetReader.java:85)
+    #: scan()/splits() accept ColumnDomains and prune partitions +
+    #: rowgroups by footer statistics (ParquetReader's predicate
+    #: pushdown, lib/trino-parquet/.../reader/ParquetReader.java:85)
     supports_domains = True
 
-    def __init__(self, root: str):
+    #: scans can be iterated split-by-split without materializing the
+    #: table — the executor may route through exec/stream_scan.py
+    streamable = True
+
+    def __init__(self, root: str, split_target_bytes: int = 64 << 20):
         self.root = root
+        #: coalescing ceiling for splits() (Hive max-split-size analog)
+        self.split_target_bytes = split_target_bytes
         self._schema_cache: dict[tuple[str, str], TableSchema] = {}
-        #: metrics of the LAST pruned scan (tests + EXPLAIN ANALYZE —
-        #: the connector Metrics SPI analog, SPI/metrics/Metrics.java)
+        self._manifest_cache: dict[tuple[str, str], _Manifest] = {}
+        #: metrics of the LAST pruned scan / split enumeration (tests +
+        #: EXPLAIN ANALYZE — the connector Metrics SPI analog,
+        #: SPI/metrics/Metrics.java)
         self.scan_metrics: dict = {}
 
-    def _path(self, schema: str, table: str) -> str:
+    def _file_path(self, schema: str, table: str) -> str:
         return os.path.join(self.root, schema, f"{table}.parquet")
+
+    def _dir_path(self, schema: str, table: str) -> str:
+        return os.path.join(self.root, schema, table)
 
     # ---- metadata --------------------------------------------------------
 
@@ -97,25 +161,279 @@ class ParquetConnector(Connector):
         d = os.path.join(self.root, schema)
         if not os.path.isdir(d):
             return []
-        return sorted(
-            f[:-8] for f in os.listdir(d) if f.endswith(".parquet")
+        out = set()
+        for f in os.listdir(d):
+            if f.endswith(".parquet"):
+                out.add(f[:-8])
+            elif os.path.isdir(os.path.join(d, f)):
+                out.add(f)
+        return sorted(out)
+
+    def invalidate(self, schema: str | None = None, table: str | None = None):
+        """Drop cached manifests/schemas (after an external write)."""
+        if schema is None:
+            self._schema_cache.clear()
+            self._manifest_cache.clear()
+        else:
+            self._schema_cache.pop((schema, table), None)
+            self._manifest_cache.pop((schema, table), None)
+
+    def _manifest(self, schema: str, table: str) -> _Manifest:
+        key = (schema, table)
+        m = self._manifest_cache.get(key)
+        if m is None:
+            m = self._build_manifest(schema, table)
+            self._manifest_cache[key] = m
+        return m
+
+    def _data_files(self, schema: str, table: str) -> list[str]:
+        """Data file paths in global row order (sorted relative path)."""
+        single = self._file_path(schema, table)
+        if os.path.isfile(single):
+            return [single]
+        d = self._dir_path(schema, table)
+        if not os.path.isdir(d):
+            raise FileNotFoundError(single)
+        found = []
+        for base, _dirs, names in os.walk(d):
+            for n in names:
+                if n.endswith(".parquet"):
+                    found.append(os.path.join(base, n))
+        if not found:
+            raise FileNotFoundError(f"no parquet files under {d}")
+        return sorted(found)
+
+    def _build_manifest(self, schema: str, table: str) -> _Manifest:
+        _, pq = _arrow()
+        paths = self._data_files(schema, table)
+        d = self._dir_path(schema, table)
+        # partition keys from key=value path segments; value type is
+        # BIGINT only when EVERY file's value parses as int
+        raw_parts: list[dict[str, str]] = []
+        for p in paths:
+            parts = {}
+            rel = os.path.relpath(os.path.dirname(p), d)
+            if rel != "." and not os.path.isfile(
+                self._file_path(schema, table)
+            ):
+                for seg in rel.split(os.sep):
+                    if "=" in seg:
+                        k, _, v = seg.partition("=")
+                        parts[k] = v
+            raw_parts.append(parts)
+        pkeys = list(dict.fromkeys(k for rp in raw_parts for k in rp))
+        ptypes = {}
+        for k in pkeys:
+            vals = [rp.get(k) for rp in raw_parts]
+            if any(v is None for v in vals):
+                raise ValueError(
+                    f"partition key {k!r} missing from some files of "
+                    f"{schema}.{table}"
+                )
+            try:
+                [int(v) for v in vals]
+                ptypes[k] = T.BIGINT
+            except ValueError:
+                ptypes[k] = T.VARCHAR
+        base_schema = self._file_table_schema(schema, table, paths[0])
+        files = []
+        start = 0
+        total_bytes = 0
+        rg_total = 0
+        for p, rp in zip(paths, raw_parts):
+            md = pq.ParquetFile(p).metadata
+            part = {
+                k: (int(rp[k]) if ptypes[k] is T.BIGINT else rp[k])
+                for k in pkeys
+            }
+            fe = _FileEntry(p, start, md.num_rows, part)
+            name_to_idx = {
+                md.row_group(0).column(j).path_in_schema: j
+                for j in range(md.row_group(0).num_columns)
+            } if md.num_row_groups else {}
+            for i in range(md.num_row_groups):
+                rg = md.row_group(i)
+                stats = {}
+                for cname, j in name_to_idx.items():
+                    st = rg.column(j).statistics
+                    if st is None or not st.has_min_max:
+                        continue
+                    try:
+                        t = base_schema.column_type(cname)
+                    except KeyError:
+                        continue
+                    stats[cname] = (
+                        _stat_to_storage(st.min, t),
+                        _stat_to_storage(st.max, t),
+                    )
+                # partition values are exact single-value bounds
+                for k, v in part.items():
+                    stats[k] = (v, v)
+                nbytes = rg.total_byte_size
+                fe.row_groups.append(
+                    _RowGroup(i, start, rg.num_rows, nbytes, stats)
+                )
+                start += rg.num_rows
+                total_bytes += nbytes
+                rg_total += 1
+            files.append(fe)
+        return _Manifest(
+            files, start, [(k, ptypes[k]) for k in pkeys],
+            total_bytes, rg_total,
         )
+
+    def _file_table_schema(
+        self, schema: str, table: str, path: str
+    ) -> TableSchema:
+        _, pq = _arrow()
+        meta = pq.read_schema(path)
+        return TableSchema(table, [
+            (name, _type_from_arrow(meta.field(name).type))
+            for name in meta.names
+        ])
 
     def table_schema(self, schema: str, table: str) -> TableSchema:
         key = (schema, table)
         if key not in self._schema_cache:
-            _, pq = _arrow()
-            meta = pq.read_schema(self._path(schema, table))
-            cols = [
-                (name, _type_from_arrow(meta.field(name).type))
-                for name in meta.names
+            m = self._manifest(schema, table)
+            ts = self._file_table_schema(schema, table, m.files[0].path)
+            cols = list(ts.columns) + [
+                (k, t) for k, t in m.partition_cols
+                if k not in ts.column_names
             ]
             self._schema_cache[key] = TableSchema(table, cols)
         return self._schema_cache[key]
 
     def row_count(self, schema: str, table: str) -> int:
-        _, pq = _arrow()
-        return pq.ParquetFile(self._path(schema, table)).metadata.num_rows
+        return self._manifest(schema, table).row_count
+
+    def table_stats(self, schema: str, table: str) -> TableStats:
+        """Row count + exact per-column min/max merged from footers (no
+        data pages touched) — feeds join ordering and df_range_keep."""
+        m = self._manifest(schema, table)
+        merged: dict[str, list] = {}
+        counted: dict[str, int] = {}
+        for fe in m.files:
+            for rg in fe.row_groups:
+                for c, (lo, hi) in rg.stats.items():
+                    if lo is None or hi is None or isinstance(lo, str):
+                        continue
+                    cur = merged.get(c)
+                    if cur is None:
+                        merged[c] = [lo, hi]
+                    else:
+                        cur[0] = min(cur[0], lo)
+                        cur[1] = max(cur[1], hi)
+                    counted[c] = counted.get(c, 0) + rg.count
+        cols = {
+            c: ColumnStats(lo=v[0], hi=v[1])
+            for c, v in merged.items()
+            # only exact bounds: every row group must have reported
+            if counted.get(c, 0) == m.row_count
+        }
+        return TableStats(float(m.row_count), cols)
+
+    # ---- splits ----------------------------------------------------------
+
+    def splits(
+        self, schema: str, table: str, target_splits: int,
+        domains: dict | None = None,
+    ) -> list[Split]:
+        """One Split per surviving row group, coalesced to a byte
+        target (the Hive split model: HiveSplitSource + max-split-size
+        coalescing). ``domains`` prunes partitions and row groups from
+        footer stats before any split exists; never coalesces across a
+        pruned or non-adjacent row group, so a split's row range reads
+        back exactly its surviving row groups."""
+        m = self._manifest(schema, table)
+        domains = domains or {}
+        pruned_partitions: set[tuple] = set()
+        all_partitions: set[tuple] = set()
+        survivors: list[_RowGroup] = []
+        rg_pruned = 0
+        live_bytes = 0
+        for fe in m.files:
+            pkey = tuple(sorted(fe.partition.items()))
+            if fe.partition:
+                all_partitions.add(pkey)
+            if fe.partition and any(
+                dom is not None and dom.disjoint(
+                    fe.partition[k], fe.partition[k]
+                )
+                for k, dom in domains.items() if k in fe.partition
+            ):
+                pruned_partitions.add(pkey)
+                continue
+            for rg in fe.row_groups:
+                if any(
+                    dom is not None and c in rg.stats
+                    and dom.disjoint(*rg.stats[c])
+                    for c, dom in domains.items()
+                ):
+                    rg_pruned += 1
+                    continue
+                survivors.append(rg)
+                live_bytes += rg.size_bytes
+        target_splits = max(1, target_splits)
+        target_bytes = min(
+            self.split_target_bytes,
+            max(1, -(-live_bytes // target_splits)),
+        )
+        out: list[Split] = []
+        cur: list[_RowGroup] = []
+        cur_bytes = 0
+
+        def _flush():
+            nonlocal cur, cur_bytes
+            if not cur:
+                return
+            stats: dict[str, list] = {}
+            # merge bounds; a column must appear in EVERY member to
+            # stay (a missing footer stat means unknown, not empty)
+            common = set(cur[0].stats)
+            for rg in cur[1:]:
+                common &= set(rg.stats)
+            for c in common:
+                los = [rg.stats[c][0] for rg in cur]
+                his = [rg.stats[c][1] for rg in cur]
+                if any(v is None for v in los + his):
+                    continue
+                try:
+                    stats[c] = [min(los), max(his)]
+                except TypeError:
+                    continue
+            out.append(Split(
+                table, cur[0].start, sum(rg.count for rg in cur),
+                size_bytes=cur_bytes,
+                stats=tuple(
+                    (c, lo, hi) for c, (lo, hi) in sorted(stats.items())
+                ),
+            ))
+            cur, cur_bytes = [], 0
+
+        for rg in survivors:
+            adjacent = bool(cur) and cur[-1].start + cur[-1].count == rg.start
+            if cur and (
+                not adjacent or cur_bytes + rg.size_bytes > target_bytes
+            ):
+                _flush()
+            cur.append(rg)
+            cur_bytes += rg.size_bytes
+        _flush()
+        self.scan_metrics = {
+            "rowgroups_total": m.rowgroups_total,
+            "rowgroups_read": len(survivors),
+            "rowgroups_pruned": rg_pruned,
+            "partitions_total": len(all_partitions),
+            "partitions_pruned": len(pruned_partitions),
+            "splits": len(out),
+        }
+        telemetry.SCAN_ROWGROUPS_TOTAL.inc(m.rowgroups_total, table=table)
+        telemetry.SCAN_ROWGROUPS_PRUNED.inc(rg_pruned, table=table)
+        telemetry.SCAN_PARTITIONS_PRUNED.inc(
+            len(pruned_partitions), table=table
+        )
+        return out or [Split(table, 0, 0)]
 
     # ---- scan ------------------------------------------------------------
 
@@ -123,65 +441,199 @@ class ParquetConnector(Connector):
         self, schema: str, table: str, columns: list[str],
         split: Split | None = None, domains=None,
     ):
-        _, pq = _arrow()
+        """Produce host arrays for the requested columns.
+
+        ``split`` may be ANY global row range — not just one produced
+        by splits(): the streamed-chunk reader slices uniform chunks.
+        Only row groups overlapping the range are decoded; ``domains``
+        additionally skips stats-disjoint row groups (pruning-safe: the
+        engine re-applies the full filter)."""
+        m = self._manifest(schema, table)
         ts = self.table_schema(schema, table)
-        if domains and split is None:
-            tbl = self._read_pruned(schema, table, columns, domains)
-        else:
-            tbl = pq.read_table(
-                self._path(schema, table), columns=list(columns)
+        lo = 0 if split is None else split.start
+        hi = m.row_count if split is None else min(
+            m.row_count, split.start + split.count
+        )
+        domains = domains or {}
+        pcols = {k for k, _ in m.partition_cols}
+        file_cols = [c for c in columns if c not in pcols]
+        pieces: list[tuple[int, dict]] = []  # (n_rows, col -> host)
+        rg_total = 0
+        rg_read = 0
+        parts_pruned: set[tuple] = set()
+        bytes_read = 0
+        for fe in m.files:
+            if fe.start >= hi or fe.start + fe.count <= lo:
+                continue
+            rg_total += len(fe.row_groups)
+            if fe.partition and any(
+                dom is not None and dom.disjoint(
+                    fe.partition[k], fe.partition[k]
+                )
+                for k, dom in domains.items() if k in fe.partition
+            ):
+                parts_pruned.add(tuple(sorted(fe.partition.items())))
+                continue
+            keep = []
+            for rg in fe.row_groups:
+                if rg.start >= hi or rg.start + rg.count <= lo:
+                    continue
+                if any(
+                    dom is not None and c in rg.stats
+                    and dom.disjoint(*rg.stats[c])
+                    for c, dom in domains.items()
+                ):
+                    continue
+                keep.append(rg)
+            if not keep:
+                continue
+            rg_read += len(keep)
+            bytes_read += sum(rg.size_bytes for rg in keep)
+            n, cols = self._read_file_rowgroups(fe, keep, file_cols, ts, lo, hi)
+            if n == 0:
+                continue
+            for k, t in m.partition_cols:
+                if k in columns and k not in cols:
+                    cols[k] = _const_column(fe.partition[k], t, n)
+            pieces.append((n, cols))
+        telemetry.SCAN_BYTES_READ.inc(bytes_read, table=table)
+        if split is None and domains:
+            # whole-table pruned scan: report connector metrics the way
+            # the legacy single-file path always did
+            self.scan_metrics = {
+                "rowgroups_total": rg_total,
+                "rowgroups_read": rg_read,
+                "rowgroups_pruned": rg_total - rg_read
+                - sum(
+                    len(fe.row_groups) for fe in m.files
+                    if tuple(sorted(fe.partition.items())) in parts_pruned
+                ),
+                "partitions_pruned": len(parts_pruned),
+            }
+            telemetry.SCAN_ROWGROUPS_TOTAL.inc(rg_total, table=table)
+            telemetry.SCAN_ROWGROUPS_PRUNED.inc(
+                self.scan_metrics["rowgroups_pruned"], table=table
             )
-            if split is not None:
-                tbl = tbl.slice(split.start, split.count)
-        out = {}
-        for c in columns:
-            arr = tbl.column(c).combine_chunks()
-            out[c] = _to_host(arr, ts.column_type(c))
-        return out
+            telemetry.SCAN_PARTITIONS_PRUNED.inc(
+                len(parts_pruned), table=table
+            )
+        return _concat_pieces(pieces, columns, ts)
 
-    def _read_pruned(self, schema: str, table: str, columns, domains):
-        """Read only the rowgroups whose footer min/max stats can
-        intersect every column domain (stripe/rowgroup pruning,
-        lib/trino-parquet predicate pushdown: a disjoint rowgroup
-        cannot contribute rows — NULLs never satisfy a comparison)."""
+    def _read_file_rowgroups(
+        self, fe: _FileEntry, keep: list, file_cols: list,
+        ts: TableSchema, lo: int, hi: int,
+    ):
+        """Decode the kept row groups of one file, sliced to the global
+        [lo, hi) range; returns (n_rows, col -> host arrays)."""
         _, pq = _arrow()
-        ts = self.table_schema(schema, table)
-        pf = pq.ParquetFile(self._path(schema, table))
-        md = pf.metadata
-        name_to_idx = {
-            md.row_group(0).column(j).path_in_schema: j
-            for j in range(md.row_group(0).num_columns)
-        } if md.num_row_groups else {}
-        keep = []
-        for i in range(md.num_row_groups):
-            rg = md.row_group(i)
-            skip = False
-            for cname, dom in domains.items():
-                j = name_to_idx.get(cname)
-                if j is None:
-                    continue
-                st = rg.column(j).statistics
-                if st is None or not st.has_min_max:
-                    continue
-                t = ts.column_type(cname)
-                lo = _stat_to_storage(st.min, t)
-                hi = _stat_to_storage(st.max, t)
-                if dom.disjoint(lo, hi):
-                    skip = True
-                    break
-            if not skip:
-                keep.append(i)
-        self.scan_metrics = {
-            "rowgroups_total": md.num_row_groups,
-            "rowgroups_read": len(keep),
-        }
-        import pyarrow as pa
+        # kept row groups are contiguous-or-not; read them as one arrow
+        # table (global offsets of each are known, so edge-slice per
+        # contiguous run)
+        runs: list[list] = []
+        for rg in keep:
+            if runs and runs[-1][-1].index + 1 == rg.index and (
+                runs[-1][-1].start + runs[-1][-1].count == rg.start
+            ):
+                runs[-1].append(rg)
+            else:
+                runs.append([rg])
+        pf = pq.ParquetFile(fe.path)
+        n_total = 0
+        per_col: dict[str, list] = {c: [] for c in file_cols}
+        for run in runs:
+            run_start = run[0].start
+            run_count = sum(rg.count for rg in run)
+            off = max(0, lo - run_start)
+            take = min(run_start + run_count, hi) - max(run_start, lo)
+            if take <= 0:
+                continue
+            if file_cols:
+                tbl = pf.read_row_groups(
+                    [rg.index for rg in run], columns=list(file_cols)
+                )
+                if off or take != run_count:
+                    tbl = tbl.slice(off, take)
+                for c in file_cols:
+                    per_col[c].append(tbl.column(c))
+            n_total += take
+        out = {}
+        for c in file_cols:
+            arrs = per_col[c]
+            if not arrs:
+                continue
+            out[c] = _to_host(
+                _combine_arrow(arrs), ts.column_type(c)
+            )
+        return n_total, out
 
-        if not keep:
-            return pa.schema(
-                [(c, pf.schema_arrow.field(c).type) for c in columns]
-            ).empty_table()
-        return pf.read_row_groups(keep, columns=list(columns))
+    def _read_pruned(self, schema, table, columns, domains):
+        """Back-compat shim: whole-table domain-pruned read."""
+        return self.scan(schema, table, columns, domains=domains)
+
+
+def _combine_arrow(arrs):
+    """Chunked/plain arrow arrays -> one contiguous Array."""
+    import pyarrow as pa
+
+    chunks = []
+    for a in arrs:
+        if isinstance(a, pa.ChunkedArray):
+            chunks.extend(a.chunks)
+        else:
+            chunks.append(a)
+    if len(chunks) == 1:
+        return chunks[0]
+    return pa.chunked_array(chunks).combine_chunks()
+
+
+def _const_column(value, t: T.DataType, n: int):
+    """Synthesize a partition column as n copies of its value."""
+    if isinstance(t, T.VarcharType):
+        out = np.empty(n, dtype=object)
+        out[:] = value
+        return out
+    return np.full(n, value, dtype=t.np_dtype)
+
+
+def _empty_host(t: T.DataType):
+    if isinstance(t, T.VarcharType):
+        return np.empty(0, dtype=object)
+    if isinstance(t, T.DecimalType) and t.is_long:
+        return np.empty((0, 2), dtype=np.int64)
+    return np.empty(0, dtype=t.np_dtype)
+
+
+def _concat_pieces(pieces, columns, ts: TableSchema):
+    """Stitch per-file host fragments into one (values, valid|None)
+    dict, preserving global row order (pieces arrive ordered)."""
+    if not pieces:
+        return {c: _empty_host(ts.column_type(c)) for c in columns}
+    if len(pieces) == 1:
+        n, cols = pieces[0]
+        return {c: cols[c] for c in columns}
+    out = {}
+    for c in columns:
+        vals_parts = []
+        valid_parts = []
+        any_null = False
+        for n, cols in pieces:
+            v = cols[c]
+            if isinstance(v, tuple):
+                vals, valid = v
+                if valid is None:
+                    valid = np.ones(len(vals), dtype=bool)
+                else:
+                    any_null = True
+            else:
+                vals, valid = v, np.ones(len(v), dtype=bool)
+            vals_parts.append(vals)
+            valid_parts.append(valid)
+        vals = np.concatenate(vals_parts)
+        if any_null:
+            out[c] = (vals, np.concatenate(valid_parts))
+        else:
+            out[c] = vals
+    return out
 
 
 def _stat_to_storage(v, t: T.DataType):
@@ -213,6 +665,19 @@ def _to_host(arr, t: T.DataType):
         vals = np.asarray(
             ["" if v is None else v for v in arr.to_pylist()], dtype=object
         )
+    elif isinstance(t, T.DecimalType) and t.is_long:
+        import pyarrow as pa
+
+        # two-limb [n, 2] int64: hi = unscaled >> 32 (floor), lo = low
+        # 32 bits — the engine's decimal(38) device layout
+        unscaled = arr.cast(pa.decimal128(38, t.scale))
+        vals = np.zeros((len(arr), 2), dtype=np.int64)
+        for i, v in enumerate(unscaled.to_pylist()):
+            if v is None:
+                continue
+            u = int(v.scaleb(t.scale))
+            vals[i, 0] = u >> 32
+            vals[i, 1] = u & 0xFFFFFFFF
     elif isinstance(t, T.DecimalType):
         import pyarrow as pa
 
@@ -240,14 +705,10 @@ def _to_host(arr, t: T.DataType):
     return vals if valid is None else (vals, valid)
 
 
-def write_parquet_table(
-    root: str, schema: str, table: str, table_schema: TableSchema,
-    columns: dict, row_group_size: int | None = None,
-):
-    """Write host columns as one parquet file (the export half of the
-    ingest path; the reference writes via ParquetWriter)."""
-    pa, pq = _arrow()
-    os.makedirs(os.path.join(root, schema), exist_ok=True)
+def _columns_to_arrow(table_schema: TableSchema, columns: dict, sel=None):
+    """Host columns -> (arrays, names) for the columns present in
+    ``table_schema``, optionally row-selected by boolean mask ``sel``."""
+    pa, _ = _arrow()
     arrays = []
     names = []
     for c, t in table_schema.columns:
@@ -255,17 +716,31 @@ def write_parquet_table(
         valid = None
         if isinstance(vals, tuple):
             vals, valid = vals
+        vals = np.asarray(vals)
+        if sel is not None:
+            vals = vals[sel]
+            valid = None if valid is None else np.asarray(valid)[sel]
         mask = None if valid is None else ~np.asarray(valid, dtype=bool)
         if isinstance(t, T.VarcharType):
             arr = pa.array(list(vals), type=pa.string(), mask=mask)
         elif isinstance(t, T.DecimalType):
             import decimal
 
-            py = [
-                decimal.Decimal(int(v)).scaleb(-t.scale)
-                for v in np.asarray(vals)
-            ]
-            arr = pa.array(py, type=pa.decimal128(t.precision, t.scale), mask=mask)
+            if t.is_long and vals.ndim == 2:
+                # two-limb [n, 2] input: unscaled = hi * 2^32 + lo
+                py = [
+                    decimal.Decimal(
+                        int(v[0]) * (1 << 32) + int(v[1])
+                    ).scaleb(-t.scale)
+                    for v in vals
+                ]
+            else:
+                py = [
+                    decimal.Decimal(int(v)).scaleb(-t.scale) for v in vals
+                ]
+            arr = pa.array(
+                py, type=pa.decimal128(t.precision, t.scale), mask=mask
+            )
         elif isinstance(t, T.DateType):
             arr = pa.array(
                 np.asarray(vals, dtype=np.int32), type=pa.date32(), mask=mask
@@ -276,12 +751,64 @@ def write_parquet_table(
                 type=pa.timestamp("us"), mask=mask,
             )
         else:
-            arr = pa.array(np.asarray(vals), mask=mask)
+            arr = pa.array(vals, mask=mask)
         arrays.append(arr)
         names.append(c)
+    return arrays, names
+
+
+def write_parquet_table(
+    root: str, schema: str, table: str, table_schema: TableSchema,
+    columns: dict, row_group_size: int | None = None,
+    partition_by: list[str] | None = None,
+):
+    """Write host columns as parquet (the export half of the ingest
+    path; the reference writes via ParquetWriter).
+
+    Without ``partition_by``: one file ``root/schema/table.parquet``.
+    With it: a Hive-style tree ``root/schema/table/<key>=<value>/
+    part-<i>.parquet``, one file per distinct partition tuple, with the
+    partition columns elided from the files (they live in the path)."""
+    pa, pq = _arrow()
     kw = {} if row_group_size is None else {"row_group_size": row_group_size}
-    pq.write_table(
-        pa.Table.from_arrays(arrays, names=names),
-        os.path.join(root, schema, f"{table}.parquet"),
-        **kw,
-    )
+    if not partition_by:
+        os.makedirs(os.path.join(root, schema), exist_ok=True)
+        arrays, names = _columns_to_arrow(table_schema, columns)
+        pq.write_table(
+            pa.Table.from_arrays(arrays, names=names),
+            os.path.join(root, schema, f"{table}.parquet"),
+            **kw,
+        )
+        return
+    for k in partition_by:
+        t = table_schema.column_type(k)
+        if not (t.is_integer or isinstance(t, T.VarcharType)):
+            raise ValueError(
+                f"partition column {k!r} must be integer or varchar"
+            )
+    file_schema = TableSchema(table, [
+        (c, t) for c, t in table_schema.columns if c not in partition_by
+    ])
+    pvals = []
+    for k in partition_by:
+        v = columns[k]
+        if isinstance(v, tuple):
+            v = v[0]
+        pvals.append(np.asarray(v))
+    n = len(pvals[0])
+    keys = list(zip(*(v.tolist() for v in pvals)))
+    for i, combo in enumerate(sorted(set(keys))):
+        sel = np.fromiter(
+            (key == combo for key in keys), dtype=bool, count=n
+        )
+        d = os.path.join(
+            root, schema, table,
+            *(f"{k}={v}" for k, v in zip(partition_by, combo)),
+        )
+        os.makedirs(d, exist_ok=True)
+        arrays, names = _columns_to_arrow(file_schema, columns, sel=sel)
+        pq.write_table(
+            pa.Table.from_arrays(arrays, names=names),
+            os.path.join(d, f"part-{i:05d}.parquet"),
+            **kw,
+        )

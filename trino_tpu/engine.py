@@ -144,6 +144,22 @@ class QueryRunner:
             md, Session(catalog="tpcds", schema=schema), mesh=mesh
         )
 
+    @staticmethod
+    def parquet(
+        root: str, schema: str = "default", mesh=None,
+        catalog: str = "hive",
+    ) -> "QueryRunner":
+        """Runner over a parquet directory tree (the HiveQueryRunner
+        analog): ``root/<schema>/<table>.parquet`` files or Hive-style
+        ``root/<schema>/<table>/<key>=<value>/`` partition trees."""
+        from trino_tpu.connectors.parquet import ParquetConnector
+
+        md = Metadata()
+        md.register_catalog(catalog, ParquetConnector(root))
+        return QueryRunner(
+            md, Session(catalog=catalog, schema=schema), mesh=mesh
+        )
+
     # ---- planning --------------------------------------------------------
 
     def plan_stmt(self, stmt: ast.Statement, optimized: bool = True) -> P.PlanNode:
@@ -787,6 +803,7 @@ class QueryRunner:
             from trino_tpu.profiler import OperatorProfiler
 
             ex.profiler = own_prof = OperatorProfiler()
+        scan0 = len(getattr(ex, "scan_log", None) or [])
         try:
             t0 = time.perf_counter()
             page = ex.execute(plan)
@@ -850,6 +867,23 @@ class QueryRunner:
                 f"bucket escalations: "
                 f"{getattr(ex, 'exchange_escalations', 0) - esc0}"
             )
+        for entry in (getattr(ex, "scan_log", None) or [])[scan0:]:
+            # storage pushdown effectiveness (the connector-metrics
+            # lines Trino's EXPLAIN ANALYZE renders per scan)
+            parts = [
+                f"Scan {entry.get('table', '?')}: "
+                f"{entry.get('rowgroups_pruned', 0)}/"
+                f"{entry.get('rowgroups_total', 0)} row groups pruned",
+            ]
+            if entry.get("partitions_pruned"):
+                parts.append(
+                    f"{entry['partitions_pruned']} partitions pruned"
+                )
+            if entry.get("streamed"):
+                parts.append(
+                    f"streamed in {entry.get('batches', 0)} batches"
+                )
+            lines.append(", ".join(parts))
         lines.extend(
             _annotated_tree(plan, stats, profile=profile).splitlines()
         )

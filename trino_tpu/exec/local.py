@@ -101,6 +101,10 @@ class LocalExecutor:
         #: dynamic-filter effectiveness log (tests + EXPLAIN ANALYZE):
         #: [{rows_in, rows_kept, pairs}] per join probe this executor ran
         self.df_log: list[dict] = []
+        #: storage-scan pruning/streaming log (tests + EXPLAIN ANALYZE):
+        #: [{table, rowgroups_total, rowgroups_pruned, partitions_pruned,
+        #:   batches?, streamed?}] per pruned or streamed scan
+        self.scan_log: list[dict] = []
         #: worker-local memory pool: every device allocation path
         #: reserves through a MemoryContext rooted here, and the
         #: per-node cap (query_max_memory_per_node) is enforced at
@@ -191,6 +195,9 @@ class LocalExecutor:
             connector = None
         if connector is not None:
             scan_cache.SHARED.invalidate(connector, schema, table)
+            scan_cache.SHARED_SPLITS.invalidate(connector, schema, table)
+            if hasattr(connector, "invalidate"):
+                connector.invalidate(schema, table)
         for k in [
             k for k in self._jit_cache
             if isinstance(k, tuple) and k and k[0] in ("selectivity", "caps")
@@ -251,14 +258,24 @@ class LocalExecutor:
             while isinstance(cur, stage.FUSABLE):
                 chain.append(cur)
                 cur = cur.sources[0]
-            budget = self.hbm_budget()
-            if budget and isinstance(cur, P.TableScan):
-                from trino_tpu.exec import spill
+            if isinstance(cur, P.TableScan):
+                from trino_tpu.exec import stream_scan
 
-                if spill.scan_bytes(self.metadata, cur) > budget // 4:
-                    return spill.run_chain_streamed(
+                if stream_scan.eligible(self, cur):
+                    return stream_scan.run_chain_streamed(
                         self, list(reversed(chain)), cur
                     )
+                budget = self.hbm_budget()
+                if budget:
+                    from trino_tpu.exec import spill
+
+                    if spill.scan_bytes(self.metadata, cur) > budget // 4:
+                        return spill.run_chain_streamed(
+                            self, list(reversed(chain)), cur
+                        )
+                # not streaming (disabled or ineligible): the resident
+                # materialization must still fit the per-node cap
+                stream_scan.enforce_resident_fits(self, cur)
             base = self.execute(cur)
             return self._run_chain(list(reversed(chain)), base)
         m = getattr(self, f"_{type(node).__name__}", None)
@@ -894,6 +911,24 @@ class LocalExecutor:
             node.schema, node.table, list(node.assignments.values()),
             domains=domains,
         )
+        metrics = getattr(connector, "scan_metrics", None)
+        if metrics:
+            self.scan_log.append({
+                "table": f"{node.schema}.{node.table}",
+                "streamed": False,
+                "rowgroups_total": int(metrics.get("rowgroups_total", 0)),
+                "rowgroups_pruned": int(
+                    metrics.get(
+                        "rowgroups_pruned",
+                        metrics.get("rowgroups_total", 0)
+                        - metrics.get("rowgroups_read", 0),
+                    )
+                ),
+                "partitions_pruned": int(
+                    metrics.get("partitions_pruned", 0)
+                ),
+            })
+            del self.scan_log[:-100]  # bounded: executors outlive queries
         first = cols[next(iter(node.assignments.values()))]
         n = len(first[0] if isinstance(first, tuple) else first)
         cap = shapes.bucket(n, site="scan")
@@ -916,15 +951,28 @@ class LocalExecutor:
         parallelism). Split scans are not device-cached: a worker sees
         a different split per task, and fleet tables are read once per
         stage wave."""
-        from trino_tpu.connectors.base import Split
+        from trino_tpu.connectors.base import ColumnDomain, Split
 
         start, count = node.split
         connector = self.metadata.connector(node.catalog)
         split = Split(node.table, start, count)
+        kw = {}
+        if node.domains and getattr(connector, "supports_domains", False):
+            # pushed-down domains (static filters + coordinator-fed
+            # dynamic filters) prune row groups WITHIN this split; the
+            # filter above re-applies, so dropped rows stay exact
+            kw["domains"] = {
+                c: ColumnDomain(*dom) for c, dom in node.domains.items()
+            }
         cols = connector.scan(
             node.schema, node.table, list(node.assignments.values()),
-            split=split,
+            split=split, **kw,
         )
+        if node.assignments:
+            first = cols[next(iter(node.assignments.values()))]
+            n = len(first[0] if isinstance(first, tuple) else first)
+        else:
+            n = count
         cap = shapes.bucket(count, site="scan-split")
         hashed_syms = set(node.hash_varchar or [])
         names, columns = [], []
@@ -935,10 +983,10 @@ class LocalExecutor:
                 hashed=sym in hashed_syms,
             ))
         mask = np.zeros(cap, dtype=np.bool_)
-        mask[:count] = True
+        mask[:n] = True
         return Page(
             names, columns, jnp.asarray(mask),
-            known_rows=count, packed=True,
+            known_rows=n, packed=True,
         )
 
     def _Exchange(self, node: P.Exchange) -> Page:

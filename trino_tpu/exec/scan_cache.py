@@ -30,8 +30,9 @@ from __future__ import annotations
 
 import threading
 import weakref
+from collections import OrderedDict
 
-__all__ = ["ScanPageCache", "SHARED"]
+__all__ = ["ScanPageCache", "SplitBatchCache", "SHARED", "SHARED_SPLITS"]
 
 
 class ScanPageCache:
@@ -91,5 +92,107 @@ class ScanPageCache:
             self._by_connector.clear()
 
 
+class SplitBatchCache:
+    """Byte-bounded LRU for streamed-split host batches.
+
+    Whole-table identity caching (above) is exactly wrong for
+    out-of-core scans: pinning every page of an SF100 table would
+    recreate the memory problem streaming exists to avoid. Streamed
+    reads instead cache per-(connector, schema, table, row-range,
+    columns) host batches with an LRU bounded by total bytes, so a hot
+    working set (dimension tables, re-scanned probe splits) stays warm
+    while a single pass over a huge fact table churns through without
+    accumulating. Connector identity is part of the key via ``id()``
+    plus a weak finalizer that drops the connector's entries when it is
+    collected — same isolation contract as ScanPageCache without
+    pinning the connector alive."""
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self._watched: set[int] = set()
+
+    @staticmethod
+    def _size(batch: dict) -> int:
+        total = 0
+        for v in batch.values():
+            if isinstance(v, tuple):
+                for a in v:
+                    total += getattr(a, "nbytes", 0) or 0
+            else:
+                total += getattr(v, "nbytes", 0) or 0
+        return total
+
+    def _key(self, connector, schema, table, start, count, columns):
+        return (id(connector), schema, table, start, count, tuple(columns))
+
+    def get(self, connector, schema, table, start, count, columns):
+        from trino_tpu import telemetry
+
+        k = self._key(connector, schema, table, start, count, columns)
+        with self._lock:
+            batch = self._entries.get(k)
+            if batch is not None:
+                self._entries.move_to_end(k)
+                telemetry.SCAN_CACHE_HITS.inc(table=table)
+                return batch
+        telemetry.SCAN_CACHE_MISSES.inc(table=table)
+        return None
+
+    def put(self, connector, schema, table, start, count, columns, batch):
+        size = self._size(batch)
+        if size > self.max_bytes:
+            return  # a batch bigger than the cache would evict everything
+        k = self._key(connector, schema, table, start, count, columns)
+        with self._lock:
+            if id(connector) not in self._watched:
+                self._watched.add(id(connector))
+                weakref.finalize(
+                    connector, self._drop_connector, id(connector)
+                )
+            old = self._entries.pop(k, None)
+            if old is not None:
+                self._bytes -= self._size(old)
+            self._entries[k] = batch
+            self._bytes += size
+            while self._bytes > self.max_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= self._size(evicted)
+
+    def _drop_connector(self, cid: int) -> None:
+        with self._lock:
+            self._watched.discard(cid)
+            for k in [k for k in self._entries if k[0] == cid]:
+                self._bytes -= self._size(self._entries.pop(k))
+
+    def invalidate(self, connector, schema: str, table: str) -> None:
+        with self._lock:
+            dead = [
+                k for k in self._entries
+                if k[0] == id(connector) and k[1:3] == (schema, table)
+            ]
+            for k in dead:
+                self._bytes -= self._size(self._entries.pop(k))
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
 #: the process-wide cache every LocalExecutor scans through
 SHARED = ScanPageCache()
+
+#: the process-wide streamed-split host-batch cache
+SHARED_SPLITS = SplitBatchCache()

@@ -17,6 +17,7 @@ Sites (one string per architectural seam):
     ``task-exec``   worker stage-task execution (server/worker.py)
     ``device-oom``  memory reservations (memory.py MemoryPool)
     ``planner``     statement planning (engine.plan_stmt)
+    ``scan-read``   streamed storage split reads (exec/stream_scan.py)
 
 Schedules: ``arm`` (attempts 0..times-1 fail — the classic retry
 shape), ``arm_nth`` (exactly the n-th matching call fails), and
@@ -47,7 +48,7 @@ __all__ = [
 #: the closed set of injection sites (typo'd arms fail fast)
 SITES = frozenset(
     ["rpc", "spool-write", "spool-read", "task-exec", "device-oom",
-     "planner", "compile-deserialize"]
+     "planner", "compile-deserialize", "scan-read"]
 )
 
 

@@ -116,6 +116,22 @@ class MemoryContext:
             return
         self.pool._free(self, int(nbytes))
 
+    def reserving(self, nbytes: int):
+        """Context manager: reserve for the duration of a block and
+        free on exit — the streamed-batch working-set idiom (reserve a
+        batch, run the compiled chain, release)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            self.reserve(nbytes)
+            try:
+                yield self
+            finally:
+                self.free(nbytes)
+
+        return _scope()
+
     def snapshot(self) -> dict:
         with self.pool._lock:
             return {

@@ -84,8 +84,28 @@ class _Ctx:
         build_rows = self.rows(build)
         if build_rows > self.broadcast_limit:
             return False
+        # an out-of-core storage probe must not be repartitioned: a
+        # hash exchange materializes the WHOLE table through the spool
+        # — exactly what streamed split-granular scans exist to avoid.
+        # Replicate the (row-limit-bounded) build and leave the fact
+        # table streaming in place.
+        if self._streams_storage(probe):
+            return True
         probe_rows = self.rows(probe)
         return build_rows * self.n_shards <= probe_rows + build_rows
+
+    def _streams_storage(self, node: P.PlanNode) -> bool:
+        """True when the subtree is a Filter/Project chain over a scan
+        of a streamable storage connector (parquet)."""
+        while isinstance(node, (P.Filter, P.Project)):
+            node = node.source
+        if not isinstance(node, P.TableScan):
+            return False
+        try:
+            conn = self.md.connector(node.catalog)
+        except KeyError:
+            return False
+        return bool(getattr(conn, "streamable", False))
 
 
 def add_exchanges(

@@ -168,7 +168,32 @@ def _scan_stats(node: P.TableScan, md: Metadata) -> PlanStats:
                 null_frac=cs.null_fraction,
                 exact=cs.lo is not None,
             )
-    return PlanStats(rows, symbols)
+    # pushdown domains narrow what the scan actually reads: clamp the
+    # symbol bounds and scale the row estimate by the range fraction.
+    # The Filter the domains came from stays in the plan and re-derives
+    # its selectivity against the CLAMPED bounds (keep ~ 1), so the
+    # reduction is applied once, at the scan where storage applies it.
+    if node.domains:
+        inv = {c: s for s, c in node.assignments.items()}
+        for cname, dom in node.domains.items():
+            sym = inv.get(cname)
+            st = symbols.get(sym) if sym is not None else None
+            if st is None or st.lo is None or st.hi is None:
+                continue
+            try:
+                dlo = st.lo if dom[0] is None else float(dom[0])
+                dhi = st.hi if dom[1] is None else float(dom[1])
+            except (TypeError, ValueError):
+                continue  # non-numeric domain (varchar partition key)
+            nlo, nhi = max(float(st.lo), dlo), min(float(st.hi), dhi)
+            if nhi < nlo:
+                rows = 0.0
+                continue
+            width = float(st.hi) - float(st.lo)
+            if width > 0:
+                rows *= min(max((nhi - nlo) / width, 0.0), 1.0)
+            symbols[sym] = replace(st, lo=nlo, hi=nhi)
+    return PlanStats(max(rows, 1.0), symbols)
 
 
 def _union_sym(per: list[SymbolStats]) -> SymbolStats:

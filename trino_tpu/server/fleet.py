@@ -47,6 +47,7 @@ from dataclasses import dataclass
 
 from trino_tpu import fault, memory, profiler, telemetry, tracker
 from trino_tpu import session_properties as sp
+from trino_tpu.connectors.base import ColumnDomain, Split
 from trino_tpu.engine import (
     QueryResult,
     QueryRunner,
@@ -166,6 +167,10 @@ class _TaskSpec:
     plan_json: dict
     partition: int | None
     fail_first: bool = False
+    #: build-side output symbols whose min/max the worker reports on
+    #: FINISHED (coordinator-level dynamic filtering: the merged range
+    #: becomes a storage domain on held probe-side scan stages)
+    report_ranges: list[str] | None = None
 
 
 class FleetRunner:
@@ -269,6 +274,10 @@ class FleetRunner:
         #: execute() — the chaos suite asserts per-site injections
         #: actually reached the worker tier from these
         self.failure_log: list[str] = []
+        #: coordinator-level dynamic-filter applications from the last
+        #: execute(): one entry per probe-side scan stage whose domains
+        #: were narrowed by merged build-task ranges (tests/EXPLAIN)
+        self.df_scan_log: list[dict] = []
         #: task_id -> (Stage, _TaskSpec) from the last _run_dag, kept
         #: for coordinator-side corruption recovery on the root read
         self._last_specs: dict[str, tuple[Stage, _TaskSpec]] = {}
@@ -554,6 +563,7 @@ class FleetRunner:
         }
         self.retry_delays = []
         self.failure_log = []
+        self.df_scan_log = []
         seed = sp.get(self.session, "retry_backoff_seed")
         self._retry_rng = random.Random(seed or None)
         # inconsistent memory caps fail the statement before any task
@@ -860,7 +870,23 @@ class FleetRunner:
             scan = scans[0]
             connector = self.metadata.connector(scan.catalog)
             n_live = max(2, sum(1 for w in self.workers if w.alive))
-            splits = connector.splits(scan.schema, scan.table, n_live)
+            # pushdown at split generation: a supports_domains
+            # connector prunes partitions/row groups from the scan's
+            # domains (static filter conjuncts + any coordinator-level
+            # dynamic-filter ranges injected before admission), so
+            # pruned storage never even becomes a task. Split footer
+            # stats give a second, connector-agnostic pruning pass.
+            domains = None
+            if scan.domains and getattr(connector, "supports_domains", False):
+                domains = {
+                    c: ColumnDomain(*d) for c, d in scan.domains.items()
+                }
+            splits = connector.splits(
+                scan.schema, scan.table, n_live, domains=domains
+            )
+            if domains:
+                kept = [s for s in splits if not s.disjoint(domains)]
+                splits = kept or [Split(scan.table, 0, 0)]
             specs = []
             for i, spl in enumerate(splits):
                 bound = _bind_split(stage.root, scan, (spl.start, spl.count))
@@ -877,6 +903,120 @@ class FleetRunner:
                 fail_first=f"{sid}:0" in self.inject_failures,
             )
         ]
+
+    # ---- coordinator-level dynamic filtering over storage scans ----------
+
+    def _plan_scan_df(self, stages: list[Stage], by_id: dict):
+        """Find inner joins whose probe side bottoms at an unbound
+        supports_domains TableScan and whose build side is an upstream
+        stage. Returns (hold, inject, report):
+
+        - hold: probe_stage_id -> build stage ids that must complete
+          before the probe stage is admitted;
+        - inject: probe_stage_id -> [{scan, column, build_stage,
+          build_sym}] domain-injection targets resolved at admission;
+        - report: build_stage_id -> output symbols whose min/max its
+          tasks report.
+
+        The reference's coordinator-side dynamic filtering
+        (MAIN/server/DynamicFilterService.java:120) does the same
+        collect-then-narrow, with the lazy-blocking split source in
+        the role the admission hold plays here."""
+        hold: dict[str, set] = {}
+        inject: dict[str, list] = {}
+        report: dict[str, list] = {}
+        if not sp.get(self.session, "dynamic_filtering_enabled"):
+            return hold, inject, report
+        by_source = {
+            i.source_id: i.stage_id for s in stages for i in s.inputs
+        }
+
+        def blocked_by(sid: str) -> set:
+            out: set = set()
+            stack = [sid]
+            while stack:
+                x = stack.pop()
+                deps = {i.stage_id for i in by_id[x].inputs}
+                deps |= hold.get(x, set())
+                for d in deps:
+                    if d not in out:
+                        out.add(d)
+                        stack.append(d)
+            return out
+
+        joins: list[tuple[Stage, P.Join]] = []
+        for s in stages:
+            def walk(n, _s=s):
+                if isinstance(n, P.Join):
+                    joins.append((_s, n))
+                for c in n.sources:
+                    walk(c)
+            walk(s.root)
+        for s, j in joins:
+            if j.kind != "inner" or not j.criteria:
+                continue
+            # planner hint: a build range expected to keep >70% of
+            # probe rows cannot pay for the admission hold (same gate
+            # as the in-executor range filter); unknown -> try, the
+            # storage-pruning upside dwarfs the collection cost
+            if j.df_range_keep is not None and j.df_range_keep > 0.7:
+                continue
+            for psym, bsym in j.criteria:
+                bsid, bout = _df_build_source(j.right, bsym, by_source)
+                if bsid is None:
+                    continue
+                pstage, scan, col = _df_trace(
+                    s, j.left, psym, by_id, by_source
+                )
+                if scan is None or pstage.stage_id == bsid:
+                    continue
+                try:
+                    conn = self.metadata.connector(scan.catalog)
+                except KeyError:
+                    continue
+                if not getattr(conn, "supports_domains", False):
+                    continue
+                # never create a wait cycle: the build stage must not
+                # itself (transitively, through inputs or earlier
+                # holds) wait on the probe stage
+                if pstage.stage_id in blocked_by(bsid):
+                    continue
+                hold.setdefault(pstage.stage_id, set()).add(bsid)
+                inject.setdefault(pstage.stage_id, []).append({
+                    "scan": scan, "column": col,
+                    "build_stage": bsid, "build_sym": bout,
+                })
+                syms = report.setdefault(bsid, [])
+                if bout not in syms:
+                    syms.append(bout)
+        return hold, inject, report
+
+    def _apply_scan_df(
+        self, stage: Stage, targets: list[dict], col_ranges: dict
+    ) -> None:
+        """Narrow the held stage's scan domains with the merged build
+        ranges (intersected with any static filter domains), rewriting
+        the stage root in place before task construction."""
+        upd: dict[int, list] = {}
+        for t in targets:
+            rng = col_ranges.get(t["build_stage"], {}).get(t["build_sym"])
+            if not rng or not rng[2] or rng[0] is None:
+                continue  # unreported/uncomputable: no narrowing
+            scan = t["scan"]
+            ent = upd.setdefault(
+                id(scan), [scan, dict(scan.domains or {}), []]
+            )
+            ent[1][t["column"]] = _merge_domain(
+                ent[1].get(t["column"]), int(rng[0]), int(rng[1])
+            )
+            ent[2].append((t["column"], int(rng[0]), int(rng[1])))
+        for scan, domains, applied in upd.values():
+            stage.root = _bind_domains(stage.root, scan, domains)
+            self.df_scan_log.append({
+                "stage_id": stage.stage_id,
+                "table": f"{scan.schema}.{scan.table}",
+                "columns": {c: [lo, hi] for c, lo, hi in applied},
+            })
 
     # ---- overlapping stage-DAG scheduling with retry ---------------------
 
@@ -918,6 +1058,16 @@ class FleetRunner:
         - dead-worker re-admission: evicted workers are probed on a
           backoff schedule and rejoin the pool when they answer."""
         by_id = {s.stage_id: s for s in stages}
+        # coordinator-level dynamic filtering over storage scans: probe
+        # stages whose fragment bottoms at a supports_domains TableScan
+        # hold admission until their build stages complete, build tasks
+        # report per-symbol min/max, and the merged range lands in the
+        # probe scan's domains BEFORE its splits are enumerated — the
+        # fact table's pruned row groups are never read anywhere
+        df_hold, df_inject, df_report = self._plan_scan_df(stages, by_id)
+        #: build_stage_id -> sym -> [lo, hi, complete?] merged across
+        #: that stage's committed tasks
+        col_ranges: dict[str, dict[str, list]] = {}
         specs_of: dict[str, list[_TaskSpec]] = {}
         spec_by_tid: dict[str, tuple[Stage, _TaskSpec]] = {}
         done_of: dict[str, set] = {s.stage_id: set() for s in stages}
@@ -999,7 +1149,12 @@ class FleetRunner:
             # liveness); PIPELINED registers every stage up front —
             # children-first fragment order means producers register
             # before their consumers, and per-TASK readiness is the
-            # scheduler's call at dispatch time
+            # scheduler's call at dispatch time. A dynamic-filter hold
+            # trumps both modes: a probe-side scan stage waits for its
+            # build stages so admission sees the merged key ranges.
+            holds = df_hold.get(stage.stage_id)
+            if holds and not all(b in complete for b in holds):
+                return False
             return pipelined or ready(stage)
 
         def take_next(now: float):
@@ -1210,7 +1365,14 @@ class FleetRunner:
             for stage in stages:
                 if stage.stage_id in started or not stage_startable(stage):
                     continue
+                targets = df_inject.pop(stage.stage_id, None)
+                if targets:
+                    self._apply_scan_df(stage, targets, col_ranges)
                 specs = self._make_tasks(stage)
+                rep = df_report.get(stage.stage_id)
+                if rep:
+                    for spec in specs:
+                        spec.report_ranges = list(rep)
                 specs_of[stage.stage_id] = specs
                 sched.register_stage(stage, specs)
                 if (
@@ -1424,6 +1586,29 @@ class FleetRunner:
                     # per-task stats + worker-side span subtree ride on
                     # the FINISHED status response
                     tstats = state.get("stats") or {}
+                    # build-side key ranges for coordinator-level
+                    # dynamic filtering: merged across the stage's
+                    # committed tasks; a task that could not compute a
+                    # requested range (None) poisons the symbol so a
+                    # partial range never over-prunes the probe scan
+                    if spec.report_ranges:
+                        got = tstats.get("col_ranges") or {}
+                        store = col_ranges.setdefault(sid, {})
+                        for sym in spec.report_ranges:
+                            cur = store.setdefault(sym, [None, None, True])
+                            rng = got.get(sym)
+                            if rng is None:
+                                cur[2] = False
+                            elif rng:
+                                lo, hi = int(rng[0]), int(rng[1])
+                                cur[0] = (
+                                    lo if cur[0] is None
+                                    else min(cur[0], lo)
+                                )
+                                cur[1] = (
+                                    hi if cur[1] is None
+                                    else max(cur[1], hi)
+                                )
                     task_row = {
                         "query_id": self._query_id,
                         "stage_id": sid, "task_id": tid, "attempt": a,
@@ -1679,6 +1864,10 @@ class FleetRunner:
             },
             "spool": qroot,
             "session": dict(self.session.properties),
+            **(
+                {"report_ranges": list(spec.report_ranges)}
+                if spec.report_ranges else {}
+            ),
             "fail": bool(spec.fail_first and attempt == 0),
             # worker pools attribute reservations per query; the
             # spool directory name doubles as the query id
@@ -1752,3 +1941,94 @@ def _bind_split(
         return _replace_sources(n, [walk(s) for s in srcs])
 
     return walk(root)
+
+
+def _bind_domains(
+    root: P.PlanNode, scan: P.TableScan, domains: dict
+) -> P.PlanNode:
+    """Rebind the fragment's scan leaf with narrowed pushdown domains."""
+    from dataclasses import replace as dc_replace
+
+    from trino_tpu.plan.optimizer import _replace_sources
+
+    def walk(n: P.PlanNode) -> P.PlanNode:
+        if n is scan:
+            return dc_replace(n, domains=domains)
+        srcs = n.sources
+        if not srcs:
+            return n
+        return _replace_sources(n, [walk(s) for s in srcs])
+
+    return walk(root)
+
+
+def _merge_domain(cur, lo: int, hi: int):
+    """Intersect an existing (lo, hi, lo_strict, hi_strict) domain with
+    a closed [lo, hi] dynamic-filter range."""
+    if cur is None:
+        return (lo, hi, False, False)
+    clo, chi, cls, chs = cur
+    if clo is None or lo > clo:
+        clo, cls = lo, False
+    if chi is None or hi < chi:
+        chi, chs = hi, False
+    return (clo, chi, cls, chs)
+
+
+def _df_trace(stage: Stage, node: P.PlanNode, sym: str, by_id, by_source):
+    """Follow a probe key symbol down Filter/Project chains — hopping
+    across exchanges into producer stages — to a bare column of an
+    unbound TableScan. Returns (stage, scan, column) or Nones when the
+    chain computes the symbol or crosses a non-streaming operator."""
+    from trino_tpu.expr.ir import InputRef
+
+    for _ in range(64):  # fragment DAGs are shallow; bound paranoia
+        if isinstance(node, P.TableScan):
+            col = node.assignments.get(sym)
+            if col is None or node.split is not None:
+                return None, None, None
+            return stage, node, col
+        if isinstance(node, P.RemoteSource):
+            sid = by_source.get(node.source_id)
+            if sid is None:
+                return None, None, None
+            stage = by_id[sid]
+            node = stage.root
+            continue
+        if isinstance(node, P.Filter):
+            node = node.source
+            continue
+        if isinstance(node, P.Project):
+            e = node.assignments.get(sym)
+            if not isinstance(e, InputRef):
+                return None, None, None
+            sym = e.name
+            node = node.source
+            continue
+        return None, None, None
+    return None, None, None
+
+
+def _df_build_source(node: P.PlanNode, sym: str, by_source):
+    """Trace a build key symbol down to the RemoteSource reading the
+    build stage's spooled output; a Filter between them only widens the
+    reported range (superset rows), which stays correct. Returns
+    (build_stage_id, stage_output_symbol) or (None, None)."""
+    from trino_tpu.expr.ir import InputRef
+
+    for _ in range(64):
+        if isinstance(node, P.RemoteSource):
+            sid = by_source.get(node.source_id)
+            return (sid, sym) if sid is not None else (None, None)
+        if isinstance(node, P.Filter):
+            node = node.source
+            continue
+        if isinstance(node, P.Project):
+            e = node.assignments.get(sym)
+            if not isinstance(e, InputRef):
+                return None, None
+            sym = e.name
+            node = node.source
+            continue
+        return None, None
+    return None, None

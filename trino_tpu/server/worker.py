@@ -410,6 +410,7 @@ class WorkerServer:
             out_stats = {"rows": 0, "bytes": 0}
             peak_bytes = 0
             op_stats: list = []
+            col_ranges: dict = {}
             try:
                 if req.get("fail"):
                     raise InjectedTaskFailure(
@@ -529,6 +530,14 @@ class WorkerServer:
                             # cache, which is XLA work
                             jit_cache.set_active_span(tspan)
                             op_stats = prof.finish(ex)
+                            # coordinator-level dynamic filtering:
+                            # min/max of the requested build-key
+                            # output symbols ride back on FINISHED
+                            # (still under the runner lock — the
+                            # device fetch is XLA work)
+                            rep = req.get("report_ranges") or []
+                            if rep:
+                                col_ranges = _page_col_ranges(page, rep)
                             # a cancelled speculative loser should not
                             # burn spool writes; a cancel arriving after
                             # this check commits anyway, which
@@ -586,6 +595,10 @@ class WorkerServer:
                             ),
                             "peak_memory_bytes": int(peak_bytes),
                             "operator_stats": op_stats,
+                            **(
+                                {"col_ranges": col_ranges}
+                                if col_ranges else {}
+                            ),
                         }
                         task.spans = tspan.finish().to_dict()
                         task.state = "FINISHED"
@@ -600,6 +613,44 @@ class WorkerServer:
 
         threading.Thread(target=run, daemon=True).start()
         return task
+
+
+def _page_col_ranges(page, symbols: list) -> dict:
+    """Min/max of live non-null values per requested output symbol —
+    the build-side summary behind coordinator-level dynamic filtering.
+    ``[lo, hi]`` when computable, ``[]`` when the task produced no
+    usable rows, ``None`` when the column's domain cannot prune
+    (dictionary/hash codes carry no storage order, two-limb decimals
+    and pooled types have no 1-D integer domain)."""
+    import numpy as np
+
+    out: dict = {}
+    mask = np.asarray(page.mask)
+    for sym in symbols:
+        if sym not in page.names:
+            out[sym] = None
+            continue
+        col = page.column(sym)
+        if (
+            col.dictionary is not None
+            or col.hash_pool is not None
+            or col.array_pool is not None
+        ):
+            out[sym] = None
+            continue
+        data = np.asarray(col.data)
+        if data.ndim != 1 or np.dtype(data.dtype).kind != "i":
+            out[sym] = None
+            continue
+        keep = mask.copy()
+        if col.valid is not None:
+            keep &= np.asarray(col.valid)
+        vals = data[keep]
+        if vals.size == 0:
+            out[sym] = []
+        else:
+            out[sym] = [int(vals.min()), int(vals.max())]
+    return out
 
 
 def _json_element(t, x):
@@ -693,6 +744,11 @@ def main():
     ap.add_argument("--catalog", default="tpch")
     ap.add_argument("--schema", default="tiny")
     ap.add_argument("--mesh", action="store_true")
+    ap.add_argument(
+        "--parquet-root", default=None,
+        help="mount a parquet directory tree as the worker catalog "
+             "(--catalog names the catalog, --schema the schema)",
+    )
     args = ap.parse_args()
     if os.environ.get("JAX_PLATFORMS"):
         # a site-installed accelerator plugin may overwrite
@@ -723,10 +779,18 @@ def main():
         from trino_tpu.parallel.core import make_mesh
 
         mesh = make_mesh()
-    factory = (
-        QueryRunner.tpcds if args.catalog == "tpcds" else QueryRunner.tpch
-    )
-    runner = factory(args.schema, mesh=mesh)
+    if args.parquet_root:
+        catalog = "hive" if args.catalog == "tpch" else args.catalog
+        runner = QueryRunner.parquet(
+            args.parquet_root, schema=args.schema, mesh=mesh,
+            catalog=catalog,
+        )
+    else:
+        factory = (
+            QueryRunner.tpcds if args.catalog == "tpcds"
+            else QueryRunner.tpch
+        )
+        runner = factory(args.schema, mesh=mesh)
     if os.environ.get("TRINO_TPU_PREWARM", "") not in ("", "0"):
         # trace-compile the canonical bucket set before accepting
         # tasks (cheap against a warm persistent cache; off by default

@@ -221,6 +221,15 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "joins (enable_dynamic_filtering analog)",
             "boolean", True,
         ),
+        _P(
+            "streaming_scan_enabled",
+            "Route big scans over streamable connectors (parquet) "
+            "through the out-of-core split-granular reader "
+            "(exec.stream_scan) when the estimated scan exceeds a "
+            "quarter of the memory budget; OFF materializes the scan "
+            "resident (and over-budget tables then fail loudly)",
+            "boolean", True,
+        ),
         # ---- client/worker protocol -----------------------------------
         _P(
             "result_batch_rows",

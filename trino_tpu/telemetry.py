@@ -549,6 +549,21 @@ SCAN_CACHE_HITS = REGISTRY.counter(
 SCAN_CACHE_MISSES = REGISTRY.counter(
     "trino_scan_cache_misses_total",
     "Table-scan page materializations that had to hit the connector")
+SCAN_ROWGROUPS_TOTAL = REGISTRY.counter(
+    "trino_scan_rowgroups_total",
+    "Storage row groups considered by split generation / pruned scans")
+SCAN_ROWGROUPS_PRUNED = REGISTRY.counter(
+    "trino_scan_rowgroups_pruned",
+    "Row groups skipped by min/max footer-stat pruning")
+SCAN_PARTITIONS_PRUNED = REGISTRY.counter(
+    "trino_scan_partitions_pruned",
+    "Hive-style partition directories skipped by partition-value pruning")
+SCAN_BYTES_READ = REGISTRY.counter(
+    "trino_scan_bytes_read",
+    "Compressed storage bytes actually read from columnar files")
+SCAN_BATCHES = REGISTRY.counter(
+    "trino_scan_batches",
+    "Row-group batches streamed through the out-of-core scan operator")
 
 
 # ---------------------------------------------------------------------------

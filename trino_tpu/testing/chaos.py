@@ -47,6 +47,7 @@ from trino_tpu.testing.golden import (
 __all__ = [
     "CHAOS_BASE_PORT", "spawn_workers", "stop_workers",
     "make_fleet", "make_serving", "run_chaos_soak", "fired_sites",
+    "run_storage_chaos",
 ]
 
 CHAOS_BASE_PORT = 18960
@@ -266,6 +267,77 @@ def run_chaos_soak(
             })
         record["policies"][policy] = runs
     return record
+
+
+def run_storage_chaos(seed: int = 0, root: str | None = None) -> dict:
+    """Streamed-storage chaos scenario: every split's first TWO read
+    attempts fail at the ``scan-read`` site mid-stream, forcing the
+    out-of-core scan (exec/stream_scan) to retry at SPLIT granularity
+    — one row-group batch re-reads, never the table. The result must
+    stay oracle-exact and the stream must still report its batches,
+    proving the retries were local. Requires pyarrow (the caller
+    gates); returns the canonical fired-injection record."""
+    import sqlite3
+    import tempfile
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.connectors.base import TableSchema
+    from trino_tpu.connectors.parquet import write_parquet_table
+
+    root = root or tempfile.mkdtemp(prefix="chaos-storage")
+    n = 120_000
+    rng = np.random.default_rng(seed + 101)
+    k = np.arange(n, dtype=np.int64) // 64
+    v = rng.integers(0, 997, n, dtype=np.int64)
+    p = (np.arange(n, dtype=np.int64) * 7) % 3
+    write_parquet_table(
+        root, "default", "events",
+        TableSchema(
+            "events",
+            [("k", T.BIGINT), ("v", T.BIGINT), ("p", T.BIGINT)],
+        ),
+        {"k": k, "v": v, "p": p},
+        row_group_size=10_000, partition_by=["p"],
+    )
+    runner = QueryRunner.parquet(root)
+    # a tiny budget forces the streamed path regardless of host RAM
+    runner.session.properties["hbm_budget_bytes"] = 1 << 20
+    sql = (
+        "select p, count(*), sum(v) from events where k >= 500 "
+        "group by p order by p"
+    )
+    db = sqlite3.connect(":memory:")
+    db.execute("create table events (k integer, v integer, p integer)")
+    db.executemany(
+        "insert into events values (?,?,?)",
+        zip(k.tolist(), v.tolist(), p.tolist()),
+    )
+    expected = db.execute(to_sqlite(sql)).fetchall()
+
+    inj = fault.FaultInjector(seed=seed)
+    # attempts 0 and 1 of EVERY split read fail; the third in-place
+    # retry succeeds — one more armed attempt would exhaust
+    # stream_scan.SCAN_READ_ATTEMPTS and fail the query
+    inj.arm("scan-read", times=2)
+    fault.activate(inj)
+    try:
+        result = runner.execute(sql)
+    finally:
+        fault.deactivate()
+    assert_rows_match(result.rows, expected, ordered=result.ordered)
+    entry = runner.executor.scan_log[-1]
+    assert entry["streamed"] and entry["batches"] >= 1, entry
+    fired = sorted(
+        d for d in inj.decisions
+        if d[3] is not None and d[0] == "scan-read"
+    )
+    assert fired, "scan-read injections never fired"
+    return {
+        "seed": seed, "scenario": "scan-read", "fired": fired,
+        "batches": int(entry["batches"]),
+    }
 
 
 def fired_sites(record: dict) -> set[str]:
