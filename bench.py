@@ -142,6 +142,14 @@ def main(argv=None) -> int:
         "the producer/consumer overlap the pipelined mode won",
     )
     ap.add_argument(
+        "--exchange", action="store_true",
+        help="also run the exchange-mode A/B: TPC-H q3/q5/q9 on a "
+        "live 2-worker fleet with exchange_mode=DIRECT (producer "
+        "memory first, spool fallback) vs SPOOL (filesystem only), "
+        "recording wall-clock per query, the direct-fetch ratio, and "
+        "a byte-equality check between the two modes' results",
+    )
+    ap.add_argument(
         "--trace-dir", default=os.environ.get("BENCH_TRACE_DIR"),
         help="export each warmup query's trace as Chrome trace-event "
         "JSON (<dir>/<qid>.trace.json — load in chrome://tracing or "
@@ -512,6 +520,16 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
             chaos_mod.stop_workers(procs)
 
     if (
+        args.exchange or _section_enabled("BENCH_EXCHANGE", False)
+    ) and fits("exchange", 240.0):
+        # direct-exchange A/B (BENCH_r07): the same multi-stage TPC-H
+        # queries on a real 2-process fleet with the spool on vs off
+        # the critical path. Byte-equality between the modes is
+        # checked here, not assumed. Ports 19200+ (telemetry tests
+        # own 19000+, serving 19020+).
+        _exchange_section(detail)
+
+    if (
         args.serving or _section_enabled("BENCH_SERVING", False)
     ) and fits("serving", 240.0):
         # multi-query serving (BENCH_r08): N closed-loop clients
@@ -633,6 +651,55 @@ def _storage_section(detail) -> None:
         detail["storage_peak_bytes"] = int(
             runner.executor.memory_pool.peak_bytes
         )
+
+
+def _exchange_section(detail) -> None:
+    import tempfile
+
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.testing import chaos as chaos_mod
+
+    qids = ("q03", "q05", "q09")
+    procs, uris = chaos_mod.spawn_workers(2, base_port=19200)
+    rows_by_mode: dict = {}
+    direct = spooled = 0
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench-exchange-") as sp:
+            for mode in ("SPOOL", "DIRECT"):
+                fleet = chaos_mod.make_fleet(uris, sp)
+                fleet.session.properties["exchange_mode"] = mode
+                fleet.session.properties[
+                    "join_distribution_type"
+                ] = "PARTITIONED"
+                for q in qids:  # warmup: compile caches, scan residency
+                    fleet.execute(QUERIES[q])
+                for q in qids:
+                    t0 = time.perf_counter()
+                    res = fleet.execute(QUERIES[q])
+                    detail[f"fleet_{mode.lower()}_{q}_ms"] = round(
+                        (time.perf_counter() - t0) * 1e3, 1
+                    )
+                    rows_by_mode.setdefault(mode, {})[q] = res.rows
+                    if mode == "DIRECT":
+                        direct += sum(
+                            st.get("direct_bytes", 0)
+                            for st in res.stage_stats
+                        )
+                        spooled += sum(
+                            st.get("spooled_bytes", 0)
+                            for st in res.stage_stats
+                        )
+    finally:
+        chaos_mod.stop_workers(procs)
+    detail["exchange_direct_bytes"] = direct
+    detail["exchange_spooled_bytes"] = spooled
+    detail["exchange_direct_fetch_ratio"] = round(
+        direct / (direct + spooled), 4
+    ) if (direct + spooled) else 0.0
+    detail["exchange_rows_identical"] = all(
+        rows_by_mode["SPOOL"][q] == rows_by_mode["DIRECT"][q]
+        for q in qids
+    )
 
 
 def _serving_section(detail) -> None:

@@ -318,6 +318,36 @@ def test_chaos_pipelined_producer_retry_mid_stream(
     assert pipelined.tasks_retried >= 1
 
 
+def test_chaos_exchange_fetch_fault_falls_back_to_spool(
+    chaos_workers, spool_root
+):
+    """A mid-fetch fault on the direct exchange (every attempt-0
+    producer-memory fetch fires) degrades silently to the durable
+    spool copy: no task failure, no retry, rows byte-identical across
+    admission modes and oracle-exact. The workers' injection counters
+    prove the faults really fired (the site is absorbed, so nothing
+    reaches failure_log), and zero direct bytes prove every exchange
+    read actually took the fallback path."""
+    before = chaos._worker_chaos_counts(chaos_workers)
+    _, pipelined = _assert_modes_agree(
+        chaos_workers, spool_root, chaos._JOIN_SQL, 41,
+        lambda inj: inj.arm("exchange-fetch", times=1),
+    )
+    after = chaos._worker_chaos_counts(chaos_workers)
+    assert after.get("exchange-fetch", 0) > before.get(
+        "exchange-fetch", 0
+    ), "exchange-fetch site never fired in the workers"
+    # absorbed, never fatal: invisible to the retry tiers
+    assert pipelined.tasks_retried == 0
+    assert pipelined.query_retries == 0
+    assert all(
+        s["direct_bytes"] == 0 for s in pipelined.stage_stats
+    ), "a faulted fetch still served direct bytes"
+    assert sum(
+        s["spooled_bytes"] for s in pipelined.stage_stats
+    ) > 0, "fallback reads never touched the spool"
+
+
 @pytest.mark.slow
 def test_chaos_pipelined_spool_read_fault_on_admitted_edge(
     chaos_workers, spool_root
@@ -402,12 +432,20 @@ def test_chaos_pipelined_speculative_producer_loses(
 
 @pytest.mark.slow
 def test_chaos_soak_covers_all_sites(chaos_workers, spool_root):
-    """All six sites inject under both retry policies; every scenario
-    returns oracle-exact rows (asserted inside the soak); the QUERY
-    tier actually re-executes for the faults that escape the task
-    tier."""
+    """Every fleet-reachable site injects under both retry policies;
+    every scenario returns oracle-exact rows (asserted inside the
+    soak); the QUERY tier actually re-executes for the faults that
+    escape the task tier. Two sites live outside the fleet soak's
+    reach and carry their own dedicated chaos coverage: ``scan-read``
+    (parquet streamed-storage splits — tests/test_storage_scan.py and
+    run_storage_chaos) and ``compile-deserialize`` (the compile
+    service's persistent-cache path, which long-lived soak workers
+    never re-enter once their in-memory executable caches are warm —
+    tests/test_jit_cache.py)."""
     record = chaos.run_chaos_soak(chaos_workers, spool_root, seed=7)
-    assert chaos.fired_sites(record) == set(fault.SITES)
+    assert chaos.fired_sites(record) == set(fault.SITES) - {
+        "scan-read", "compile-deserialize",
+    }
     by_name = {
         run["scenario"]: run for run in record["policies"]["QUERY"]
     }
@@ -415,6 +453,15 @@ def test_chaos_soak_covers_all_sites(chaos_workers, spool_root):
     assert by_name["root-read-exhausted"]["query_retries"] >= 1
     # the task tier absorbed everything it is meant to absorb
     for run in record["policies"]["TASK"]:
+        assert run["query_retries"] == 0
+    # the absorbed direct-exchange site: fired in the workers, yet
+    # caused no retries at any tier
+    for runs in record["policies"].values():
+        run = next(
+            r for r in runs if r["scenario"] == "exchange-fetch"
+        )
+        assert run["absorbed_sites"] == ["exchange-fetch"]
+        assert run["tasks_retried"] == 0
         assert run["query_retries"] == 0
 
 

@@ -139,6 +139,56 @@ def test_fleet_tpch_q18(fleet, oracle):
     check(fleet, oracle, QUERIES["q18"], abs_tol=0.006)
 
 
+def test_fleet_array_column_crosses_exchange(workers, spool_root):
+    """ARRAY columns round-trip through both exchange paths.
+
+    ``array_agg`` has no partial form, so the distributed plan routes
+    raw rows by group-key hash and aggregates in one step — the
+    resulting list column (offsets + flat values in the spool serde)
+    then crosses the agg->sort exchange.  Element order within each
+    array depends on row routing, so arrays compare as sorted
+    multisets per key against the single-runner result — proving
+    every element survived the exchange byte-exact, in both DIRECT
+    and SPOOL modes.
+    """
+    local = QueryRunner.tpch("tiny")
+    queries = [
+        # bigint elements
+        "select o_orderpriority, array_agg(o_orderkey) from orders "
+        "group by o_orderpriority order by 1",
+        # varchar elements
+        "select c_mktsegment, array_agg(c_name) from customer "
+        "group by c_mktsegment order by 1",
+    ]
+
+    def merged(rows):
+        out = {}
+        for key, arr in rows:
+            out.setdefault(key, []).extend(arr)
+        return {k: sorted(v) for k, v in out.items()}
+
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    for sql in queries:
+        expected = merged(local.execute(sql).rows)
+        for mode in ("SPOOL", "DIRECT"):
+            fl = FleetRunner(
+                workers, md, Session(catalog="tpch", schema="tiny"),
+                spool_root=spool_root, n_partitions=4,
+            )
+            fl.session.properties["exchange_mode"] = mode
+            res = fl.execute(sql)
+            assert len(res.rows) == len(expected), (mode, sql)
+            assert merged(res.rows) == expected, (mode, sql)
+            direct = sum(
+                st.get("direct_bytes", 0) for st in res.stage_stats
+            )
+            if mode == "DIRECT":
+                assert direct > 0, "DIRECT run served no direct bytes"
+            else:
+                assert direct == 0, "SPOOL run must not fetch direct"
+
+
 def test_fleet_task_retry_after_injected_failure(fleet, oracle):
     """First attempt of a scan task fails (FailureInjector analog);
     the retry on another worker must make the query succeed."""

@@ -443,3 +443,78 @@ def test_stage_admission_property_is_validated(workers, spool_root):
     fleet = _make_fleet(workers, spool_root, "EAGERLY")
     with pytest.raises(Exception, match="stage_admission"):
         fleet.execute("select count(*) from nation")
+
+
+# ---- attempt pinning under direct exchange ---------------------------
+
+
+def test_direct_exchange_serves_exactly_the_pinned_attempt():
+    """A consumer admitted against attempt 0 must never receive
+    attempt 1 bytes from the producer's buffer pool: the direct-fetch
+    URL carries the pinned attempt, the pool keys on (query, task,
+    attempt, partition) exactly, and any miss is a 404 — the consumer
+    then falls back to the spool read, which pins the same attempt."""
+    import urllib.error
+    import zlib
+
+    from trino_tpu.server.worker import WorkerServer
+
+    class _Ctx:  # memory context stand-in: reservation always grants
+        def try_reserve(self, n):
+            return True
+
+        def free(self, n):
+            pass
+
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    srv = WorkerServer(
+        QueryRunner(md, Session(catalog="tpch", schema="tiny")), port=0
+    ).start()
+    try:
+        ctx = _Ctx()
+        a0 = b"attempt-zero-partition-bytes"
+        a1 = b"attempt-one-partition-bytes-DIFFER"
+        assert srv.exchange_buffer.put(
+            ("qpin", "s2p0", 0, 0), a0, zlib.crc32(a0), ctx
+        )
+        assert srv.exchange_buffer.put(
+            ("qpin", "s2p0", 1, 0), a1, zlib.crc32(a1), ctx
+        )
+
+        def fetch(attempt, query="qpin"):
+            url = (
+                f"http://127.0.0.1:{srv.port}/v1/stagetask/s2p0/"
+                f"results/{attempt}/0?query={query}"
+            )
+            try:
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    return (
+                        r.status, r.read(),
+                        r.headers.get("X-Trino-File-CRC"),
+                    )
+            except urllib.error.HTTPError as e:
+                return e.code, b"", None
+
+        st, body, crc = fetch(0)
+        assert (st, body) == (200, a0)
+        assert int(crc) == zlib.crc32(a0)
+        st, body, crc = fetch(1)
+        assert (st, body) == (200, a1)
+        assert int(crc) == zlib.crc32(a1)
+        # an attempt that never stashed is a miss, never a "closest"
+        # entry from another attempt
+        assert fetch(2)[0] == 404
+        # an identical task id from a DIFFERENT query never cross-talks
+        # (long-lived workers reuse s2p0-style ids across queries)
+        assert fetch(0, query="other")[0] == 404
+        # cancelling the speculative loser drops only ITS attempt
+        srv.exchange_buffer.drop_task("qpin", "s2p0", 1)
+        assert fetch(1)[0] == 404
+        st, body, _ = fetch(0)
+        assert (st, body) == (200, a0)
+        # end-of-query cleanup clears the rest
+        srv.exchange_buffer.drop_query("qpin")
+        assert fetch(0)[0] == 404
+    finally:
+        srv.stop()

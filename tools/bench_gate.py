@@ -57,6 +57,15 @@ _RATE_KEYS = [
     # baselines that predate the out-of-core streamed scan tier
     ("detail.storage_stream_rows_per_s", True),
     ("detail.storage_pushdown_rows_per_s", True),
+    # exchange keys (BENCH_r07+, ``bench.py --exchange``): SKIP against
+    # baselines that predate the direct memory-exchange path
+    ("detail.fleet_direct_q03_ms", False),
+    ("detail.fleet_direct_q05_ms", False),
+    ("detail.fleet_direct_q09_ms", False),
+    ("detail.fleet_spool_q03_ms", False),
+    ("detail.fleet_spool_q05_ms", False),
+    ("detail.fleet_spool_q09_ms", False),
+    ("detail.exchange_direct_fetch_ratio", True),
 ]
 
 #: compile-count keys: lower is better, absolute slack not a pure band

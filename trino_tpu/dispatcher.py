@@ -291,6 +291,15 @@ class Dispatcher:
     def status(self, task_id: str, attempt: int) -> dict | None:
         return self._status.get((task_id, attempt))
 
+    def residency(self, task_id: str, attempt: int) -> str | None:
+        """Worker URI an attempt is bound to (None once terminal) —
+        the reactor's direct-exchange buffer-residency hint: the
+        attempt's committed output partitions sit in this worker's
+        buffer pool until fetched or evicted, so the scheduler ships
+        this URI on consumer admissions that pin the attempt."""
+        with self._lock:
+            return self._tracked.get((task_id, attempt))
+
     def mark_dead(self, w) -> None:
         """A query's POST saw this worker die; evict it and strand its
         tracked attempts as LOST (same as a reactor-observed death)."""

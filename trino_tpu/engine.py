@@ -197,10 +197,16 @@ class QueryRunner:
         plan = analyzer.analyze(stmt)
         if optimized:
             plan = optimize(plan, self.metadata, self.session)
-        if self.mesh is not None and not _has_arrays(plan):
+        if self.mesh is not None and (
+            not _has_arrays(plan)
+            or getattr(self.mesh, "host_exchange", False)
+        ):
             # ARRAY columns live in host pools whose handles cannot
-            # shard over the mesh yet: array-bearing plans execute on
-            # the local paths even with a mesh attached
+            # shard over a device mesh yet: array-bearing plans execute
+            # on the local paths with a mesh attached. Fleet exchanges
+            # move pages through the host spool serde (which carries
+            # list columns), so a mesh stand-in that advertises
+            # host_exchange distributes them normally.
             from trino_tpu.plan.distribute import add_exchanges
 
             plan = add_exchanges(
@@ -945,6 +951,10 @@ def _stage_stats_line(label: str, st: dict) -> str:
         line += f", peak memory: {_fmt_bytes(st['peak_memory_bytes'])}"
     if st.get("admission_wait_ms"):
         line += f", admission wait: {st['admission_wait_ms']:.1f} ms"
+    if st.get("direct_bytes") or st.get("spooled_bytes"):
+        line += (
+            f", direct fetch ratio: {st.get('direct_fetch_ratio', 0.0):.2f}"
+        )
     return line
 
 

@@ -68,6 +68,7 @@ __all__ = [
     "page_to_host", "host_to_page", "committed_attempt",
     "SpoolCorruptionError", "quarantine_attempt", "next_attempt",
     "partition_marker", "committed_partitions",
+    "encode_partition", "payload_from_bytes",
 ]
 
 
@@ -254,28 +255,52 @@ def _concat_payloads(payloads: list[dict]) -> dict:
 
 # ---- file format -----------------------------------------------------------
 
-def _save_npz(path: str, payload: dict, sel: np.ndarray) -> int:
-    """Write one checksummed partition file; returns the CRC32 of the
-    complete on-disk file (header + body) for the commit manifest."""
+def encode_partition(payload: dict, sel: np.ndarray) -> tuple[bytes, int]:
+    """Serialize the selected rows of a host payload into the
+    checksummed partition wire format (header + npz body). Returns
+    ``(raw_bytes, whole_file_crc)`` — the SAME bytes land on the spool
+    and in the producer's direct-exchange buffer, so both paths share
+    one serde and one integrity check."""
     arrays = {}
     schema = []
     for i, (t, (values, valid)) in enumerate(
         zip(payload["types"], payload["cols"])
     ):
-        if isinstance(t, T.ArrayType):
-            raise NotImplementedError(
-                "ARRAY columns cannot cross the spooled exchange yet"
-            )
         v = values[sel]
-        if v.dtype == object:
-            v = v.astype(str)
-        arrays[f"d{i}"] = v
-        if valid is not None:
-            arrays[f"v{i}"] = valid[sel]
-        schema.append({
+        entry = {
             "name": payload["names"][i], "type": str(t),
             "valid": valid is not None,
-        })
+        }
+        if isinstance(t, T.ArrayType):
+            # list column: int64 offsets + flattened element values
+            # (the Arrow ListArray / ArrayBlock layout) — npz cannot
+            # hold object rows without pickle, and pickle never
+            # crosses the exchange
+            offs = np.zeros(len(v) + 1, dtype=np.int64)
+            flat: list = []
+            for j, row in enumerate(v):
+                if row is None:
+                    offs[j + 1] = offs[j]
+                    continue
+                flat.extend(row)
+                offs[j + 1] = offs[j] + len(row)
+            arrays[f"o{i}"] = offs
+            if isinstance(t.element, T.VarcharType):
+                arrays[f"d{i}"] = np.asarray(
+                    [str(x) for x in flat], dtype=str
+                )
+            else:
+                arrays[f"d{i}"] = np.asarray(
+                    flat if flat else [], dtype=t.element.np_dtype
+                )
+            entry["array"] = True
+        else:
+            if v.dtype == object:
+                v = v.astype(str)
+            arrays[f"d{i}"] = v
+        if valid is not None:
+            arrays[f"v{i}"] = valid[sel]
+        schema.append(entry)
     arrays["schema"] = np.frombuffer(
         json.dumps(schema).encode(), dtype=np.uint8
     )
@@ -283,27 +308,43 @@ def _save_npz(path: str, payload: dict, sel: np.ndarray) -> int:
     np.savez(buf, **arrays)
     body = buf.getvalue()
     header = _HEADER.pack(_MAGIC, zlib.crc32(body))
+    raw = header + body
+    return raw, zlib.crc32(raw)
+
+
+def _write_partition_file(path: str, raw: bytes) -> None:
+    """Durably land pre-encoded partition bytes (tmp + atomic rename)."""
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(header)
-        f.write(body)
+        f.write(raw)
     os.replace(tmp, path)
-    telemetry.SPOOL_BYTES_WRITTEN.inc(len(header) + len(body))
-    return zlib.crc32(body, zlib.crc32(header))
+    telemetry.SPOOL_BYTES_WRITTEN.inc(len(raw))
 
 
-def _load_npz(path: str, expect_crc: int | None = None) -> dict:
+def _save_npz(path: str, payload: dict, sel: np.ndarray) -> int:
+    """Write one checksummed partition file; returns the CRC32 of the
+    complete on-disk file (header + body) for the commit manifest."""
+    raw, crc = encode_partition(payload, sel)
+    _write_partition_file(path, raw)
+    return crc
+
+
+def _load_npz(
+    path: str, expect_crc: int | None = None, on_bytes=None,
+) -> dict:
     """Load + verify one partition file (counts bytes read and CRC
     failures into the metrics registry)."""
     try:
-        out = _load_npz_verified(path, expect_crc)
+        out = _load_npz_verified(path, expect_crc, on_bytes)
     except SpoolCorruptionError:
         telemetry.SPOOL_CRC_FAILURES.inc()
         raise
     return out
 
 
-def _load_npz_verified(path: str, expect_crc: int | None = None) -> dict:
+def _load_npz_verified(
+    path: str, expect_crc: int | None = None, on_bytes=None,
+) -> dict:
     """Load + verify one partition file. ``expect_crc`` is the
     whole-file checksum from the commit manifest (when available);
     the embedded header CRC is always checked. Any mismatch,
@@ -314,6 +355,18 @@ def _load_npz_verified(path: str, expect_crc: int | None = None) -> dict:
             raw = f.read()
     except FileNotFoundError:
         raise SpoolCorruptionError(f"partition file missing: {path}")
+    out = payload_from_bytes(raw, expect_crc)
+    telemetry.SPOOL_BYTES_READ.inc(len(raw))
+    if on_bytes is not None:
+        on_bytes(len(raw))
+    return out
+
+
+def payload_from_bytes(raw: bytes, expect_crc: int | None = None) -> dict:
+    """Verify + decode partition wire bytes (shared by the spool read
+    path and the direct producer-memory fetch path; no spool byte
+    accounting happens here). ``expect_crc`` is the whole-file CRC32
+    from the commit manifest / partition marker when available."""
     if expect_crc is not None and zlib.crc32(raw) != expect_crc:
         raise SpoolCorruptionError(
             "file checksum does not match commit manifest"
@@ -324,7 +377,6 @@ def _load_npz_verified(path: str, expect_crc: int | None = None) -> dict:
     body = raw[_HEADER.size:]
     if zlib.crc32(body) != crc:
         raise SpoolCorruptionError("partition body fails CRC32")
-    telemetry.SPOOL_BYTES_READ.inc(len(raw))
     try:
         with np.load(io.BytesIO(body), allow_pickle=False) as z:
             schema = json.loads(bytes(z["schema"].tobytes()).decode())
@@ -332,7 +384,17 @@ def _load_npz_verified(path: str, expect_crc: int | None = None) -> dict:
             for i, col in enumerate(schema):
                 names.append(col["name"])
                 types.append(T.type_from_name(col["type"]))
-                data = z[f"d{i}"]
+                if col.get("array"):
+                    # offsets + flat values back into object rows of
+                    # python lists (what Column.from_numpy expects for
+                    # ArrayType)
+                    offs = z[f"o{i}"]
+                    flat = z[f"d{i}"]
+                    data = np.empty(len(offs) - 1, dtype=object)
+                    for j in range(len(offs) - 1):
+                        data[j] = flat[offs[j]:offs[j + 1]].tolist()
+                else:
+                    data = z[f"d{i}"]
                 valid = z[f"v{i}"] if col["valid"] else None
                 cols.append((data, valid))
     except SpoolCorruptionError:
@@ -393,6 +455,7 @@ def write_task_output(
     root: str, stage_id: str, task_id: str, attempt: int, page: Page,
     partitioning: str, key_names: list[str], n_parts: int,
     partition_delay_ms: float = 0.0, on_partition=None,
+    on_partition_bytes=None,
 ) -> dict:
     """Partition a task's output page and commit it to the spool.
 
@@ -404,8 +467,15 @@ def write_task_output(
     hook: widens the producer write tail so pipelined-admission
     overlap is observable on tiny data).
 
+    ``on_partition_bytes(part, raw, crc)`` (optional) receives each
+    partition's encoded wire bytes right after its marker commits —
+    the worker's direct-exchange buffer pool hooks in here so buffered
+    bytes are exactly the committed on-disk bytes.
+
     Returns ``{"rows": n, "bytes": total_file_bytes}`` for per-task
     output stats."""
+    import queue as _queue
+    import threading as _threading
     import time as _time
 
     from trino_tpu import fault
@@ -423,29 +493,65 @@ def write_task_output(
         parts = np.zeros(n, dtype=np.int64)
     written = []
     manifest: dict[str, int] = {}
-    for p in np.unique(parts):
-        sel = np.nonzero(parts == p)[0]
-        name = f"t{task_id}-a{attempt}-p{int(p)}.npz"
-        crc = _save_npz(os.path.join(d, name), payload, sel)
-        manifest[name] = crc
-        _commit_partition_marker(d, task_id, attempt, int(p), name, crc)
-        written.append(int(p))
-        if on_partition is not None:
-            on_partition(int(p))
-        if partition_delay_ms:
-            _time.sleep(partition_delay_ms / 1e3)
-    if not written:
-        # empty output still ships its schema (consumers need a typed
-        # zero-row page, the empty-serialized-page analog)
-        name = f"t{task_id}-a{attempt}-p0.npz"
-        crc = _save_npz(
-            os.path.join(d, name), payload, np.zeros(0, dtype=np.int64)
-        )
-        manifest[name] = crc
-        _commit_partition_marker(d, task_id, attempt, 0, name, crc)
-        written.append(0)
-        if on_partition is not None:
-            on_partition(0)
+
+    # async background commit: encoding (the CPU-bound half) stays on
+    # the caller's thread while a writer thread lands files + markers
+    # in submission order. Observable ordering is unchanged — every
+    # partition still commits file-then-marker-then-callback, the
+    # spool-write chaos seam and the attempt-level ``.done`` still run
+    # strictly after ALL partitions are durable (the join below) — so
+    # admission gating, attempt pinning, and quarantine semantics are
+    # byte-identical to the synchronous writer.
+    work: _queue.Queue = _queue.Queue()
+    failure: list[BaseException] = []
+
+    def _writer():
+        while True:
+            item = work.get()
+            if item is None:
+                return
+            part, name, raw, crc = item
+            if failure:
+                continue  # drain; first error wins at the join
+            try:
+                _write_partition_file(os.path.join(d, name), raw)
+                _commit_partition_marker(
+                    d, task_id, attempt, part, name, crc
+                )
+                if on_partition_bytes is not None:
+                    on_partition_bytes(part, raw, crc)
+                if on_partition is not None:
+                    on_partition(part)
+                if partition_delay_ms:
+                    _time.sleep(partition_delay_ms / 1e3)
+            except BaseException as e:  # surfaced at the join
+                failure.append(e)
+
+    writer = _threading.Thread(target=_writer, daemon=True)
+    writer.start()
+    try:
+        for p in np.unique(parts):
+            sel = np.nonzero(parts == p)[0]
+            name = f"t{task_id}-a{attempt}-p{int(p)}.npz"
+            raw, crc = encode_partition(payload, sel)
+            manifest[name] = crc
+            written.append(int(p))
+            work.put((int(p), name, raw, crc))
+        if not written:
+            # empty output still ships its schema (consumers need a
+            # typed zero-row page, the empty-serialized-page analog)
+            name = f"t{task_id}-a{attempt}-p0.npz"
+            raw, crc = encode_partition(
+                payload, np.zeros(0, dtype=np.int64)
+            )
+            manifest[name] = crc
+            written.append(0)
+            work.put((0, name, raw, crc))
+    finally:
+        work.put(None)
+        writer.join()
+    if failure:
+        raise failure[0]
     # chaos seam: a spool-write fault fails the producing task AFTER
     # its partition files (and their per-partition markers) landed but
     # BEFORE the attempt-level commit marker — the genuinely dangerous
@@ -549,6 +655,7 @@ def quarantine_attempt(
 def read_partition(
     root: str, stage_id: str, task_ids: list[str],
     partition: int | None, attempts: dict | None = None,
+    on_bytes=None,
 ) -> dict:
     """Read one partition (or, when ``partition`` is None, everything)
     written by the given tasks, deduplicated to one committed attempt
@@ -631,7 +738,9 @@ def read_partition(
             name = f"t{tid}-a{a}-p{p}.npz"
             try:
                 payloads.append(
-                    _load_npz(os.path.join(d, name), crcs.get(name))
+                    _load_npz(
+                        os.path.join(d, name), crcs.get(name), on_bytes
+                    )
                 )
             except SpoolCorruptionError as e:
                 raise SpoolCorruptionError(
@@ -646,7 +755,7 @@ def read_partition(
     if not payloads:
         if empty is not None:
             try:
-                p = _load_npz(empty, empty_crc[0])
+                p = _load_npz(empty, empty_crc[0], on_bytes)
             except SpoolCorruptionError as e:
                 raise SpoolCorruptionError(
                     str(e), stage_id=empty_crc[1], task_id=empty_crc[2],

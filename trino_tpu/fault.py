@@ -18,6 +18,9 @@ Sites (one string per architectural seam):
     ``device-oom``  memory reservations (memory.py MemoryPool)
     ``planner``     statement planning (engine.plan_stmt)
     ``scan-read``   streamed storage split reads (exec/stream_scan.py)
+    ``exchange-fetch`` direct producer-memory partition fetches
+                    (server/worker.py consumer side; a fired fault
+                    falls back to the spool, never fails the task)
 
 Schedules: ``arm`` (attempts 0..times-1 fail — the classic retry
 shape), ``arm_nth`` (exactly the n-th matching call fails), and
@@ -48,7 +51,7 @@ __all__ = [
 #: the closed set of injection sites (typo'd arms fail fast)
 SITES = frozenset(
     ["rpc", "spool-write", "spool-read", "task-exec", "device-oom",
-     "planner", "compile-deserialize", "scan-read"]
+     "planner", "compile-deserialize", "scan-read", "exchange-fetch"]
 )
 
 

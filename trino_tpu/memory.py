@@ -111,6 +111,21 @@ class MemoryContext:
         fault.check("device-oom", tag=self.name)
         self.pool._reserve(self, int(nbytes))
 
+    def try_reserve(self, nbytes: int) -> bool:
+        """Best-effort claim: False (nothing recorded) instead of
+        raising when the per-node cap would be breached. Used by the
+        direct-exchange buffer pool, where a failed reservation means
+        "serve this partition from the spool", not a query error —
+        deliberately NOT a device-oom chaos seam, so arming that site
+        keeps its existing seeded schedules."""
+        if nbytes <= 0:
+            return True
+        try:
+            self.pool._reserve(self, int(nbytes))
+        except ExceededMemoryLimitError:
+            return False
+        return True
+
     def free(self, nbytes: int) -> None:
         if nbytes <= 0:
             return

@@ -79,6 +79,10 @@ class EventDrivenScheduler:
         self._admission_wait_ms: dict[str, float] = {}
         #: (producer sid, tid, attempt) -> consumer tids pinned to it
         self._dependents: dict[tuple[str, str, int], set[str]] = {}
+        #: (producer sid, tid, attempt) -> worker URI whose buffer pool
+        #: holds the attempt's output (the direct-exchange residency
+        #: hint shipped on consumer stage-task requests)
+        self._locations: dict[tuple[str, str, int], str] = {}
         #: open overlap windows: (consumer tid, producer sid, t_admit)
         self._overlap_open: list[tuple[str, str, float]] = []
         self._overlap_s = 0.0
@@ -96,16 +100,24 @@ class EventDrivenScheduler:
             self._queued_at.setdefault(s.task_id, now)
 
     def on_partition_commit(
-        self, sid: str, tid: str, attempt: int, part: int
+        self, sid: str, tid: str, attempt: int, part: int,
+        worker: str | None = None,
     ) -> None:
         self._partitions.setdefault(sid, {}).setdefault(
             tid, {}
         ).setdefault(int(attempt), set()).add(int(part))
+        if worker:
+            self._locations[(sid, tid, int(attempt))] = worker
 
-    def on_task_commit(self, sid: str, tid: str, attempt: int) -> None:
+    def on_task_commit(
+        self, sid: str, tid: str, attempt: int,
+        worker: str | None = None,
+    ) -> None:
         self._task_commits.setdefault(sid, {}).setdefault(
             tid, set()
         ).add(int(attempt))
+        if worker:
+            self._locations[(sid, tid, int(attempt))] = worker
 
     def on_stage_complete(self, sid: str) -> None:
         """Close the overlap windows of consumers admitted while this
@@ -130,6 +142,7 @@ class EventDrivenScheduler:
         self._partitions.get(sid, {}).get(tid, {}).pop(attempt, None)
         self._task_commits.get(sid, {}).get(tid, set()).discard(attempt)
         self._complete.discard(sid)
+        self._locations.pop((sid, tid, attempt), None)
         return sorted(self._dependents.pop((sid, tid, attempt), ()))
 
     # ---- readiness + admission --------------------------------------------
@@ -196,6 +209,18 @@ class EventDrivenScheduler:
                 attempts[ptid] = a
             if attempts is not None:
                 entry["attempts"] = attempts
+                # best-effort direct-exchange residency hints: the
+                # worker whose buffer pool holds each pinned attempt's
+                # output (consumers without a hint, or whose fetch
+                # misses, read the spool — correctness never depends
+                # on this map)
+                workers = {
+                    ptid: self._locations[(sid, ptid, a)]
+                    for ptid, a in attempts.items()
+                    if (sid, ptid, a) in self._locations
+                }
+                if workers:
+                    entry["workers"] = workers
             pins[sid] = entry
         return pins
 

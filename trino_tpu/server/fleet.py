@@ -139,6 +139,10 @@ class _FleetParallelism:
     capacity estimates and broadcast thresholds match what actually
     runs (VERDICT r4: the fixed _FakeMesh ignored worker meshes)."""
 
+    #: fleet exchanges serialize pages through the host spool serde,
+    #: which carries ARRAY/MAP columns — unlike device-mesh sharding
+    host_exchange = True
+
     def __init__(self, n: int):
         self.devices = _N(n)
 
@@ -709,6 +713,24 @@ class FleetRunner:
                 # retries exhausted, deadline, memory kill)
                 self.dispatcher.unregister_query(self._dispatch_handle)
                 self._dispatch_handle = None
+            # release the query's direct-exchange buffers on every
+            # live worker: once the query is done (or dead) nothing
+            # will fetch them again — this is the "all pinned
+            # consumers have fetched" eviction point
+            for w in self.workers:
+                if not w.alive:
+                    continue
+                try:
+                    r = urllib.request.Request(
+                        f"{w.uri}/v1/exchange/{query_id}",
+                        method="DELETE",
+                    )
+                    with urllib.request.urlopen(
+                        r, timeout=self.rpc_timeout_s
+                    ):
+                        pass
+                except Exception:
+                    pass  # best-effort; LRU pressure reclaims later
             if not self.keep_spool:
                 import shutil
 
@@ -728,6 +750,7 @@ class FleetRunner:
                 "rows_out": 0, "bytes_out": 0, "elapsed_ms": 0.0,
                 "retries": 0, "peak_memory_bytes": 0,
                 "admission_wait_ms": 0.0,
+                "direct_bytes": 0, "spooled_bytes": 0,
             })
 
         for ts in self._task_stats:
@@ -748,8 +771,17 @@ class FleetRunner:
             st["admission_wait_ms"] += float(
                 ts.get("admission_wait_ms", 0.0) or 0
             )
+            st["direct_bytes"] += int(ts.get("direct_bytes", 0) or 0)
+            st["spooled_bytes"] += int(ts.get("spooled_bytes", 0) or 0)
         for sid, n in self._retries_by_stage.items():
             entry(sid)["retries"] = n
+        for st in by_stage.values():
+            # fraction of exchange input bytes a stage's tasks pulled
+            # straight from producer memory (vs. the durable spool)
+            tot = st["direct_bytes"] + st["spooled_bytes"]
+            st["direct_fetch_ratio"] = (
+                st["direct_bytes"] / tot if tot else 0.0
+            )
         order = [s.stage_id for s in stages]
         return [by_stage[sid] for sid in order if sid in by_stage]
 
@@ -1573,8 +1605,16 @@ class FleetRunner:
                 sid = stage.stage_id
                 # committed-partition sets ride on every status
                 # response: the event feed of pipelined admission
+                # (the worker URI doubles as the direct-exchange
+                # buffer-residency hint for consumer admissions; in
+                # serving mode the reactor's binding is authoritative)
+                wuri = w.uri
+                if self.dispatcher is not None:
+                    wuri = self.dispatcher.residency(tid, a) or w.uri
                 for p in state.get("partitions") or ():
-                    sched.on_partition_commit(sid, tid, a, int(p))
+                    sched.on_partition_commit(
+                        sid, tid, a, int(p), worker=wuri
+                    )
                 if state["state"] == "FINISHED":
                     del inflight[key]
                     if self.dispatcher is not None:
@@ -1582,7 +1622,7 @@ class FleetRunner:
                     if tid in done_of[sid]:
                         continue  # duplicate commit of a raced attempt
                     done_of[sid].add(tid)
-                    sched.on_task_commit(sid, tid, a)
+                    sched.on_task_commit(sid, tid, a, worker=wuri)
                     # per-task stats + worker-side span subtree ride on
                     # the FINISHED status response
                     tstats = state.get("stats") or {}
@@ -1625,6 +1665,10 @@ class FleetRunner:
                         ),
                         "admission_wait_ms": sched.admission_wait_ms(
                             tid
+                        ),
+                        "direct_bytes": tstats.get("direct_bytes", 0),
+                        "spooled_bytes": tstats.get(
+                            "spooled_bytes", 0
                         ),
                     }
                     self._task_stats.append(task_row)
@@ -1851,6 +1895,16 @@ class FleetRunner:
                         {"attempts": pins[i.stage_id]["attempts"]}
                         if pins and i.stage_id in pins
                         and "attempts" in pins[i.stage_id]
+                        else {}
+                    ),
+                    # direct-exchange residency hints: which worker's
+                    # buffer pool holds each pinned attempt's output
+                    # (best-effort — a consumer without hints, or
+                    # whose fetch misses, reads the spool)
+                    **(
+                        {"workers": pins[i.stage_id]["workers"]}
+                        if pins and i.stage_id in pins
+                        and "workers" in pins[i.stage_id]
                         else {}
                     ),
                 }

@@ -15,8 +15,11 @@ Run: ``python -m trino_tpu.server.worker --port 8091 [--mesh]``.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import urllib.request
 import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from trino_tpu import fault, telemetry
@@ -47,6 +50,103 @@ class _Task:
         #: poll so the coordinator's pipelined scheduler can admit
         #: consumers before the task finishes
         self.partitions: list[int] = []
+        #: owning query — stage-task ids repeat across queries on a
+        #: long-lived worker, so direct-exchange lookups must also
+        #: match the query before trusting a task record
+        self.query_id = ""
+
+
+class _ExchangeBuffer:
+    """Producer-side buffer pool of the direct exchange path.
+
+    Committed output partitions stay resident as raw spool-encoded
+    bytes (the exact SPL1 frame + CRC the on-disk file carries), keyed
+    by ``(query_id, task_id, attempt, partition)`` so a consumer
+    pinned to one attempt can structurally never be served another
+    attempt's bytes. Every entry is reserved through the producing
+    task's MemoryContext, best-effort: under pressure the pool evicts
+    LRU entries, and a partition that still does not fit is simply not
+    buffered. The pool is a cache, never a source of truth — the async
+    spool commit made the bytes durable before they were offered here,
+    so any miss, eviction, or producer death degrades the consumer to
+    ``spool.read_partition`` with identical results."""
+
+    def __init__(self, cap_bytes: int | None = None):
+        self._lock = threading.Lock()
+        #: key -> (raw, crc, memory ctx); insertion order is LRU order
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+        self.cap_bytes = int(
+            cap_bytes if cap_bytes is not None
+            else os.environ.get(
+                "TRINO_TPU_EXCHANGE_BUFFER_BYTES", 128 << 20
+            )
+        )
+
+    def put(self, key: tuple, raw: bytes, crc: int, ctx) -> bool:
+        need = len(raw)
+        with self._lock:
+            if key in self._entries:
+                return True
+            if need > self.cap_bytes:
+                return False
+            while (
+                self._bytes + need > self.cap_bytes
+                or not ctx.try_reserve(need)
+            ):
+                if not self._entries:
+                    return False
+                self._evict_locked()
+            self._entries[key] = (raw, int(crc), ctx)
+            self._bytes += need
+            telemetry.EXCHANGE_BUFFER_RESERVED.set(self._bytes)
+            return True
+
+    def get(self, key: tuple) -> tuple | None:
+        """``(raw, crc)`` for an exact key match, else None."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            self._entries.move_to_end(key)
+            return e[0], e[1]
+
+    def drop_task(self, query_id: str, task_id: str, attempt: int):
+        """Release a canceled attempt's buffers (losing speculative
+        attempts; pinned consumers fall back to the durable spool)."""
+        with self._lock:
+            for key in [
+                k for k in self._entries
+                if k[0] == query_id and k[1] == task_id
+                and k[2] == attempt
+            ]:
+                self._release_locked(key)
+            telemetry.EXCHANGE_BUFFER_RESERVED.set(self._bytes)
+
+    def drop_query(self, query_id: str):
+        """Release every buffer of a finished query — the 'all pinned
+        consumers have fetched' eviction point (a query's exchange has
+        no readers once the query is done)."""
+        with self._lock:
+            for key in [
+                k for k in self._entries if k[0] == query_id
+            ]:
+                self._release_locked(key)
+            telemetry.EXCHANGE_BUFFER_RESERVED.set(self._bytes)
+
+    def _evict_locked(self):
+        key = next(iter(self._entries))
+        self._release_locked(key)
+        telemetry.EXCHANGE_BUFFER_EVICTIONS.inc()
+        telemetry.EXCHANGE_BUFFER_RESERVED.set(self._bytes)
+
+    def _release_locked(self, key: tuple):
+        raw, _crc, ctx = self._entries.pop(key)
+        self._bytes -= len(raw)
+        try:
+            ctx.free(len(raw))
+        except Exception:
+            pass
 
 
 class InjectedTaskFailure(fault.InjectedFault):
@@ -137,6 +237,7 @@ class WorkerServer:
                 # (list append/copy are atomic under the GIL, so no
                 # lock against the run thread is needed)
                 payload["partitions"] = list(t.partitions)
+                payload["query_id"] = t.query_id
                 # pool snapshot on every status response: the
                 # coordinator's ClusterMemoryManager aggregates these
                 # (the heartbeat memory surface of the reference's
@@ -146,8 +247,59 @@ class WorkerServer:
                 )
                 self._send(200, payload)
 
+            def _buffer_fetch(self, task_id, attempt, part, query):
+                from urllib.parse import parse_qs
+
+                try:
+                    a, p = int(attempt), int(part)
+                except ValueError:
+                    self._send(404, {"error": "bad attempt/partition"})
+                    return
+                qid = (parse_qs(query).get("query") or [""])[0]
+                entry = worker.exchange_buffer.get(
+                    (qid, task_id, a, p)
+                )
+                if entry is not None:
+                    raw, crc = entry
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.send_header("Content-Length", str(len(raw)))
+                    self.send_header("X-Trino-File-CRC", str(crc))
+                    self.end_headers()
+                    self.wfile.write(raw)
+                    return
+                t = worker._tasks.get(f"{task_id}.{a}")
+                if (
+                    t is not None and t.query_id == qid
+                    and t.state == "FINISHED"
+                    and p not in t.partitions
+                ):
+                    # definitively absent: the attempt committed and
+                    # never wrote this partition (vs. a 404 miss /
+                    # eviction, where the consumer must try the spool)
+                    self.send_response(204)
+                    self.end_headers()
+                    return
+                self._send(404, {"error": "not buffered"})
+
             def do_GET(self):
-                parts = self.path.strip("/").split("/")
+                path, _, query = self.path.partition("?")
+                parts = path.strip("/").split("/")
+                if (
+                    len(parts) == 6
+                    and parts[:2] == ["v1", "stagetask"]
+                    and parts[3] == "results"
+                ):
+                    # direct-exchange fetch: raw committed partition
+                    # bytes straight out of the producer's buffer
+                    # pool. Exact attempt match only — a consumer
+                    # pinned to attempt N is never served attempt M.
+                    self._buffer_fetch(
+                        parts[2], parts[4], parts[5], query
+                    )
+                    return
                 if parts == ["v1", "metrics"]:
                     # Prometheus text exposition of the process-wide
                     # registry (worker-side counters: task states,
@@ -221,10 +373,23 @@ class WorkerServer:
                     ok = worker.cancel_task(parts[2])
                     self._send(200 if ok else 404, {"canceled": ok})
                     return
+                if len(parts) == 3 and parts[:2] == ["v1", "exchange"]:
+                    # query-end buffer release: all pinned consumers
+                    # have fetched once the query is done, so the
+                    # coordinator drops the query's direct-exchange
+                    # buffers on every worker
+                    worker.exchange_buffer.drop_query(parts[2])
+                    self._send(200, {"released": parts[2]})
+                    return
                 self._send(404, {"error": "not found"})
 
+        #: direct-exchange buffer pool: committed output partitions of
+        #: this worker's stage tasks, served to consumers over
+        #: GET /v1/stagetask/{task}/results/{attempt}/{partition}
+        self.exchange_buffer = _ExchangeBuffer()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
+        self._self_uri = f"http://127.0.0.1:{self.port}"
         # memory-pool snapshots attribute to this worker's address
         # (the node_id shown in kill-policy errors and
         # system.runtime.memory)
@@ -370,7 +535,128 @@ class WorkerServer:
             if t.state in ("RUNNING", "FINISHED", "FAILED"):
                 t.state = "CANCELED"
             t.payload = None
+        # a canceled stage attempt keeps no exchange buffers; pinned
+        # consumers fall back to whatever it durably committed
+        tid, _, a = task_id.rpartition(".")
+        if tid and a.isdigit():
+            self.exchange_buffer.drop_task(t.query_id, tid, int(a))
         return True
+
+    # ---- direct exchange (consumer side) ---------------------------------
+
+    #: sentinel: the producer attempt committed WITHOUT this partition
+    _ABSENT = object()
+
+    def _fetch_buffer(self, uri: str, qid: str, tid: str,
+                      attempt: int, part: int):
+        """One partition's ``(raw, crc)`` from a producer's buffer
+        pool, ``_ABSENT`` when the attempt definitively never wrote
+        the partition, or an exception on miss/eviction/unreachable
+        producer (the caller falls back to the spool)."""
+        if uri.rstrip("/") == self._self_uri:
+            entry = self.exchange_buffer.get((qid, tid, attempt, part))
+            if entry is not None:
+                return entry
+            t = self._tasks.get(f"{tid}.{attempt}")
+            if (
+                t is not None and t.query_id == qid
+                and t.state == "FINISHED"
+                and part not in t.partitions
+            ):
+                return WorkerServer._ABSENT
+            raise LookupError(f"{tid}.{attempt} p{part} not buffered")
+        url = (
+            f"{uri}/v1/stagetask/{tid}/results/{attempt}/{part}"
+            f"?query={qid}"
+        )
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            if resp.status == 204:
+                return WorkerServer._ABSENT
+            raw = resp.read()
+            crc = resp.headers.get("X-Trino-File-CRC")
+            return raw, (int(crc) if crc else None)
+
+    def _producer_partitions(self, uri: str, qid: str, tid: str,
+                             attempt: int) -> list[int]:
+        """Committed partition ids of a FINISHED producer attempt —
+        the fetch list for gather/broadcast edges, which read the
+        producer's whole output."""
+        if uri.rstrip("/") == self._self_uri:
+            t = self._tasks.get(f"{tid}.{attempt}")
+            if (
+                t is None or t.query_id != qid
+                or t.state != "FINISHED"
+            ):
+                raise LookupError(f"{tid}.{attempt} not finished here")
+            return sorted(set(t.partitions))
+        url = f"{uri}/v1/stagetask/{tid}.{attempt}"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            state = json.loads(resp.read())
+        if (
+            state.get("state") != "FINISHED"
+            or state.get("query_id") != qid
+        ):
+            raise LookupError(f"{tid}.{attempt} not finished at {uri}")
+        return sorted({int(p) for p in state.get("partitions") or ()})
+
+    def _direct_read(self, src: dict, part: int | None, qid: str):
+        """Serve one RemoteSource edge from producer memory: returns
+        ``(payload, direct_bytes)``, or ``(None, 0)`` to fall back to
+        the spool. Mirrors ``spool.read_partition`` exactly — same
+        task_ids concatenation order, same ascending partition order
+        within a producer, same per-producer spool-read fault seam (an
+        armed spool-read schedule fails the task identically in both
+        exchange modes) — so DIRECT results are byte-identical to
+        SPOOL. Only the exchange-fetch site is absorbed here: a fired
+        fetch fault, like any miss/eviction/producer-death/integrity
+        failure, silently degrades the edge to the durable spool copy
+        and never fails the task."""
+        from trino_tpu.exec import spool
+
+        attempts = src.get("attempts") or {}
+        hints = src.get("workers") or {}
+        if not attempts or not hints:
+            return None, 0
+        sid = src["stage_id"]
+        payloads: list[dict] = []
+        total = 0
+        for tid in src["task_ids"]:
+            # the same read seam the spool path runs per producer task
+            fault.check("spool-read", tag=f"{sid}:{tid}")
+            uri = hints.get(tid)
+            a = attempts.get(tid)
+            if uri is None or a is None:
+                return None, 0
+            try:
+                fault.check("exchange-fetch", tag=f"{sid}:{tid}")
+                if part is not None:
+                    wanted = [int(part)]
+                else:
+                    wanted = self._producer_partitions(
+                        uri, qid, tid, int(a)
+                    )
+                for p in wanted:
+                    got = self._fetch_buffer(
+                        uri, qid, tid, int(a), p
+                    )
+                    if got is WorkerServer._ABSENT:
+                        continue
+                    raw, crc = got
+                    payloads.append(
+                        spool.payload_from_bytes(raw, expect_crc=crc)
+                    )
+                    total += len(raw)
+            except fault.InjectedFault as e:
+                if e.site != "exchange-fetch":
+                    raise
+                return None, 0
+            except Exception:
+                return None, 0
+        if not payloads:
+            # no producer had data (empty edge): let the spool path
+            # rebuild the typed zero-row payload from its schema files
+            return None, 0
+        return spool._concat_payloads(payloads), total
 
     def submit_stage(self, req: dict) -> "_Task":
         """Execute one fleet stage task: a plan fragment whose
@@ -383,6 +669,7 @@ class WorkerServer:
 
         tkey = f"{req['task_id']}.{req['attempt']}"
         task = _Task(tkey)
+        task.query_id = str(req.get("query_id") or req["task_id"])
         with self._lock:
             self._tasks[tkey] = task
 
@@ -411,6 +698,8 @@ class WorkerServer:
             peak_bytes = 0
             op_stats: list = []
             col_ranges: dict = {}
+            direct_bytes = 0
+            spooled_bytes = 0
             try:
                 if req.get("fail"):
                     raise InjectedTaskFailure(
@@ -460,6 +749,13 @@ class WorkerServer:
                             tag=f"{out['stage_id']}:{req['task_id']}",
                             attempt=int(req["attempt"]),
                         )
+                        qid = str(
+                            req.get("query_id") or req["task_id"]
+                        )
+                        sess = req.get("session") or {}
+                        use_direct = str(
+                            sess.get("exchange_mode") or "DIRECT"
+                        ).upper() != "SPOOL"
                         pages = {}
                         read_sp = tspan.child("spool-read", "spool")
                         for src in req["sources"]:
@@ -467,10 +763,23 @@ class WorkerServer:
                                 partition if src["mode"] == "aligned"
                                 else None
                             )
-                            payload = spool.read_partition(
-                                root, src["stage_id"], src["task_ids"],
-                                part, attempts=src.get("attempts"),
-                            )
+                            payload = None
+                            if use_direct:
+                                # producer-memory first; any miss or
+                                # fault falls back to the spool below
+                                payload, nb = self._direct_read(
+                                    src, part, qid
+                                )
+                                direct_bytes += nb
+                            if payload is None:
+                                nb: list = []
+                                payload = spool.read_partition(
+                                    root, src["stage_id"],
+                                    src["task_ids"], part,
+                                    attempts=src.get("attempts"),
+                                    on_bytes=nb.append,
+                                )
+                                spooled_bytes += sum(nb)
                             if payload.get("cols"):
                                 rows_in += len(payload["cols"][0][0])
                             pages[src["source_id"]] = spool.host_to_page(
@@ -478,6 +787,15 @@ class WorkerServer:
                             )
                         read_sp.finish()
                         read_sp.attrs["rows"] = rows_in
+                        read_sp.attrs["direct_bytes"] = direct_bytes
+                        if direct_bytes:
+                            telemetry.EXCHANGE_DIRECT_BYTES.inc(
+                                direct_bytes
+                            )
+                        if spooled_bytes:
+                            telemetry.EXCHANGE_SPOOLED_BYTES.inc(
+                                spooled_bytes
+                            )
                         saved = dict(self.runner.session.properties)
                         self.runner.session.properties.update(
                             req.get("session") or {}
@@ -492,7 +810,6 @@ class WorkerServer:
                         # query -> task context: reservations made by
                         # this fragment attribute to the owning query in
                         # the pool snapshot the coordinator aggregates
-                        qid = str(req.get("query_id") or req["task_id"])
                         prev_ctx = ex.memory_ctx
                         task_ctx = ex.memory_pool.query_context(
                             qid
@@ -546,6 +863,26 @@ class WorkerServer:
                                 write_sp = tspan.child(
                                     "spool-write", "spool"
                                 )
+                                # keep each committed partition's raw
+                                # bytes resident for direct-exchange
+                                # consumers, reserved on the task's
+                                # memory context (best-effort — an
+                                # unbuffered partition is served from
+                                # the spool)
+                                buf_ctx = task_ctx.child(
+                                    "exchange-buffer"
+                                )
+
+                                def _stash(p, raw, crc):
+                                    self.exchange_buffer.put(
+                                        (
+                                            qid, req["task_id"],
+                                            int(req["attempt"]),
+                                            int(p),
+                                        ),
+                                        raw, crc, buf_ctx,
+                                    )
+
                                 out_stats = spool.write_task_output(
                                     root, out["stage_id"],
                                     req["task_id"],
@@ -559,6 +896,9 @@ class WorkerServer:
                                         ) or 0
                                     ),
                                     on_partition=task.partitions.append,
+                                    on_partition_bytes=(
+                                        _stash if use_direct else None
+                                    ),
                                 ) or out_stats
                                 write_sp.finish()
                                 write_sp.attrs.update(out_stats)
@@ -595,6 +935,8 @@ class WorkerServer:
                             ),
                             "peak_memory_bytes": int(peak_bytes),
                             "operator_stats": op_stats,
+                            "direct_bytes": int(direct_bytes),
+                            "spooled_bytes": int(spooled_bytes),
                             **(
                                 {"col_ranges": col_ranges}
                                 if col_ranges else {}

@@ -347,6 +347,17 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "varchar", "PIPELINED",
             _one_of("stage_admission", {"BARRIER", "PIPELINED"}),
         ),
+        _P(
+            "exchange_mode",
+            "Inter-stage exchange data path: DIRECT serves committed "
+            "partitions straight from the producer worker's in-memory "
+            "output buffer (spool write becomes an async background "
+            "commit, read falls back to the spool on miss/eviction/"
+            "producer death); SPOOL forces every edge through the "
+            "on-disk spool (the FTE filesystem-exchange analog)",
+            "varchar", "DIRECT",
+            _one_of("exchange_mode", {"DIRECT", "SPOOL"}),
+        ),
         # ---- observability --------------------------------------------
         _P(
             "slow_query_log_threshold",

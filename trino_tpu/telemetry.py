@@ -475,6 +475,18 @@ EXCHANGE_ROWS = REGISTRY.counter(
     "trino_exchange_rows_total", "Rows moved through mesh exchanges")
 EXCHANGE_BYTES = REGISTRY.counter(
     "trino_exchange_bytes_total", "Bytes moved through mesh exchanges")
+EXCHANGE_DIRECT_BYTES = REGISTRY.counter(
+    "trino_exchange_direct_bytes_total",
+    "Exchange bytes served straight from producer memory buffers")
+EXCHANGE_SPOOLED_BYTES = REGISTRY.counter(
+    "trino_exchange_spooled_bytes_total",
+    "Exchange bytes read back from the on-disk spool")
+EXCHANGE_BUFFER_RESERVED = REGISTRY.gauge(
+    "trino_exchange_buffer_reserved_bytes",
+    "Bytes currently held in the worker's direct-exchange buffer pool")
+EXCHANGE_BUFFER_EVICTIONS = REGISTRY.counter(
+    "trino_exchange_buffer_evictions_total",
+    "Direct-exchange buffer entries evicted before every consumer fetched")
 MEMORY_RESERVED = REGISTRY.gauge(
     "trino_memory_pool_reserved_bytes", "Currently reserved bytes per memory pool")
 MEMORY_PEAK = REGISTRY.gauge(

@@ -189,6 +189,14 @@ def _scenarios(fleet: FleetRunner, policy: str):
              inj.arm_probability("spool-write", 0.2),
              inj.arm_probability("device-oom", 0.15),
          )),
+        # every attempt-0 direct-exchange fetch faults mid-fetch ->
+        # the consumer silently falls back to the durable spool copy.
+        # The task NEVER fails (the site is absorbed, not fatal), so
+        # the only evidence is the workers' chaos-injection counters
+        # (absorbed_sites) plus the oracle check proving the fallback
+        # read the same bytes
+        ("exchange-fetch", _JOIN_SQL,
+         lambda inj: inj.arm("exchange-fetch", times=1)),
     ]
     if policy == "QUERY":
         scenarios += [
@@ -209,6 +217,27 @@ def _scenarios(fleet: FleetRunner, policy: str):
              ]),
         ]
     return scenarios
+
+
+def _worker_chaos_counts(worker_uris) -> dict:
+    """Summed per-site chaos-injection counters scraped off every
+    worker's /v1/metrics — the evidence channel for ABSORBED faults
+    (sites like exchange-fetch whose firing degrades a code path
+    instead of failing the task, so nothing reaches failure_log)."""
+    totals: dict = {}
+    pat = re.compile(
+        r'trino_chaos_injections_total\{site="([^"]+)"\}\s+(\d+)'
+    )
+    for uri in worker_uris:
+        with urllib.request.urlopen(
+            f"{uri}/v1/metrics", timeout=5
+        ) as resp:
+            txt = resp.read().decode()
+        for m in pat.finditer(txt):
+            totals[m.group(1)] = (
+                totals.get(m.group(1), 0) + int(m.group(2))
+            )
+    return totals
 
 
 def run_chaos_soak(
@@ -240,11 +269,13 @@ def run_chaos_soak(
                 seed=seed, max_attempts=fleet.max_attempts
             )
             arm(inj)
+            before = _worker_chaos_counts(worker_uris)
             fault.activate(inj)
             try:
                 result = fleet.execute(sql)
             finally:
                 fault.deactivate()
+            after = _worker_chaos_counts(worker_uris)
             expected = oracle.execute(to_sqlite(sql)).fetchall()
             assert_rows_match(
                 result.rows, expected, ordered=result.ordered,
@@ -262,6 +293,15 @@ def run_chaos_soak(
                     d for d in inj.decisions if d[3] is not None
                 ),
                 "worker_fired": worker_fired,
+                # sites whose worker-side injection counters moved
+                # during the scenario: catches absorbed faults (the
+                # SET is seed-deterministic; raw counts would carry
+                # scheduler interleaving noise, so they stay out of
+                # the canonical record)
+                "absorbed_sites": sorted(
+                    site for site, n in after.items()
+                    if n > before.get(site, 0)
+                ),
                 "tasks_retried": result.tasks_retried,
                 "query_retries": result.query_retries,
             })
@@ -350,4 +390,5 @@ def fired_sites(record: dict) -> set[str]:
                 sites.add(site)
             for site, _tag, _attempt, _kind in run["worker_fired"]:
                 sites.add(site)
+            sites.update(run.get("absorbed_sites") or ())
     return sites
