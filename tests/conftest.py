@@ -10,6 +10,12 @@ import os
 
 # Must be set before jax initializes.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Validate the plan after every optimizer rewrite under tests (prod
+# default is FINAL-only).  Env-seeded so fleet worker subprocesses
+# inherit the setting (the session property default reads this env
+# var at import time).
+os.environ.setdefault("TRINO_TPU_PLAN_VALIDATION", "FULL")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -170,6 +170,11 @@ def test_mesh_fleet_three_way_join_minimal_repro(fleet, oracle):
     mesh — no filters, no date arithmetic, plain sum/group/limit.
     Either 2-way sub-join alone returns oracle-exact rows."""
     fleet.session.properties["join_distribution_type"] = "PARTITIONED"
+    # debug assertion (plan.validate): count rows across every
+    # exchange edge so when this xfails it names the edge that dropped
+    # rows (mesh collective or fleet spool edge) instead of just
+    # producing a wrong row set
+    fleet.session.properties["check_exchange_coverage"] = True
     check(
         fleet, oracle,
         "select o_orderkey, sum(l_extendedprice) rev "

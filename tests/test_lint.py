@@ -1,0 +1,331 @@
+"""Engine linter: every rule must flag its synthetic violation, skip
+the clean twin, and honor inline suppressions; the CLI must exit
+non-zero on findings and emit machine-readable JSON.
+
+No jax import — the linter is pure stdlib ast so it runs in the CI
+lint job without the accelerator stack.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from tools.lint import run_lint
+from tools.lint.__main__ import main as lint_main
+
+
+def write(tmp_path: Path, name: str, body: str) -> Path:
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return p
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---- LCK001 -----------------------------------------------------------------
+
+def test_lck001_bare_acquire(tmp_path):
+    p = write(tmp_path, "m.py", """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def bad(self):
+                self._mu.acquire()
+                work()
+                self._mu.release()
+
+            def good_with(self):
+                with self._mu:
+                    work()
+
+            def good_try(self):
+                self._mu.acquire()
+                try:
+                    work()
+                finally:
+                    self._mu.release()
+    """)
+    findings = run_lint([str(p)])
+    assert rules_of(findings) == ["LCK001"]
+    assert findings[0].line == 9
+    assert "finally" in findings[0].fixit
+
+
+def test_lck001_ignores_non_lock_acquire(tmp_path):
+    # .acquire() protocols that are NOT threading locks (resource
+    # groups, slot pools) must not be flagged
+    p = write(tmp_path, "m.py", """
+        class Pool:
+            def __init__(self, mgr):
+                self._mgr = mgr
+
+            def admit(self, q):
+                self._mgr.acquire(q)
+    """)
+    assert run_lint([str(p)]) == []
+
+
+# ---- LCK002 -----------------------------------------------------------------
+
+def test_lck002_unlooped_wait(tmp_path):
+    p = write(tmp_path, "m.py", """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._done = threading.Event()
+
+            def bad(self):
+                with self._cond:
+                    self._cond.wait()
+
+            def good(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait()
+
+            def event_wait_is_fine(self):
+                self._done.wait()
+    """)
+    findings = run_lint([str(p)])
+    assert rules_of(findings) == ["LCK002"]
+    assert "spurious" in findings[0].message
+
+
+# ---- LCK003 -----------------------------------------------------------------
+
+def test_lck003_undeclared_nesting(tmp_path):
+    p = write(tmp_path, "m.py", """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def nested(self):
+                with self._a:
+                    with self._b:
+                        work()
+    """)
+    findings = run_lint([str(p)])
+    assert rules_of(findings) == ["LCK003"]
+    assert "_LOCK_ORDER" in findings[0].message
+
+
+def test_lck003_declared_order(tmp_path):
+    ok = write(tmp_path, "ok.py", """
+        import threading
+
+        _LOCK_ORDER = ("_a", "_b")
+
+        class W:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def nested(self):
+                with self._a:
+                    with self._b:
+                        work()
+    """)
+    assert run_lint([str(ok)]) == []
+
+    bad = write(tmp_path, "bad.py", """
+        import threading
+
+        _LOCK_ORDER = ("_a", "_b")
+
+        class W:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def inverted(self):
+                with self._b:
+                    with self._a:
+                        work()
+    """)
+    findings = run_lint([str(bad)])
+    assert rules_of(findings) == ["LCK003"]
+    assert "inverting" in findings[0].message
+
+
+# ---- JAX001 -----------------------------------------------------------------
+
+def test_jax001_host_sync_in_compiled_chain(tmp_path):
+    p = write(tmp_path, "m.py", """
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        def kernel(x):
+            y = helper(x)
+            return y.item()
+
+        compiled = jax.jit(kernel)
+
+        def trace_time_is_fine(x):
+            return np.asarray(x)
+    """)
+    findings = run_lint([str(p)])
+    assert rules_of(findings) == ["JAX001", "JAX001"]
+    assert {f.line for f in findings} == {6, 10}
+
+
+def test_jax001_decorated(tmp_path):
+    p = write(tmp_path, "m.py", """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def kernel(x, n):
+            return x.block_until_ready()
+    """)
+    findings = run_lint([str(p)])
+    assert rules_of(findings) == ["JAX001"]
+
+
+# ---- REG001 -----------------------------------------------------------------
+
+def test_reg001_unregistered_site(tmp_path):
+    write(tmp_path, "fault.py", 'SITES = frozenset(["rpc", "spool-read"])\n')
+    p = write(tmp_path, "m.py", """
+        from trino_tpu import fault
+
+        def f():
+            fault.check("rcp", tag="typo")
+            fault.check("rpc", tag="fine")
+    """)
+    findings = run_lint([str(tmp_path)])
+    assert rules_of(findings) == ["REG001"]
+    assert "'rcp'" in findings[0].message
+    assert "rpc" in findings[0].fixit
+
+
+# ---- REG002 -----------------------------------------------------------------
+
+_TELEM = """
+    class _Registry:
+        def counter(self, name):
+            return object()
+
+    REGISTRY = _Registry()
+    QUERIES = REGISTRY.counter("q")
+    DEAD = REGISTRY.counter("dead")
+"""
+
+
+def test_reg002_undeclared_and_dead(tmp_path):
+    write(tmp_path, "telemetry.py", _TELEM)
+    write(tmp_path, "m.py", """
+        from trino_tpu import telemetry
+
+        def f():
+            telemetry.QUERIES.inc()
+            telemetry.GHOST.inc()
+    """)
+    findings = run_lint([str(tmp_path)])
+    assert sorted(rules_of(findings)) == ["REG002", "REG002"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "GHOST" in msgs  # emitted but undeclared
+    assert "DEAD" in msgs  # declared but never emitted
+
+
+# ---- suppression / CLI -----------------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    p = write(tmp_path, "m.py", """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def handoff(self):
+                self._mu.acquire()  # lint: disable=LCK001
+                return self._mu
+    """)
+    assert run_lint([str(p)]) == []
+
+
+def test_suppress_all_and_wrong_rule(tmp_path):
+    p = write(tmp_path, "m.py", """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def a(self):
+                self._mu.acquire()  # lint: disable=all
+                self._mu.acquire()  # lint: disable=LCK002
+    """)
+    findings = run_lint([str(p)])
+    assert rules_of(findings) == ["LCK001"]
+    assert findings[0].line == 10
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    bad = write(tmp_path, "m.py", """
+        import threading
+        _mu = threading.Lock()
+
+        def f():
+            _mu.acquire()
+    """)
+    rc = lint_main([str(bad), "--format=json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["count"] == 1
+    f = out["findings"][0]
+    assert f["rule"] == "LCK001"
+    assert f["path"] == str(bad)
+    assert f["line"] == 6
+    assert f["fixit"]
+
+    clean = write(tmp_path, "ok.py", "x = 1\n")
+    rc = lint_main([str(clean)])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_rule_filter(tmp_path, capsys):
+    p = write(tmp_path, "m.py", """
+        import threading
+        _mu = threading.Lock()
+        _other = threading.Lock()
+
+        def f():
+            _mu.acquire()
+            with _mu:
+                with _other:
+                    pass
+    """)
+    rc = lint_main([str(p), "--rule=LCK003", "--format=json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["rule"] for f in out["findings"]] == ["LCK003"]
+
+
+def test_head_tree_is_clean():
+    """The gate this PR lands: the engine tree lints clean, so CI can
+    block on any new finding."""
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "trino_tpu", "--format=json"],
+        cwd=repo, capture_output=True, text=True, timeout=120,
+    )
+    payload = json.loads(proc.stdout)
+    assert proc.returncode == 0, payload
+    assert payload["count"] == 0, payload

@@ -1,0 +1,387 @@
+"""Plan sanity checker: every invariant must catch its hand-built
+broken plan and attribute it to the named pass, and a deliberately
+broken optimizer rewrite must be caught mid-pipeline with the pass
+name in the error.
+"""
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.engine import QueryRunner
+from trino_tpu.expr.ir import AggCall, Call, InputRef, Literal
+from trino_tpu.plan import nodes as P
+from trino_tpu.plan import optimizer
+from trino_tpu.plan.fragment import Stage, StageInput
+from trino_tpu.plan.validate import (
+    ExchangeCoverageError,
+    PlanSanityError,
+    check_edge_coverage,
+    validate_plan,
+    validate_stages,
+)
+
+
+def scan(**cols):
+    return P.TableScan(
+        dict(cols), catalog="c", schema="s", table="t",
+        assignments={s: s for s in cols},
+    )
+
+
+def err(plan, phase="test-pass"):
+    with pytest.raises(PlanSanityError) as ei:
+        validate_plan(plan, phase=phase)
+    return ei.value
+
+
+# ---- plan-level invariants -------------------------------------------------
+
+def test_clean_plan_passes():
+    s = scan(a=T.BIGINT, b=T.VARCHAR)
+    f = P.Filter(
+        dict(s.outputs), source=s,
+        predicate=Call(T.BOOLEAN, "eq",
+                       (InputRef(T.BIGINT, "a"), Literal(T.BIGINT, 1))),
+    )
+    assert validate_plan(f, phase="x") is f
+
+
+def test_missing_symbol_named_with_phase():
+    s = scan(a=T.BIGINT)
+    f = P.Filter(
+        dict(s.outputs), source=s,
+        predicate=InputRef(T.BOOLEAN, "ghost"),
+    )
+    e = err(f, phase="push_predicates")
+    assert e.check == "symbols"
+    assert e.phase == "push_predicates"
+    assert "ghost" in str(e)
+    assert "push_predicates" in str(e)
+
+
+def test_project_type_mismatch():
+    s = scan(a=T.BIGINT)
+    p = P.Project(
+        {"x": T.VARCHAR}, source=s,
+        assignments={"x": InputRef(T.BIGINT, "a")},
+    )
+    e = err(p)
+    assert e.check == "types"
+    assert "x" in str(e)
+
+
+def test_passthrough_type_drift():
+    s = scan(a=T.BIGINT)
+    f = P.Filter(
+        {"a": T.DOUBLE}, source=s,
+        predicate=Literal(T.BOOLEAN, True),
+    )
+    assert err(f).check == "types"
+
+
+def test_aggregate_stray_output():
+    s = scan(a=T.BIGINT, b=T.BIGINT)
+    a = P.Aggregate(
+        {"a": T.BIGINT, "b": T.BIGINT, "n": T.BIGINT},
+        source=s, group_keys=["a"],
+        aggregates={"n": AggCall("count_all", (), T.BIGINT)},
+    )
+    e = err(a)
+    assert e.check == "symbols"
+    assert "'b'" in str(e)
+
+
+def test_join_incompatible_key_types():
+    lt = scan(a=T.BIGINT)
+    rt = scan(b=T.DOUBLE)
+    j = P.Join(
+        {"a": T.BIGINT, "b": T.DOUBLE},
+        kind="inner", left=lt, right=rt, criteria=[("a", "b")],
+    )
+    e = err(j)
+    assert e.check == "types"
+    assert "incompatible" in str(e)
+
+
+def test_join_sided_symbol_resolution():
+    # key symbols must come from the correct side, not just anywhere
+    lt = scan(a=T.BIGINT)
+    rt = scan(b=T.BIGINT)
+    j = P.Join(
+        {"a": T.BIGINT, "b": T.BIGINT},
+        kind="inner", left=lt, right=rt, criteria=[("b", "a")],
+    )
+    assert err(j).check == "symbols"
+
+
+def test_union_bad_symbol_map():
+    s1, s2 = scan(a=T.BIGINT), scan(a=T.BIGINT)
+    u = P.Union(
+        {"a": T.BIGINT}, all_sources=[s1, s2],
+        symbol_map={"a": ["a"]},  # one mapping for two sources
+    )
+    assert err(u).check == "symbols"
+
+
+def test_hash_exchange_without_symbols():
+    s = scan(a=T.BIGINT)
+    x = P.Exchange(
+        dict(s.outputs), source=s, partitioning="hash", hash_symbols=[],
+    )
+    e = err(x, phase="add_exchanges")
+    assert e.check == "exchanges"
+    assert e.phase == "add_exchanges"
+
+
+def test_dynamic_filter_without_criteria():
+    lt = scan(a=T.BIGINT)
+    rt = scan(b=T.BIGINT)
+    j = P.Join(
+        {"a": T.BIGINT}, kind="inner", left=lt, right=rt,
+        criteria=[], df_keep_frac=0.5,
+    )
+    assert err(j).check == "dynamic-filters"
+
+
+def test_shared_subtree_is_legal_but_cycle_is_not():
+    # grouping-sets planning shares one pre-aggregation subtree across
+    # Union branches: a DAG, not a defect
+    s = scan(a=T.BIGINT)
+    u = P.Union(
+        {"a": T.BIGINT}, all_sources=[s, s],
+        symbol_map={"a": ["a", "a"]},
+    )
+    validate_plan(u, phase="x")
+
+    f = P.Filter({"a": T.BIGINT}, source=None,
+                 predicate=Literal(T.BOOLEAN, True))
+    f.source = f  # self-loop
+    assert err(f).check == "acyclic"
+
+
+def test_multiple_violations_counted():
+    s = scan(a=T.BIGINT)
+    f = P.Filter(
+        {"a": T.DOUBLE, "zz": T.BIGINT}, source=s,
+        predicate=InputRef(T.BOOLEAN, "ghost"),
+    )
+    e = err(f)
+    assert "more violation" in str(e)
+
+
+# ---- optimizer pass attribution --------------------------------------------
+
+@pytest.fixture(scope="module")
+def runner():
+    return QueryRunner.tpch("tiny")
+
+
+def test_full_pipeline_validates_clean(runner):
+    runner.session.properties["plan_validation"] = "FULL"
+    try:
+        runner.plan_sql(
+            "SELECT o.o_orderkey, sum(l.l_quantity) FROM orders o "
+            "JOIN lineitem l ON o.o_orderkey = l.l_orderkey "
+            "GROUP BY o.o_orderkey"
+        )
+    finally:
+        runner.session.properties.pop("plan_validation", None)
+
+
+def test_broken_rewrite_attributed_to_pass(runner, monkeypatch):
+    # sabotage one optimizer pass: the checker must name it, not the
+    # passes before or after it
+    def broken(plan):
+        return P.Filter(
+            dict(plan.outputs), source=plan,
+            predicate=InputRef(T.BOOLEAN, "no_such_symbol"),
+        )
+
+    monkeypatch.setattr(optimizer, "_prune_columns", broken)
+    runner.session.properties["plan_validation"] = "FULL"
+    try:
+        with pytest.raises(PlanSanityError) as ei:
+            runner.plan_sql("SELECT o_orderkey FROM orders")
+        assert ei.value.phase == "prune_columns"
+        assert "no_such_symbol" in str(ei.value)
+    finally:
+        runner.session.properties.pop("plan_validation", None)
+
+
+def test_validation_off_skips_broken_rewrite(runner, monkeypatch):
+    def broken(plan):
+        return P.Filter(
+            dict(plan.outputs), source=plan,
+            predicate=InputRef(T.BOOLEAN, "no_such_symbol"),
+        )
+
+    monkeypatch.setattr(optimizer, "_prune_columns", broken)
+    runner.session.properties["plan_validation"] = "OFF"
+    try:
+        runner.plan_sql("SELECT o_orderkey FROM orders")
+    finally:
+        runner.session.properties.pop("plan_validation", None)
+
+
+# ---- fragment closure ------------------------------------------------------
+
+def _stage(stage_id, root, partitioning="single", hash_symbols=None,
+           inputs=None):
+    return Stage(
+        stage_id=stage_id, root=root, partitioning=partitioning,
+        hash_symbols=hash_symbols or [], inputs=inputs or [],
+    )
+
+
+def frag_err(stages):
+    with pytest.raises(PlanSanityError) as ei:
+        validate_stages(stages, phase="fragment_plan")
+    return ei.value
+
+
+def test_stages_clean():
+    producer = _stage("s0", scan(a=T.BIGINT), partitioning="hash",
+                      hash_symbols=["a"])
+    rs = P.RemoteSource({"a": T.BIGINT}, source_id="rss0")
+    consumer = _stage(
+        "s1", rs,
+        inputs=[StageInput(source_id="rss0", stage_id="s0",
+                           mode="aligned", hash_symbols=["a"])],
+    )
+    validate_stages([producer, consumer], phase="fragment_plan")
+
+
+def test_remote_source_without_producer():
+    rs = P.RemoteSource({"a": T.BIGINT}, source_id="rss9")
+    st = _stage(
+        "s1", rs,
+        inputs=[StageInput(source_id="rss9", stage_id="s9", mode="all")],
+    )
+    e = frag_err([st])
+    assert e.check == "fragments"
+    assert "rss9" in str(e)
+
+
+def test_undeclared_input():
+    rs = P.RemoteSource({"a": T.BIGINT}, source_id="rss0")
+    producer = _stage("s0", scan(a=T.BIGINT))
+    st = _stage("s1", rs, inputs=[])  # fragment reads rss0, declares nothing
+    assert frag_err([producer, st]).check == "fragments"
+
+
+def test_edge_schema_mismatch():
+    producer = _stage("s0", scan(a=T.BIGINT))
+    rs = P.RemoteSource({"a": T.BIGINT, "ghost": T.BIGINT},
+                        source_id="rss0")
+    consumer = _stage(
+        "s1", rs,
+        inputs=[StageInput(source_id="rss0", stage_id="s0", mode="all")],
+    )
+    e = frag_err([producer, consumer])
+    assert e.check == "fragments"
+    assert "ghost" in str(e)
+
+
+def test_hash_edge_on_symbol_producer_lacks():
+    producer = _stage("s0", scan(a=T.BIGINT), partitioning="hash",
+                      hash_symbols=["a"])
+    rs = P.RemoteSource({"a": T.BIGINT}, source_id="rss0")
+    consumer = _stage(
+        "s1", rs,
+        inputs=[StageInput(source_id="rss0", stage_id="s0",
+                           mode="aligned", hash_symbols=["zz"])],
+    )
+    e = frag_err([producer, consumer])
+    assert e.check == "exchanges"
+
+
+def test_aligned_partitioning_disagreement():
+    producer = _stage("s0", scan(a=T.BIGINT, b=T.BIGINT),
+                      partitioning="hash", hash_symbols=["a"])
+    rs = P.RemoteSource({"a": T.BIGINT, "b": T.BIGINT},
+                        source_id="rss0")
+    consumer = _stage(
+        "s1", rs,
+        inputs=[StageInput(source_id="rss0", stage_id="s0",
+                           mode="aligned", hash_symbols=["b"])],
+    )
+    e = frag_err([producer, consumer])
+    assert e.check == "exchanges"
+    assert "aligned" in str(e)
+
+
+def test_bad_topological_order():
+    rs = P.RemoteSource({"a": T.BIGINT}, source_id="rss1")
+    first = _stage(
+        "s0", rs,
+        inputs=[StageInput(source_id="rss1", stage_id="s1", mode="all")],
+    )
+    later = _stage("s1", scan(a=T.BIGINT))
+    assert frag_err([first, later]).check == "fragments"
+
+
+def test_duplicate_stage_ids():
+    s1 = _stage("s0", scan(a=T.BIGINT))
+    s2 = _stage("s0", scan(a=T.BIGINT))
+    assert frag_err([s1, s2]).check == "fragments"
+
+
+# ---- runtime edge coverage -------------------------------------------------
+
+def _cov_stages():
+    producer = _stage("s0", scan(a=T.BIGINT), partitioning="hash",
+                      hash_symbols=["a"])
+    rs = P.RemoteSource({"a": T.BIGINT}, source_id="rss0")
+    consumer = _stage(
+        "s1", rs,
+        inputs=[StageInput(source_id="rss0", stage_id="s0",
+                           mode="aligned", hash_symbols=["a"])],
+    )
+    return [producer, consumer]
+
+
+def test_edge_coverage_clean():
+    stats = [
+        {"state": "FINISHED", "stage_id": "s0", "task_id": "t0",
+         "rows_out": 10, "edge_rows": {}},
+        {"state": "FINISHED", "stage_id": "s1", "task_id": "t1",
+         "rows_out": 4, "edge_rows": {"rss0": 6}},
+        {"state": "FINISHED", "stage_id": "s1", "task_id": "t2",
+         "rows_out": 3, "edge_rows": {"rss0": 4}},
+    ]
+    check_edge_coverage(_cov_stages(), stats)
+
+
+def test_edge_coverage_dropped_rows_names_edge():
+    stats = [
+        {"state": "FINISHED", "stage_id": "s0", "task_id": "t0",
+         "rows_out": 10, "edge_rows": {}},
+        {"state": "FINISHED", "stage_id": "s1", "task_id": "t1",
+         "rows_out": 4, "edge_rows": {"rss0": 6}},
+        {"state": "FINISHED", "stage_id": "s1", "task_id": "t2",
+         "rows_out": 3, "edge_rows": {"rss0": 3}},  # one row short
+    ]
+    with pytest.raises(ExchangeCoverageError) as ei:
+        check_edge_coverage(_cov_stages(), stats)
+    assert "s0->s1" in str(ei.value)
+    assert ei.value.rows_in == 10
+    assert ei.value.rows_out == 9
+
+
+def test_edge_coverage_partial_broadcast():
+    producer = _stage("s0", scan(a=T.BIGINT))
+    rs = P.RemoteSource({"a": T.BIGINT}, source_id="rss0")
+    consumer = _stage(
+        "s1", rs,
+        inputs=[StageInput(source_id="rss0", stage_id="s0", mode="all")],
+    )
+    stats = [
+        {"state": "FINISHED", "stage_id": "s0", "task_id": "t0",
+         "rows_out": 5, "edge_rows": {}},
+        {"state": "FINISHED", "stage_id": "s1", "task_id": "t1",
+         "rows_out": 5, "edge_rows": {"rss0": 5}},
+        {"state": "FINISHED", "stage_id": "s1", "task_id": "t2",
+         "rows_out": 5, "edge_rows": {"rss0": 2}},  # partial broadcast
+    ]
+    with pytest.raises(ExchangeCoverageError):
+        check_edge_coverage([producer, consumer], stats)

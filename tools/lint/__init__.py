@@ -1,0 +1,35 @@
+"""Engine lint: AST-based static checks for this codebase's failure
+modes.
+
+Run as ``python -m tools.lint trino_tpu/``. Pure stdlib (``ast``) — no
+jax import, so it runs anywhere (CI lint job, pre-commit, laptops
+without the accelerator stack).
+
+Rules:
+
+- ``LCK001`` — lock ``acquire()`` without ``with``/``try-finally``
+  release on the same receiver (leaks the lock on any exception).
+- ``LCK002`` — ``Condition.wait()`` not inside a predicate ``while``
+  loop (condition wakeups are spurious; an ``if`` misses them).
+- ``LCK003`` — nested lock acquisition not covered by (or violating)
+  the module's declared ``_LOCK_ORDER`` (deadlock-by-inversion).
+- ``JAX001`` — host synchronization (``np.asarray``, ``.item()``,
+  ``block_until_ready``, ``jax.device_get``, …) inside a function
+  reachable from a compiled (``jax.jit``/``shard_map``) chain: either
+  a trace-time error waiting to happen or a silent pipeline stall.
+- ``REG001`` — fault-injection site string not in the registered
+  ``fault.SITES`` set (a typo'd chaos arm silently never fires).
+- ``REG002`` — metric accessed as ``telemetry.NAME`` but never
+  declared in ``trino_tpu/telemetry.py``, or declared but never
+  emitted anywhere (dead metric).
+
+Suppress a finding with a same-line comment::
+
+    lock.acquire()  # lint: disable=LCK001 -- handed off to callback
+
+``# lint: disable=all`` suppresses every rule on that line.
+"""
+
+from tools.lint.core import Finding, run_lint  # noqa: F401
+
+__all__ = ["Finding", "run_lint"]

@@ -1,0 +1,54 @@
+"""CLI: ``python -m tools.lint trino_tpu/ [--format=json] [--rule=LCK001]``.
+
+Exit status 0 when clean, 1 when any finding survives suppression,
+2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.lint.core import run_lint
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="AST-based engine linter (locks, jit boundaries, "
+        "fault/metric registries)",
+    )
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (default: text)",
+    )
+    ap.add_argument(
+        "--rule", action="append", default=None, metavar="RULE",
+        help="restrict to the given rule id (repeatable)",
+    )
+    args = ap.parse_args(argv)
+
+    findings = run_lint(
+        args.paths, rules=set(args.rule) if args.rule else None
+    )
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [f.to_dict() for f in findings],
+                "count": len(findings),
+            },
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"{len(findings)} finding(s)" if findings else "clean"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

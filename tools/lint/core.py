@@ -1,0 +1,143 @@
+"""Lint driver: file discovery, parsing, suppression handling.
+
+Per-file rules (LCK*, JAX001) see one module at a time; registry
+rules (REG*) see the whole file set at once so they can cross-check
+declaration sites against use sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    fixit: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fixit": self.fixit,
+        }
+
+    def render(self) -> str:
+        out = (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} {self.message}"
+        )
+        if self.fixit:
+            out += f"\n    fix: {self.fixit}"
+        return out
+
+
+@dataclass
+class SourceFile:
+    """One parsed module plus its suppression map."""
+
+    path: Path
+    display: str
+    tree: ast.Module
+    lines: list[str]
+    #: line number -> set of suppressed rule ids (or {"all"})
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return rules is not None and (rule in rules or "all" in rules)
+
+
+def _load(path: Path, display: str) -> SourceFile | None:
+    try:
+        text = path.read_text()
+        tree = ast.parse(text, filename=str(path))
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+    lines = text.splitlines()
+    supp: dict[int, set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            }
+            supp[i] = rules
+    return SourceFile(
+        path=path, display=display, tree=tree, lines=lines,
+        suppressions=supp,
+    )
+
+
+def _attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._lint_parent = node  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST):
+    """Ancestors from nearest to the module root."""
+    cur = getattr(node, "_lint_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_lint_parent", None)
+
+
+def discover(paths: list[str]) -> list[SourceFile]:
+    files: list[SourceFile] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            found = sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            found = [p]
+        else:
+            found = []
+        for f in found:
+            if "__pycache__" in f.parts:
+                continue
+            sf = _load(f, str(f))
+            if sf is not None:
+                files.append(sf)
+    for sf in files:
+        _attach_parents(sf.tree)
+    return files
+
+
+def run_lint(paths: list[str], rules: set[str] | None = None) -> list[Finding]:
+    """Lint every .py under ``paths``; return unsuppressed findings
+    sorted by location. ``rules`` optionally restricts to a subset of
+    rule ids."""
+    from tools.lint import rules as R
+
+    files = discover(paths)
+    findings: list[Finding] = []
+    for sf in files:
+        findings.extend(R.check_locks(sf))
+        findings.extend(R.check_jax_host_sync(sf))
+    findings.extend(R.check_fault_sites(files))
+    findings.extend(R.check_metric_registry(files))
+
+    by_path = {sf.display: sf for sf in files}
+    kept = []
+    for f in findings:
+        if rules is not None and f.rule not in rules:
+            continue
+        sf = by_path.get(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept

@@ -1,0 +1,625 @@
+"""Rule implementations for the engine linter.
+
+Everything here reasons over ``ast`` only. The lock rules track which
+receivers are actually ``threading.Lock/RLock/Condition`` objects
+(assigned from a ``threading.*`` constructor) so that unrelated
+``.acquire()``/``.wait()`` protocols — resource-group slot admission,
+``Event.wait`` — are never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.lint.core import Finding, SourceFile, parents
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_COND_CTORS = {"Condition"}
+
+
+# ---- receiver typing --------------------------------------------------------
+
+def _ctor_name(value: ast.AST) -> str | None:
+    """``threading.Lock()`` / ``Lock()`` -> "Lock" (None if the value
+    is not a call to a threading synchronization constructor)."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.value.id == "threading" and fn.attr in (
+            _LOCK_CTORS | {"Event", "Semaphore", "BoundedSemaphore"}
+        ):
+            return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_CTORS:
+        return fn.id
+    return None
+
+
+def _collect_receivers(tree: ast.Module):
+    """Names/attributes assigned a threading lock or condition.
+
+    Returns ``(lock_names, lock_attrs, cond_names, cond_attrs)`` —
+    module-level variable names and instance-attribute names. Attribute
+    names are collected module-wide (not per-class): a false merge
+    across classes is harmless because both receivers really are locks.
+    """
+    lock_names: set[str] = set()
+    lock_attrs: set[str] = set()
+    cond_names: set[str] = set()
+    cond_attrs: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        ctor = _ctor_name(value) if value is not None else None
+        if ctor is None or ctor not in _LOCK_CTORS:
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if isinstance(t, ast.Name):
+                lock_names.add(t.id)
+                if ctor in _COND_CTORS:
+                    cond_names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                lock_attrs.add(t.attr)
+                if ctor in _COND_CTORS:
+                    cond_attrs.add(t.attr)
+    return lock_names, lock_attrs, cond_names, cond_attrs
+
+
+def _is_lock_receiver(expr: ast.AST, names: set[str], attrs: set[str]) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in names
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in attrs
+    return False
+
+
+def _receiver_key(expr: ast.AST) -> str:
+    """Stable identity for 'same lock object' comparisons: the full
+    dotted path when resolvable, else the ast dump."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _receiver_key(expr.value)
+        return f"{base}.{expr.attr}"
+    return ast.dump(expr)
+
+
+def _lock_label(expr: ast.AST) -> str:
+    """Identifier used in ``_LOCK_ORDER`` declarations: the bare
+    variable or attribute name."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return _receiver_key(expr)
+
+
+# ---- LCK001 / LCK002 / LCK003 ----------------------------------------------
+
+def check_locks(sf: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    lock_names, lock_attrs, cond_names, cond_attrs = _collect_receivers(
+        sf.tree
+    )
+    if not (lock_names or lock_attrs):
+        return findings
+
+    # LCK001: bare acquire() without a try/finally release
+    for node in ast.walk(sf.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+            and _is_lock_receiver(node.func.value, lock_names, lock_attrs)
+        ):
+            continue
+        if _acquire_is_released(node):
+            continue
+        recv = _receiver_key(node.func.value)
+        findings.append(Finding(
+            rule="LCK001",
+            path=sf.display,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"{recv}.acquire() without with-statement or "
+                f"try/finally release — the lock leaks on any exception"
+            ),
+            fixit=(
+                f"use 'with {recv}:' or follow acquire() immediately "
+                f"with 'try: ... finally: {recv}.release()'"
+            ),
+        ))
+
+    # LCK002: Condition.wait() outside a predicate loop
+    for node in ast.walk(sf.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "wait"
+            and _is_lock_receiver(node.func.value, cond_names, cond_attrs)
+        ):
+            continue
+        in_loop = False
+        for anc in parents(node):
+            if isinstance(anc, (ast.While, ast.For)):
+                in_loop = True
+                break
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        if in_loop:
+            continue
+        recv = _receiver_key(node.func.value)
+        findings.append(Finding(
+            rule="LCK002",
+            path=sf.display,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"{recv}.wait() outside a predicate loop — condition "
+                f"wakeups are spurious, an un-looped wait misses or "
+                f"false-triggers"
+            ),
+            fixit=(
+                f"wrap in 'while not <predicate>: {recv}.wait()' or "
+                f"use {recv}.wait_for(<predicate>)"
+            ),
+        ))
+
+    # LCK003: nested acquisition order vs the module's declaration
+    order = _declared_lock_order(sf.tree)
+    for outer, inner, line, col in _nested_pairs(
+        sf.tree, lock_names, lock_attrs
+    ):
+        if outer == inner:
+            continue  # RLock re-entry / same lock — not an ordering issue
+        if order is not None and outer in order and inner in order:
+            if order.index(outer) > order.index(inner):
+                findings.append(Finding(
+                    rule="LCK003",
+                    path=sf.display,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"lock {inner!r} acquired while holding "
+                        f"{outer!r}, inverting the declared _LOCK_ORDER "
+                        f"{order}"
+                    ),
+                    fixit=(
+                        "acquire locks in _LOCK_ORDER order, or update "
+                        "the declaration if the hierarchy changed"
+                    ),
+                ))
+            continue
+        findings.append(Finding(
+            rule="LCK003",
+            path=sf.display,
+            line=line,
+            col=col,
+            message=(
+                f"lock {inner!r} acquired while holding {outer!r} but "
+                f"the module declares no _LOCK_ORDER covering both — "
+                f"undeclared nesting is how lock-order inversions creep "
+                f"in"
+            ),
+            fixit=(
+                f"declare _LOCK_ORDER = ({outer!r}, {inner!r}, ...) at "
+                f"module level (outermost first)"
+            ),
+        ))
+    return findings
+
+
+def _acquire_is_released(call: ast.Call) -> bool:
+    """True when the acquire() is paired with a release() via one of
+    the accepted shapes: inside a Try whose finalbody releases the
+    same receiver, or an Expr statement whose next sibling is such a
+    Try."""
+    recv = _receiver_key(call.func.value)  # type: ignore[attr-defined]
+
+    def releases(stmts) -> bool:
+        for s in stmts:
+            for n in ast.walk(s):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "release"
+                    and _receiver_key(n.func.value) == recv
+                ):
+                    return True
+        return False
+
+    # acquire somewhere inside a try whose finally releases
+    for anc in parents(call):
+        if isinstance(anc, ast.Try) and releases(anc.finalbody):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+
+    # stmt-level: `lock.acquire()` (or `ok = lock.acquire(...)`)
+    # immediately followed by `try: ... finally: lock.release()`
+    stmt = None
+    for anc in parents(call):
+        if isinstance(anc, ast.stmt):
+            stmt = anc
+            break
+    if stmt is None:
+        return False
+    parent = getattr(stmt, "_lint_parent", None)
+    for body_name in ("body", "orelse", "finalbody"):
+        body = getattr(parent, body_name, None)
+        if isinstance(body, list) and stmt in body:
+            i = body.index(stmt)
+            for nxt in body[i + 1:i + 3]:
+                if isinstance(nxt, ast.Try) and releases(nxt.finalbody):
+                    return True
+            break
+    return False
+
+
+def _declared_lock_order(tree: ast.Module) -> list[str] | None:
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "_LOCK_ORDER"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            out = []
+            for el in node.value.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.append(el.value)
+            return out
+    return None
+
+
+def _nested_pairs(tree, lock_names, lock_attrs):
+    """(outer_label, inner_label, line, col) for every lock acquired
+    while another is held, tracked through ``with`` statements within
+    one function body."""
+    pairs = []
+
+    def walk(node, held: list[str]):
+        acquired_here: list[str] = []
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if _is_lock_receiver(expr, lock_names, lock_attrs):
+                    label = _lock_label(expr)
+                    for outer in held + acquired_here:
+                        pairs.append(
+                            (outer, label, expr.lineno, expr.col_offset)
+                        )
+                    acquired_here.append(label)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested function body runs later, under whatever locks
+            # its caller holds — not under ours
+            for child in ast.iter_child_nodes(node):
+                walk(child, [])
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held + acquired_here)
+
+    walk(tree, [])
+    return pairs
+
+
+# ---- JAX001 -----------------------------------------------------------------
+
+_SYNC_ATTRS = {"item", "block_until_ready", "tolist"}
+_SYNC_NP_FUNCS = {"asarray", "array", "from_dlpack"}
+_NP_MODULES = {"np", "numpy"}
+
+
+def _compiled_roots(tree: ast.Module) -> set[str]:
+    """Names of functions handed to jax.jit / jax.shard_map — by
+    decorator (including through functools.partial) or by being passed
+    as an argument to a jit/shard_map call."""
+    roots: set[str] = set()
+
+    def mentions_jit(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr in (
+                "jit", "shard_map", "pmap",
+            ):
+                return True
+            if isinstance(n, ast.Name) and n.id in (
+                "jit", "shard_map", "pmap",
+            ):
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if mentions_jit(dec):
+                    roots.add(node.name)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            is_jit_call = (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("jit", "shard_map", "pmap")
+            ) or (
+                isinstance(fn, ast.Name)
+                and fn.id in ("jit", "shard_map", "pmap")
+            )
+            if is_jit_call:
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        roots.add(a.id)
+    return roots
+
+
+def check_jax_host_sync(sf: SourceFile) -> list[Finding]:
+    tree = sf.tree
+    roots = _compiled_roots(tree)
+    if not roots:
+        return []
+
+    # all function defs by name (module- or closure-scope; collisions
+    # merge, which over-approximates reachability — safe direction)
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    # call graph over locally-defined names
+    calls: dict[str, set[str]] = {}
+    for name, nodes in defs.items():
+        out: set[str] = set()
+        for fn in nodes:
+            for n in ast.walk(fn):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id in defs
+                ):
+                    out.add(n.func.id)
+        calls[name] = out
+
+    reachable: set[str] = set()
+    frontier = [r for r in roots if r in defs]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        frontier.extend(calls.get(name, ()))
+
+    findings: list[Finding] = []
+    flagged: set[int] = set()
+
+    def flag(node: ast.AST, what: str, fn_name: str) -> None:
+        if id(node) in flagged:
+            return
+        flagged.add(id(node))
+        findings.append(Finding(
+            rule="JAX001",
+            path=sf.display,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"host sync {what} inside {fn_name!r}, which is "
+                f"reachable from a jit/shard_map-compiled chain — "
+                f"either a trace-time TracerConversionError or a "
+                f"silent device round-trip"
+            ),
+            fixit=(
+                "hoist the conversion out of the compiled function "
+                "(trace-time/static values only), or suppress with "
+                "'# lint: disable=JAX001' if it provably runs on "
+                "static metadata"
+            ),
+        ))
+
+    for name in reachable:
+        for fn in defs[name]:
+            for n in ast.walk(fn):
+                # don't double-report inside nested defs that are
+                # reachable in their own right
+                if n is not fn and isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and n.name in reachable:
+                    continue
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _SYNC_ATTRS
+                ):
+                    flag(n, f".{f.attr}()", name)
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in _NP_MODULES
+                    and f.attr in _SYNC_NP_FUNCS
+                ):
+                    flag(n, f"{f.value.id}.{f.attr}()", name)
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "jax"
+                    and f.attr == "device_get"
+                ):
+                    flag(n, "jax.device_get()", name)
+    return findings
+
+
+# ---- REG001 / REG002 --------------------------------------------------------
+
+def _find(files: list[SourceFile], suffix: str) -> SourceFile | None:
+    for sf in files:
+        if sf.display.endswith(suffix):
+            return sf
+    return None
+
+
+def check_fault_sites(files: list[SourceFile]) -> list[Finding]:
+    """Every literal site string passed to ``fault.check``/``arm`` must
+    be registered in ``fault.SITES``."""
+    fault_mod = _find(files, "fault.py")
+    if fault_mod is None:
+        return []
+    sites: set[str] = set()
+    for node in ast.walk(fault_mod.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "SITES"
+                for t in node.targets
+            )
+        ):
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    sites.add(n.value)
+    if not sites:
+        return []
+
+    findings: list[Finding] = []
+    for sf in files:
+        if sf is fault_mod:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("check", "arm")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("fault", "_fault")
+            ):
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if not (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+            ):
+                continue
+            if first.value in sites:
+                continue
+            findings.append(Finding(
+                rule="REG001",
+                path=sf.display,
+                line=first.lineno,
+                col=first.col_offset,
+                message=(
+                    f"fault site {first.value!r} is not registered in "
+                    f"fault.SITES — this chaos hook can never be armed"
+                ),
+                fixit=(
+                    f"add {first.value!r} to SITES in trino_tpu/fault.py "
+                    f"or fix the typo (known sites: {sorted(sites)})"
+                ),
+            ))
+    return findings
+
+
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+
+
+def check_metric_registry(files: list[SourceFile]) -> list[Finding]:
+    """Cross-check ``telemetry.NAME`` accesses against the metric
+    constants declared in telemetry.py: an access with no declaration
+    is an AttributeError at emit time; a declaration with no access is
+    a dead metric cluttering the scrape."""
+    telem = _find(files, "telemetry.py")
+    if telem is None:
+        return []
+
+    declared: dict[str, int] = {}  # metric name -> decl line
+    other_names: set[str] = set()  # non-metric module-level names
+    for node in telem.tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        value = node.value
+        is_metric = (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr in _METRIC_CTORS
+        )
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if is_metric:
+                declared[t.id] = node.lineno
+            else:
+                other_names.add(t.id)
+    # everything telemetry.py exports at module level (classes,
+    # functions, REGISTRY itself) is a legitimate access target
+    for node in telem.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+            other_names.add(node.name)
+
+    findings: list[Finding] = []
+    used: set[str] = set()
+    # telemetry.py may emit its own metrics (compile hooks, counting
+    # caches) via bare name references — those are uses too
+    for node in ast.walk(telem.tree):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in declared
+        ):
+            used.add(node.id)
+    for sf in files:
+        if sf is telem:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "telemetry"
+            ):
+                continue
+            name = node.attr
+            if name in declared:
+                used.add(name)
+                continue
+            if name in other_names or name.startswith("_"):
+                continue
+            if not name.isupper():
+                continue  # method/instance access, not a metric constant
+            findings.append(Finding(
+                rule="REG002",
+                path=sf.display,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"telemetry.{name} is not declared in "
+                    f"trino_tpu/telemetry.py — AttributeError at emit "
+                    f"time"
+                ),
+                fixit=(
+                    f"declare {name} = REGISTRY.counter/gauge/"
+                    f"histogram(...) in trino_tpu/telemetry.py"
+                ),
+            ))
+    for name, line in sorted(declared.items()):
+        if name in used:
+            continue
+        findings.append(Finding(
+            rule="REG002",
+            path=telem.display,
+            line=line,
+            col=0,
+            message=(
+                f"metric {name} is declared but never emitted anywhere "
+                f"in the linted tree (dead metric)"
+            ),
+            fixit=(
+                "emit it where the event happens, or delete the "
+                "declaration"
+            ),
+        ))
+    return findings

@@ -1,0 +1,40 @@
+"""Run mypy over trino_tpu with the committed baseline (mypy.ini).
+
+Usage: ``python tools/typecheck.py [extra mypy args]``
+
+Exits 0 with a notice when mypy is not installed (the accelerator
+container does not ship it; the CI lint job pip-installs it), so this
+wrapper is safe to call from any environment.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main(argv: list[str] | None = None) -> int:
+    try:
+        import mypy  # noqa: F401
+    except ImportError:
+        print(
+            "typecheck: mypy is not installed in this environment — "
+            "skipping (the CI lint job runs it; "
+            "`pip install mypy` to run locally)"
+        )
+        return 0
+    cmd = [
+        sys.executable, "-m", "mypy",
+        "--config-file", str(REPO / "mypy.ini"),
+        "trino_tpu", "tools",
+        *(argv if argv is not None else sys.argv[1:]),
+    ]
+    proc = subprocess.run(cmd, cwd=REPO)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -208,11 +208,14 @@ class QueryRunner:
             # list columns), so a mesh stand-in that advertises
             # host_exchange distributes them normally.
             from trino_tpu.plan.distribute import add_exchanges
+            from trino_tpu.plan import validate as _validate
 
             plan = add_exchanges(
                 plan, self.metadata,
                 n_shards=self.mesh.devices.size, session=self.session,
             )
+            if optimized and _validate.level(self.session) != "OFF":
+                _validate.validate_plan(plan, phase="add_exchanges")
         if optimized:
             from trino_tpu.plan.stats import annotate
 

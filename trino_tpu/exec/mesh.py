@@ -565,7 +565,9 @@ class MeshExecutor(LocalExecutor):
         cols = [sp.column(k) for k in key_symbols]
         h = K.hash_columns(_exchange_key_pairs(cols))
         dest = (h % jnp.uint64(self.n_shards)).astype(jnp.int32)
-        return self.exchange_by_dest(sp, dest)
+        return self.exchange_by_dest(
+            sp, dest, edge=f"mesh-hash({', '.join(key_symbols)})"
+        )
 
     def range_exchange(
         self, sp: ShardedPage, sort_keys
@@ -625,14 +627,19 @@ class MeshExecutor(LocalExecutor):
             dest = jnp.searchsorted(
                 jnp.asarray(qs), bits, side="right"
             ).astype(jnp.int32)
-        return self.exchange_by_dest(sp, dest)
+        return self.exchange_by_dest(
+            sp, dest, edge=f"mesh-range({k.symbol})"
+        )
 
     def exchange_by_dest(
-        self, sp: ShardedPage, dest: jnp.ndarray
+        self, sp: ShardedPage, dest: jnp.ndarray,
+        edge: str = "mesh-exchange",
     ) -> ShardedPage:
         """Route every live row to the shard named by ``dest`` — the
         engine's shuffle: one all_to_all over ICI, with bucket-overflow
-        retry (the OutputBuffer backpressure analog)."""
+        retry (the OutputBuffer backpressure analog). ``edge`` names
+        the exchange for the ``check_exchange_coverage`` debug
+        assertion (live rows must be conserved across the shuffle)."""
         shard_cap = sp.shard_capacity
         n = self.n_shards
         bucket_cap = shape_policy.exchange_bucket(shard_cap, n)
@@ -697,6 +704,28 @@ class MeshExecutor(LocalExecutor):
                     valid = out[i]
                     i += 1
                 cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
+            from trino_tpu import session_properties as SP
+
+            if SP.get(self.session, "check_exchange_coverage"):
+                # debug assertion (forces a host sync): an all_to_all
+                # must conserve live rows — any loss here is exactly
+                # the mesh×fleet wrong-results class, attributed to
+                # this named edge instead of surfacing as a silently
+                # short result
+                from trino_tpu.plan.validate import ExchangeCoverageError
+
+                n_in, n_out = jax.device_get((
+                    jnp.sum(sp.mask.astype(jnp.int32)),
+                    jnp.sum(rlive.astype(jnp.int32)),
+                ))
+                if int(n_in) != int(n_out):
+                    raise ExchangeCoverageError(
+                        edge, int(n_in), int(n_out),
+                        detail=(
+                            f"{self.n_shards}-shard all_to_all, "
+                            f"bucket_cap={bucket_cap}"
+                        ),
+                    )
             return ShardedPage(list(sp.names), cols, rlive, self.n_shards)
 
     # ---- distributed joins ----------------------------------------------

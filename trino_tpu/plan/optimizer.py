@@ -42,22 +42,58 @@ from trino_tpu.plan import nodes as P
 __all__ = ["optimize"]
 
 
-def optimize(plan: P.PlanNode, metadata: Metadata, session: Session) -> P.PlanNode:
-    plan = _rewrite_bottom_up(plan, _merge_adjacent_filters)
-    plan = _rewrite_bottom_up(plan, _factor_filter_ors)
-    plan = _rewrite_bottom_up(plan, lambda n: _extract_joins(n, metadata))
-    plan = _push_predicates(plan, metadata)
+def _passes(metadata: Metadata, session: Session):
+    """The pipeline as (pass name, rewrite) pairs — named so the
+    per-pass sanity checker can attribute a broken invariant to the
+    rewrite that introduced it (PlanSanityChecker's
+    validateIntermediatePlan seam)."""
     from trino_tpu import session_properties as SP
 
+    passes = [
+        ("merge_adjacent_filters",
+         lambda p: _rewrite_bottom_up(p, _merge_adjacent_filters)),
+        ("factor_filter_ors",
+         lambda p: _rewrite_bottom_up(p, _factor_filter_ors)),
+        ("extract_joins",
+         lambda p: _rewrite_bottom_up(
+             p, lambda n: _extract_joins(n, metadata))),
+        ("push_predicates", lambda p: _push_predicates(p, metadata)),
+    ]
     if SP.get(session, "join_reordering_strategy") != "NONE":
-        plan = _reorder_inner_joins(plan, metadata)
-        # residual conjuncts hoisted by the reorder re-push onto the
-        # new tree
-        plan = _push_predicates(plan, metadata)
-    plan = _rewrite_bottom_up(plan, _push_semijoin_filters)
-    plan = _choose_build_sides(plan, metadata)
-    plan = _prune_columns(plan)
-    plan = _rewrite_bottom_up(plan, _annotate_scan_domains)
+        passes += [
+            ("reorder_inner_joins",
+             lambda p: _reorder_inner_joins(p, metadata)),
+            # residual conjuncts hoisted by the reorder re-push onto
+            # the new tree
+            ("push_predicates(post-reorder)",
+             lambda p: _push_predicates(p, metadata)),
+        ]
+    passes += [
+        ("push_semijoin_filters",
+         lambda p: _rewrite_bottom_up(p, _push_semijoin_filters)),
+        ("choose_build_sides",
+         lambda p: _choose_build_sides(p, metadata)),
+        ("prune_columns", lambda p: _prune_columns(p)),
+        ("annotate_scan_domains",
+         lambda p: _rewrite_bottom_up(p, _annotate_scan_domains)),
+    ]
+    return passes
+
+
+def optimize(plan: P.PlanNode, metadata: Metadata, session: Session) -> P.PlanNode:
+    from trino_tpu.plan import validate as V
+
+    check = V.level(session)
+    if check == "FULL":
+        # the analyzer's output is the baseline every pass is judged
+        # against — a violation here is the analyzer's, not a pass's
+        V.validate_plan(plan, phase="analyze")
+    for name, rewrite in _passes(metadata, session):
+        plan = rewrite(plan)
+        if check == "FULL":
+            V.validate_plan(plan, phase=name)
+    if check == "FINAL":
+        V.validate_plan(plan, phase="optimize(final)")
     return plan
 
 

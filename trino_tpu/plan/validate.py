@@ -1,0 +1,664 @@
+"""Plan sanity checking: invariants between optimizer passes.
+
+The analog of the reference's PlanSanityChecker pipeline
+(MAIN/sql/planner/sanity/PlanSanityChecker.java: ValidateDependenciesChecker,
+NoDuplicatePlanNodeIdsChecker, TypeValidator, ValidateStreamingJoins,
+DynamicFiltersChecker) for this engine's ~10-pass rewrite pipeline.
+Every checker is a pure function over the plan tree; a violation
+raises :class:`PlanSanityError` naming the pass that produced the
+broken plan, so "the optimizer silently produced a wrong plan" becomes
+a located failure instead of a bench-time mystery.
+
+Gating (session property ``plan_validation``):
+
+- ``OFF``   — never validate.
+- ``FINAL`` — validate the final optimized plan, the distributed plan
+  after ``add_exchanges``, and the fragmented stage DAG (production
+  default: one pass over each finished artifact).
+- ``FULL``  — additionally validate after every individual optimizer
+  rewrite pass (the test default — tests/conftest.py exports
+  ``TRINO_TPU_PLAN_VALIDATION=FULL``).
+
+The runtime half of the exchange-completeness story lives behind the
+``check_exchange_coverage`` session property: executors and the fleet
+coordinator count rows across each exchange edge and raise
+:class:`ExchangeCoverageError` naming the edge that dropped rows (the
+debug harness for the mesh×fleet wrong-results canary).
+"""
+
+from __future__ import annotations
+
+from trino_tpu import types as T
+from trino_tpu.expr.ir import (
+    AggCall,
+    Call,
+    Cast,
+    InputRef,
+    Literal,
+    RowExpression,
+    join_key_compatible,
+)
+from trino_tpu.plan import nodes as P
+
+__all__ = [
+    "PlanSanityError",
+    "ExchangeCoverageError",
+    "validate_plan",
+    "validate_stages",
+    "check_edge_coverage",
+    "level",
+]
+
+
+class PlanSanityError(RuntimeError):
+    """A plan invariant does not hold. ``phase`` names the optimizer
+    pass (or planning step) whose output broke it; ``check`` names the
+    violated invariant."""
+
+    def __init__(self, check: str, phase: str, message: str):
+        self.check = check
+        self.phase = phase
+        super().__init__(
+            f"plan sanity violation after pass '{phase}' "
+            f"[{check}]: {message}"
+        )
+
+
+class ExchangeCoverageError(RuntimeError):
+    """A runtime exchange edge did not conserve rows: the rows that
+    came out of its partitions do not sum to the rows that went in.
+    ``edge`` names the offending edge (mesh collective or fleet
+    stage-to-stage spool/direct edge)."""
+
+    def __init__(self, edge: str, rows_in: int, rows_out: int,
+                 detail: str = ""):
+        self.edge = edge
+        self.rows_in = int(rows_in)
+        self.rows_out = int(rows_out)
+        super().__init__(
+            f"exchange coverage violation on edge {edge}: "
+            f"{rows_in} rows in, {rows_out} rows out "
+            f"(dropped {rows_in - rows_out})"
+            + (f" — {detail}" if detail else "")
+        )
+
+
+def level(session) -> str:
+    """The session's validation level (OFF | FINAL | FULL)."""
+    from trino_tpu import session_properties as SP
+
+    return str(SP.get(session, "plan_validation")).upper()
+
+
+# ---- expression helpers ----------------------------------------------------
+
+def _expr_refs(e, out: set[str]) -> None:
+    if isinstance(e, InputRef):
+        out.add(e.name)
+    elif isinstance(e, Call):
+        for a in e.args:
+            _expr_refs(a, out)
+    elif isinstance(e, Cast):
+        _expr_refs(e.arg, out)
+    elif isinstance(e, (Literal, type(None))):
+        pass
+    elif isinstance(e, RowExpression):
+        # future expression kinds: be conservative, consume nothing
+        pass
+
+
+def _refs(*exprs) -> set[str]:
+    out: set[str] = set()
+    for e in exprs:
+        _expr_refs(e, out)
+    return out
+
+
+def _agg_refs(agg: AggCall) -> set[str]:
+    out = _refs(*agg.args)
+    if agg.filter is not None:
+        _expr_refs(agg.filter, out)
+    return out
+
+
+# ---- per-node consumption / production semantics ---------------------------
+
+def _consumed(node: P.PlanNode) -> list[tuple[str, set[str]]]:
+    """``(source-scope label, symbols the node consumes from it)``
+    pairs. Scope label "any" means the union of all sources."""
+    if isinstance(node, P.Filter):
+        return [("any", _refs(node.predicate))]
+    if isinstance(node, P.Project):
+        return [("any", _refs(*node.assignments.values()))]
+    if isinstance(node, P.Aggregate):
+        used = set(node.group_keys)
+        for agg in node.aggregates.values():
+            used |= _agg_refs(agg)
+        return [("any", used)]
+    if isinstance(node, P.Join):
+        left = {ls for ls, _ in node.criteria}
+        right = {rs for _, rs in node.criteria}
+        out: list[tuple[str, set[str]]] = [
+            ("left", left), ("right", right)
+        ]
+        if node.filter is not None:
+            out.append(("any", _refs(node.filter)))
+        return out
+    if isinstance(node, P.SemiJoin):
+        out = [
+            ("left", {ls for ls, _ in node.keys}),
+            ("right", {rs for _, rs in node.keys}),
+        ]
+        if node.filter is not None:
+            out.append(("any", _refs(node.filter)))
+        return out
+    if isinstance(node, (P.Sort, P.TopN)):
+        return [("any", {k.symbol for k in node.keys})]
+    if isinstance(node, P.Window):
+        used = set(node.partition_by)
+        used |= {k.symbol for k in node.order_keys}
+        for fn in node.functions.values():
+            used |= _refs(*fn.args)
+        return [("any", used)]
+    if isinstance(node, P.Unnest):
+        used: set[str] = set()
+        for arr in node.arrays:
+            # an element is one array expression, or a (expr, ...) tuple
+            if isinstance(arr, (tuple, list)):
+                used |= _refs(*arr)
+            else:
+                used |= _refs(arr)
+        return [("any", used)]
+    if isinstance(node, P.GroupId):
+        used = set()
+        for gs in node.grouping_sets:
+            used |= set(gs)
+        return [("any", used)]
+    if isinstance(node, P.Union):
+        # handled structurally in _check_node (per-source mapping)
+        return []
+    if isinstance(node, P.Exchange):
+        used = set(node.hash_symbols) if node.partitioning == "hash" else set()
+        if node.sort_keys:
+            used |= {k.symbol for k in node.sort_keys}
+        return [("any", used)]
+    if isinstance(node, P.Output):
+        return [("any", set(node.symbols))]
+    return []
+
+
+#: nodes whose outputs must be a subset of what their sources produce
+#: (plus any symbols the node itself introduces)
+def _introduced(node: P.PlanNode) -> set[str]:
+    if isinstance(node, P.Project):
+        return set(node.assignments)
+    if isinstance(node, P.Aggregate):
+        return set(node.aggregates)
+    if isinstance(node, P.SemiJoin):
+        return {node.match_symbol}
+    if isinstance(node, P.Window):
+        return set(node.functions)
+    if isinstance(node, P.Unnest):
+        return set(node.element_symbols)
+    if isinstance(node, P.GroupId):
+        return {node.id_symbol}
+    return set()
+
+
+# ---- individual checkers ---------------------------------------------------
+
+def _check_acyclic(root: P.PlanNode, fail) -> None:
+    """The analog of NoDuplicatePlanNodeIdsChecker, adapted: plans here
+    are DAGs — the grouping-sets planner deliberately shares one
+    pre-aggregation subtree across Union branches — so sharing is
+    legal, but a node reachable from itself would make every recursive
+    rewrite diverge. Flag cycles only."""
+    on_stack: set[int] = set()
+    done: set[int] = set()
+
+    def walk(n: P.PlanNode) -> None:
+        if id(n) in done:
+            return
+        if id(n) in on_stack:
+            fail(
+                "acyclic",
+                f"{type(n).__name__} node is reachable from itself "
+                f"(cycle in the plan graph)",
+            )
+            return
+        on_stack.add(id(n))
+        for s in n.sources:
+            walk(s)
+        on_stack.discard(id(n))
+        done.add(id(n))
+
+    walk(root)
+
+
+def _check_node(node: P.PlanNode, fail) -> None:
+    """Symbol resolution + type consistency for one node against its
+    immediate sources (ValidateDependenciesChecker + TypeValidator)."""
+    srcs = node.sources
+    name = type(node).__name__
+    avail: dict[str, T.DataType] = {}
+    for s in srcs:
+        avail.update(s.outputs)
+
+    # leaves produce from thin air; nothing to resolve
+    if not srcs:
+        if isinstance(node, P.TableScan):
+            missing = set(node.outputs) - set(node.assignments)
+            if missing:
+                fail(
+                    "symbols",
+                    f"TableScan {node.table}: output symbols "
+                    f"{sorted(missing)} have no column assignment",
+                )
+        return
+
+    # every consumed symbol is produced by the right source(s)
+    for scope, used in _consumed(node):
+        if scope == "left":
+            have = set(srcs[0].outputs)
+        elif scope == "right":
+            have = set(srcs[1].outputs)
+        else:
+            have = set(avail)
+        missing = used - have
+        if missing:
+            fail(
+                "symbols",
+                f"{name} consumes {sorted(missing)} not produced by its "
+                f"{scope if scope != 'any' else ''} source(s) "
+                f"(available: {sorted(have)})",
+            )
+
+    # Union wires outputs per source explicitly
+    if isinstance(node, P.Union):
+        for sym, per_src in node.symbol_map.items():
+            if len(per_src) != len(node.all_sources):
+                fail(
+                    "symbols",
+                    f"Union symbol {sym!r} maps {len(per_src)} inputs "
+                    f"for {len(node.all_sources)} sources",
+                )
+                continue
+            for i, (s, isym) in enumerate(zip(node.all_sources, per_src)):
+                if isym not in s.outputs:
+                    fail(
+                        "symbols",
+                        f"Union symbol {sym!r} reads {isym!r} absent "
+                        f"from source #{i} outputs",
+                    )
+        extra = set(node.outputs) - set(node.symbol_map)
+        if extra:
+            fail(
+                "symbols",
+                f"Union outputs {sorted(extra)} have no symbol mapping",
+            )
+
+    # output closure: pass-through outputs must come from some source
+    # (or be introduced by the node itself)
+    if not isinstance(node, (P.Union, P.Unnest)):
+        passthrough = set(node.outputs) - _introduced(node)
+        if isinstance(node, P.Aggregate):
+            # group keys are the only pass-through an Aggregate has
+            stray = passthrough - set(node.group_keys)
+            if stray:
+                fail(
+                    "symbols",
+                    f"Aggregate outputs {sorted(stray)} are neither "
+                    f"group keys nor aggregate results",
+                )
+            passthrough &= set(node.group_keys)
+        unknown = passthrough - set(avail)
+        if unknown:
+            fail(
+                "symbols",
+                f"{name} outputs {sorted(unknown)} that no source "
+                f"produces",
+            )
+
+    # type consistency: pass-through symbols keep their source type,
+    # computed symbols carry their expression's type
+    for sym, t in node.outputs.items():
+        if isinstance(node, P.Project) and sym in node.assignments:
+            et = node.assignments[sym].type
+            if et != t:
+                fail(
+                    "types",
+                    f"Project output {sym!r} declared {t} but its "
+                    f"expression has type {et}",
+                )
+            continue
+        if isinstance(node, P.Aggregate) and sym in node.aggregates:
+            at = node.aggregates[sym].type
+            if at != t:
+                fail(
+                    "types",
+                    f"Aggregate output {sym!r} declared {t} but "
+                    f"{node.aggregates[sym].name} produces {at}",
+                )
+            continue
+        if isinstance(node, P.Window) and sym in node.functions:
+            wt = node.functions[sym].type
+            if wt != t:
+                fail(
+                    "types",
+                    f"Window output {sym!r} declared {t} but "
+                    f"{node.functions[sym].name} produces {wt}",
+                )
+            continue
+        if isinstance(node, P.Union):
+            continue  # per-source types may legitimately widen
+        st = avail.get(sym)
+        if st is not None and st != t:
+            fail(
+                "types",
+                f"{name} passes {sym!r} through as {t} but its source "
+                f"produces {st}",
+            )
+
+    # join key compatibility (raw-bits hashability of criteria pairs)
+    if isinstance(node, P.Join):
+        lo, ro = srcs[0].outputs, srcs[1].outputs
+        for ls, rs in node.criteria:
+            lt, rt = lo.get(ls), ro.get(rs)
+            if lt is None or rt is None:
+                continue  # already reported by the symbol check
+            if not join_key_compatible(lt, rt):
+                fail(
+                    "types",
+                    f"Join criteria ({ls!r}, {rs!r}) pair incompatible "
+                    f"key types {lt} and {rt}",
+                )
+
+
+def _check_exchanges(root: P.PlanNode, fail) -> None:
+    """Exchange completeness at the plan level: hash exchanges
+    partition on symbols their input actually carries, range exchanges
+    carry their sort keys (the pre-fragmentation half of
+    ValidateStreamingJoins/exchange checks)."""
+
+    def walk(n: P.PlanNode) -> None:
+        if isinstance(n, P.Exchange):
+            if n.partitioning == "hash" and not n.hash_symbols:
+                fail(
+                    "exchanges",
+                    "hash Exchange with no partitioning symbols",
+                )
+            if n.partitioning == "range" and not n.sort_keys:
+                fail(
+                    "exchanges",
+                    "range Exchange with no sort keys",
+                )
+        for s in n.sources:
+            walk(s)
+
+    walk(root)
+
+
+def _check_dynamic_filters(root: P.PlanNode, fail) -> None:
+    """Dynamic-filter well-formedness: a Join annotated with DF hints
+    must still have the equi-criteria (the live build side) those
+    hints were derived from — a rewrite that strips criteria but keeps
+    the annotation would make executors filter on nothing."""
+
+    def walk(n: P.PlanNode) -> None:
+        if isinstance(n, P.Join) and (
+            n.df_range_keep is not None or n.df_keep_frac is not None
+        ):
+            if not n.criteria:
+                fail(
+                    "dynamic-filters",
+                    "Join carries dynamic-filter annotations "
+                    "(df_range_keep/df_keep_frac) but has no "
+                    "equi-criteria to derive a build-side filter from",
+                )
+        for s in n.sources:
+            walk(s)
+
+    walk(root)
+
+
+def validate_plan(plan: P.PlanNode, phase: str) -> P.PlanNode:
+    """Run every plan-level invariant; raise :class:`PlanSanityError`
+    attributing the first violation to ``phase``. Returns the plan so
+    call sites can chain."""
+    failures: list[tuple[str, str]] = []
+
+    def fail(check: str, message: str) -> None:
+        failures.append((check, message))
+
+    _check_acyclic(plan, fail)
+    if not failures:
+        seen: set[int] = set()
+
+        def walk(n: P.PlanNode) -> None:
+            if id(n) in seen:
+                return  # shared subtree: check once
+            seen.add(id(n))
+            _check_node(n, fail)
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
+        _check_exchanges(plan, fail)
+        _check_dynamic_filters(plan, fail)
+    if failures:
+        check, message = failures[0]
+        if len(failures) > 1:
+            message += f" (+{len(failures) - 1} more violations)"
+        raise PlanSanityError(check, phase, message)
+    return plan
+
+
+# ---- fragment / stage-DAG invariants ---------------------------------------
+
+def validate_stages(stages, phase: str = "fragment_plan"):
+    """Fragment closure over a ``fragment_plan`` result: every
+    RemoteSource resolves to exactly one producing stage, stage inputs
+    match the RemoteSources actually present in the fragment, the
+    stage DAG is acyclic with children ordered before parents, and
+    every aligned (hash) edge partitions on symbols the producer
+    fragment actually outputs."""
+    failures: list[tuple[str, str]] = []
+
+    def fail(check: str, message: str) -> None:
+        failures.append((check, message))
+
+    by_id = {s.stage_id: s for s in stages}
+    if len(by_id) != len(stages):
+        fail("fragments", "duplicate stage ids in fragment list")
+    producer_of = {f"rs{s.stage_id}": s for s in stages}
+
+    for stage in stages:
+        # RemoteSources present in the fragment tree (plans are DAGs:
+        # the same node object reachable twice is one read, but two
+        # distinct RemoteSource objects with one source_id is a
+        # fragmentation bug)
+        remotes: dict[str, P.RemoteSource] = {}
+        walked: set[int] = set()
+
+        def walk(n: P.PlanNode) -> None:
+            if id(n) in walked:
+                return
+            walked.add(id(n))
+            if isinstance(n, P.RemoteSource):
+                if n.source_id in remotes:
+                    fail(
+                        "fragments",
+                        f"stage {stage.stage_id}: RemoteSource "
+                        f"{n.source_id!r} appears twice in one fragment",
+                    )
+                remotes[n.source_id] = n
+            for s in n.sources:
+                walk(s)
+
+        walk(stage.root)
+        declared = {i.source_id: i for i in stage.inputs}
+        if set(remotes) != set(declared):
+            fail(
+                "fragments",
+                f"stage {stage.stage_id}: fragment reads "
+                f"{sorted(remotes)} but declares inputs "
+                f"{sorted(declared)}",
+            )
+        for sid, rs in remotes.items():
+            producer = producer_of.get(sid)
+            if producer is None:
+                fail(
+                    "fragments",
+                    f"stage {stage.stage_id}: RemoteSource {sid!r} has "
+                    f"no producing fragment",
+                )
+                continue
+            inp = declared.get(sid)
+            if inp is not None and inp.stage_id != producer.stage_id:
+                fail(
+                    "fragments",
+                    f"stage {stage.stage_id}: input {sid!r} declares "
+                    f"producer {inp.stage_id!r} but the id resolves to "
+                    f"stage {producer.stage_id!r}",
+                )
+            # the edge's schema: the consumer reads exactly what the
+            # producer fragment outputs
+            missing = set(rs.outputs) - set(producer.root.outputs)
+            if missing:
+                fail(
+                    "fragments",
+                    f"edge {producer.stage_id}->{stage.stage_id}: "
+                    f"consumer expects {sorted(missing)} the producer "
+                    f"fragment does not output",
+                )
+            for sym, t in rs.outputs.items():
+                pt = producer.root.outputs.get(sym)
+                if pt is not None and pt != t:
+                    fail(
+                        "types",
+                        f"edge {producer.stage_id}->{stage.stage_id}: "
+                        f"{sym!r} typed {t} on the consumer, {pt} on "
+                        f"the producer",
+                    )
+            # exchange completeness on the wire: a hash edge
+            # partitions on symbols the producer actually outputs
+            if inp is not None and inp.hash_symbols:
+                stray = set(inp.hash_symbols) - set(producer.root.outputs)
+                if stray:
+                    fail(
+                        "exchanges",
+                        f"edge {producer.stage_id}->{stage.stage_id}: "
+                        f"hash-partitions on {sorted(stray)} absent "
+                        f"from the producer outputs",
+                    )
+            # producer stage partitioning must agree with the edge
+            if (
+                inp is not None and inp.mode == "aligned"
+                and producer.partitioning == "hash"
+                and list(producer.hash_symbols) != list(inp.hash_symbols)
+            ):
+                fail(
+                    "exchanges",
+                    f"edge {producer.stage_id}->{stage.stage_id}: "
+                    f"aligned consumer expects partitioning on "
+                    f"{list(inp.hash_symbols)} but the producer "
+                    f"partitions on {list(producer.hash_symbols)}",
+                )
+
+    # acyclicity + topological order (children before parents)
+    seen: set[str] = set()
+    for stage in stages:
+        for inp in stage.inputs:
+            if inp.stage_id == stage.stage_id:
+                fail(
+                    "fragments",
+                    f"stage {stage.stage_id} reads its own output "
+                    f"(cycle)",
+                )
+            elif inp.stage_id in by_id and inp.stage_id not in seen:
+                fail(
+                    "fragments",
+                    f"stage {stage.stage_id} reads stage "
+                    f"{inp.stage_id} which is not ordered before it "
+                    f"(cycle or bad topological order)",
+                )
+        seen.add(stage.stage_id)
+
+    # each fragment is itself a sane plan
+    for stage in stages:
+        try:
+            validate_plan(stage.root, phase)
+        except PlanSanityError as e:
+            fail(e.check, f"stage {stage.stage_id}: {e}")
+            break
+
+    if failures:
+        check, message = failures[0]
+        if len(failures) > 1:
+            message += f" (+{len(failures) - 1} more violations)"
+        raise PlanSanityError(check, phase, message)
+    return stages
+
+
+# ---- runtime exchange-edge coverage (fleet tier) ---------------------------
+
+def check_edge_coverage(stages, task_stats: list[dict]) -> None:
+    """Debug assertion behind the ``check_exchange_coverage`` session
+    property: for every stage-to-stage exchange edge, the rows
+    consumers observed on that edge must sum to the rows the producer
+    stage committed. An aligned (hash) edge is read exactly once
+    across the consumer stage's partitions; an "all" (gather/
+    broadcast) edge is read in full by every consumer task. Raises
+    :class:`ExchangeCoverageError` naming the first edge that dropped
+    or duplicated rows."""
+    by_stage_out: dict[str, int] = {}
+    finished: dict[str, list[dict]] = {}
+    for row in task_stats:
+        if row.get("state") != "FINISHED":
+            continue
+        sid = row["stage_id"]
+        by_stage_out[sid] = by_stage_out.get(sid, 0) + int(
+            row.get("rows_out", 0)
+        )
+        finished.setdefault(sid, []).append(row)
+
+    for stage in stages:
+        rows = finished.get(stage.stage_id)
+        if rows is None:
+            continue
+        # only meaningful when every consumer task reported per-edge
+        # row counts (older workers / root reads don't)
+        if any("edge_rows" not in r for r in rows):
+            continue
+        for inp in stage.inputs:
+            produced = by_stage_out.get(inp.stage_id)
+            if produced is None:
+                continue
+            per_task = [
+                int((r.get("edge_rows") or {}).get(inp.source_id, 0))
+                for r in rows
+            ]
+            edge = (
+                f"{inp.stage_id}->{stage.stage_id} "
+                f"[{inp.mode}"
+                + (f" on {list(inp.hash_symbols)}" if inp.hash_symbols
+                   else "")
+                + "]"
+            )
+            if inp.mode == "aligned":
+                got = sum(per_task)
+                if got != produced:
+                    raise ExchangeCoverageError(
+                        edge, produced, got,
+                        detail=f"per-partition reads {per_task}",
+                    )
+            else:
+                for r, got in zip(rows, per_task):
+                    if got != produced:
+                        raise ExchangeCoverageError(
+                            edge, produced, got,
+                            detail=(
+                                f"task {r.get('task_id')} read a "
+                                f"partial broadcast"
+                            ),
+                        )

@@ -58,6 +58,7 @@ from trino_tpu.exec import spool
 from trino_tpu.exec.local import QueryCancelled
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.plan import nodes as P
+from trino_tpu.plan import validate
 from trino_tpu.plan.fragment import Stage, fragment_plan
 from trino_tpu.plan.serde import plan_to_json
 from trino_tpu.scheduler import EventDrivenScheduler
@@ -635,6 +636,10 @@ class FleetRunner:
                     t_plan = time.perf_counter()
                     plan = self._planner.plan_stmt(stmt)
                     stages = fragment_plan(plan)
+                    if validate.level(self.session) != "OFF":
+                        validate.validate_stages(
+                            stages, phase="fragment_plan"
+                        )
                     self._plan_ms = (
                         (time.perf_counter() - t_plan) * 1e3
                     )
@@ -676,6 +681,11 @@ class FleetRunner:
         t0 = time.perf_counter()
         try:
             self._run_dag(stages, qroot, tasks_by_stage)
+            if sp.get(self.session, "check_exchange_coverage"):
+                # debug assertion: every stage-to-stage exchange edge
+                # conserved rows (consumer reads sum to producer
+                # commits) — a mismatch names the dropping edge
+                validate.check_edge_coverage(stages, self._task_stats)
             with tracer.span("read-root", "spool"):
                 payload = self._read_root(stages, qroot, tasks_by_stage)
             page = spool.host_to_page(payload)
@@ -1669,6 +1679,13 @@ class FleetRunner:
                         "direct_bytes": tstats.get("direct_bytes", 0),
                         "spooled_bytes": tstats.get(
                             "spooled_bytes", 0
+                        ),
+                        # per-edge consumer row counts (source_id ->
+                        # rows read) — the exchange-coverage debug
+                        # assertion sums these against producer commits
+                        **(
+                            {"edge_rows": tstats["edge_rows"]}
+                            if "edge_rows" in tstats else {}
                         ),
                     }
                     self._task_stats.append(task_row)

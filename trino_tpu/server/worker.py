@@ -698,6 +698,7 @@ class WorkerServer:
             peak_bytes = 0
             op_stats: list = []
             col_ranges: dict = {}
+            edge_rows: dict = {}
             direct_bytes = 0
             spooled_bytes = 0
             try:
@@ -780,8 +781,13 @@ class WorkerServer:
                                     on_bytes=nb.append,
                                 )
                                 spooled_bytes += sum(nb)
+                            src_rows = 0
                             if payload.get("cols"):
-                                rows_in += len(payload["cols"][0][0])
+                                src_rows = len(payload["cols"][0][0])
+                            rows_in += src_rows
+                            # per-edge accounting for the coordinator's
+                            # exchange-coverage debug assertion
+                            edge_rows[src["source_id"]] = src_rows
                             pages[src["source_id"]] = spool.host_to_page(
                                 payload
                             )
@@ -937,6 +943,7 @@ class WorkerServer:
                             "operator_stats": op_stats,
                             "direct_bytes": int(direct_bytes),
                             "spooled_bytes": int(spooled_bytes),
+                            "edge_rows": edge_rows,
                             **(
                                 {"col_ranges": col_ranges}
                                 if col_ranges else {}

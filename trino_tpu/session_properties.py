@@ -14,6 +14,7 @@ one parse/validate path.
 
 from __future__ import annotations
 
+import os as _os
 from dataclasses import dataclass
 from typing import Callable
 
@@ -198,6 +199,29 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "ReorderJoins analog)",
             "varchar", "AUTOMATIC",
             _one_of("join_reordering_strategy", {"AUTOMATIC", "NONE"}),
+        ),
+        # ---- plan sanity checking (plan.validate) ---------------------
+        _P(
+            "plan_validation",
+            "Plan invariant checking (PlanSanityChecker analog): OFF, "
+            "FINAL (validate the optimized plan, the distributed plan, "
+            "and the fragmented stage DAG), or FULL (additionally "
+            "validate after every optimizer pass, attributing a "
+            "violation to the pass that introduced it). Tests default "
+            "to FULL via TRINO_TPU_PLAN_VALIDATION",
+            "varchar",
+            _os.environ.get("TRINO_TPU_PLAN_VALIDATION", "FINAL"),
+            _one_of("plan_validation", {"OFF", "FINAL", "FULL"}),
+        ),
+        _P(
+            "check_exchange_coverage",
+            "Debug assertion: verify every exchange edge conserves "
+            "rows — mesh collectives compare live rows before/after "
+            "the all_to_all, the fleet coordinator compares per-edge "
+            "consumer reads against producer commits — raising "
+            "ExchangeCoverageError naming the edge that dropped rows. "
+            "Forces host syncs; keep OFF outside debugging",
+            "boolean", False, hidden=True,
         ),
         # ---- local execution (exec.local) -----------------------------
         _P(
