@@ -239,6 +239,7 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
     peaks = {}
     compile_stats = {}
     top_spans = {}
+    breakdowns = {}
     for q in QUERY_IDS:
         sql = QUERIES[q]
         c0 = telemetry.compile_snapshot()
@@ -277,7 +278,12 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         # same-process warm pass: with shape bucketing on, the second
         # run of an operator mix must be all jit-cache hits (the cold/
         # warm split that makes the compile tax auditable per query)
-        runner.execute(sql)
+        warm_result = runner.execute(sql)
+        # wall-clock bucket decomposition of the warm run (the cold
+        # run's is all compile tax) — informational in the snapshot,
+        # bench_gate skips keys it has no band for
+        if warm_result.time_breakdown is not None:
+            breakdowns[q] = warm_result.time_breakdown["buckets"]
         c2 = telemetry.compile_snapshot()
         compile_stats[q]["warm_compiles"] = int(
             c2["compiles"] - c1["compiles"]
@@ -364,6 +370,8 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         detail[f"{q}_warm_jit_hits"] = compile_stats[q]["warm_jit_hits"]
         if q in top_spans:
             detail[f"{q}_top_spans"] = top_spans[q]
+        if q in breakdowns:
+            detail[f"{q}_time_breakdown"] = breakdowns[q]
 
     # headline lands as soon as the core section is done: every later
     # section only ever ADDS detail, so a budget skip or section error

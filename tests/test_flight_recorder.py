@@ -1,0 +1,454 @@
+"""Flight recorder: critical-path attribution, per-partition exchange
+skew, cluster time-series, post-mortem bundles.
+
+Unit tier exercises the analysis layer (trino_tpu.telemetry_analysis +
+trino_tpu.diagnostics) on synthetic span trees; the fleet tier runs a
+zipfian-keyed join against REAL worker processes and checks that the
+per-edge partition histograms flag the hot key while a uniform twin of
+the same query stays flat — with both returning oracle-exact rows.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu import diagnostics, tracker
+from trino_tpu import telemetry_analysis as TA
+from trino_tpu.connectors.tpch.connector import TpchConnector
+from trino_tpu.engine import QueryRunner
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.server.fleet import FleetRunner
+from trino_tpu.telemetry import Span, Trace
+from trino_tpu.testing.golden import (
+    assert_rows_match,
+    load_tpch_sqlite,
+    to_sqlite,
+)
+
+BASE_PORT = 19060
+
+
+# ---------------------------------------------------------------------------
+# Wall-clock decomposition (sweep-line exactness)
+# ---------------------------------------------------------------------------
+
+
+def _span(name, kind, start, dur, children=()):
+    sp = Span(name=name, kind=kind, start_ms=float(start),
+              duration_ms=float(dur))
+    sp.children.extend(children)
+    return sp
+
+
+def test_breakdown_concurrent_subtrees_no_double_count():
+    # two fully-overlapping worker execute spans: naive self-time
+    # accumulation would attribute 160 ms of a 100 ms query
+    root = _span("q", "query", 0.0, 100.0, [
+        _span("t1", "execution", 10.0, 80.0),
+        _span("t2", "execution", 10.0, 80.0),
+    ])
+    bd = TA.compute_time_breakdown(Trace(root), 100.0)
+    assert abs(sum(bd["buckets"].values()) - 100.0) < 1e-6
+    # no op_stats -> all execution self-time lands in compute
+    assert abs(bd["buckets"]["compute"] - 80.0) < 1e-6
+    assert abs(bd["buckets"]["other"] - 20.0) < 1e-6
+    assert bd["coverage"] == pytest.approx(1.0, abs=1e-4)
+
+
+def test_breakdown_work_beats_waiting():
+    # a stage span's admission-wait only counts while NO work runs
+    root = _span("q", "query", 0.0, 100.0, [
+        _span("stage 0", "stage", 0.0, 100.0, [
+            _span("execute", "execution", 20.0, 50.0),
+        ]),
+    ])
+    bd = TA.compute_time_breakdown(Trace(root), 100.0)
+    assert abs(bd["buckets"]["compute"] - 50.0) < 1e-6
+    assert abs(bd["buckets"]["admission_wait"] - 50.0) < 1e-6
+    assert abs(sum(bd["buckets"].values()) - 100.0) < 1e-6
+
+
+def test_breakdown_pre_root_buckets_and_uncovered_wall():
+    root = _span("q", "query", 0.0, 50.0)
+    bd = TA.compute_time_breakdown(
+        Trace(root), 80.0, queued_ms=10.0, planning_ms=20.0,
+    )
+    assert bd["buckets"]["queued"] == 10.0
+    assert bd["buckets"]["planning"] == 20.0
+    # 50 ms trace self-time ("other") + 0 uncovered: 10+20+50 == 80
+    assert abs(sum(bd["buckets"].values()) - 80.0) < 1e-6
+
+
+def test_critical_path_descends_latest_ending_child():
+    late = _span("late", "stage", 40.0, 50.0)
+    root = _span("q", "query", 0.0, 100.0, [
+        _span("early", "stage", 0.0, 30.0),
+        late,
+    ])
+    path = TA.critical_path(Trace(root))
+    assert [p["name"] for p in path] == ["q", "late"]
+    assert path[-1]["duration_ms"] == 50.0
+
+
+def test_straggler_slack():
+    rows = [
+        {"stage_id": "1", "state": "FINISHED", "elapsed_ms": 10.0},
+        {"stage_id": "1", "state": "FINISHED", "elapsed_ms": 10.0},
+        {"stage_id": "1", "state": "FINISHED", "elapsed_ms": 40.0},
+        {"stage_id": "2", "state": "FAILED", "elapsed_ms": 500.0},
+    ]
+    assert TA.straggler_slack_ms(rows) == pytest.approx(30.0)
+    assert TA.straggler_slack_ms(None) == 0.0
+
+
+def test_local_breakdown_sums_to_wall():
+    runner = QueryRunner.tpch("tiny")
+    res = runner.execute(
+        "select count(*) from lineitem where l_quantity < 10"
+    )
+    bd = res.time_breakdown
+    assert bd is not None
+    total = sum(bd["buckets"].values())
+    assert abs(total - bd["wall_ms"]) <= 0.10 * bd["wall_ms"]
+    assert bd["critical_path"][0]["kind"] == "query"
+    assert "time_breakdown" in json.loads(res.profile_json())
+
+
+def test_format_breakdown_lines():
+    bd = {
+        "wall_ms": 100.0, "coverage": 1.0,
+        "buckets": {"planning": 40.0, "compute": 60.0},
+        "critical_path": [
+            {"name": "q", "kind": "query", "node": "coordinator",
+             "duration_ms": 100.0},
+        ],
+    }
+    lines = TA.format_breakdown(bd)
+    assert lines[0].startswith("Time breakdown (wall 100.0 ms")
+    assert any("planning" in ln and "40.0" in ln for ln in lines)
+    assert lines[-1].startswith("Critical path: q")
+    assert TA.format_breakdown(None) == []
+
+
+# ---------------------------------------------------------------------------
+# Partition-skew statistics
+# ---------------------------------------------------------------------------
+
+
+def test_partition_skew_stats():
+    uniform = TA.partition_skew({0: 100, 1: 100, 2: 100, 3: 100})
+    assert uniform["max_mean_ratio"] == 1.0
+    assert uniform["cv"] == 0.0
+    hot = TA.partition_skew({"0": 970, "1": 10, "2": 10, "3": 10})
+    assert hot["partitions"] == 4
+    assert hot["max_mean_ratio"] == pytest.approx(3.88)
+    assert hot["cv"] > 1.0
+    assert TA.partition_skew({})["partitions"] == 0
+    assert TA.partition_skew(None)["max_mean_ratio"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Clock-skew correction
+# ---------------------------------------------------------------------------
+
+
+def test_clock_skew_estimator():
+    est = TA.ClockSkewEstimator()
+    assert est.offset_ms("w1") == 0.0
+    # coordinator clock 500 ms ahead of the worker's
+    est.observe("w1", 1000.0, 1010.0, remote_now_ms=505.0)
+    assert est.offset_ms("w1") == pytest.approx(500.0)
+    # EWMA damps a one-off outlier response
+    est.observe("w1", 2000.0, 2010.0, remote_now_ms=1305.0)
+    assert 500.0 < est.offset_ms("w1") < 700.0
+    est.observe("w1", 3000.0, 3010.0, remote_now_ms=None)  # no stamp
+    assert "w1" in est.offsets()
+
+
+def test_shift_span_tree():
+    tree = {
+        "start_ms": 100.0,
+        "children": [{"start_ms": 150.0, "children": []}],
+    }
+    TA.shift_span_tree(tree, 500.0)
+    assert tree["start_ms"] == 600.0
+    assert tree["children"][0]["start_ms"] == 650.0
+    same = {"start_ms": 1.0}
+    assert TA.shift_span_tree(same, 0.0) is same
+    assert same["start_ms"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Cluster time-series recorder
+# ---------------------------------------------------------------------------
+
+
+def test_timeseries_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("TRINO_TPU_TIMESERIES_INTERVAL_MS", raising=False)
+    assert TA.ClusterTimeseriesRecorder.from_env() is None
+    monkeypatch.setenv("TRINO_TPU_TIMESERIES_INTERVAL_MS", "0")
+    assert TA.ClusterTimeseriesRecorder.from_env() is None
+
+
+def test_timeseries_ring_and_rows(monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_TIMESERIES_INTERVAL_MS", "60000")
+    monkeypatch.setenv("TRINO_TPU_TIMESERIES_SAMPLES", "4")
+    rec = TA.ClusterTimeseriesRecorder.from_env()
+    assert rec is not None and not rec.running
+    for _ in range(6):
+        rec.sample()
+    assert len(rec.samples()) == 4  # ring stays bounded
+    rows = rec.rows()
+    assert rows and all(len(r) == 4 for r in rows)
+    assert {r[1] for r in rows} == {"coordinator"}
+
+
+def test_timeseries_coordinator_endpoint(monkeypatch):
+    from trino_tpu.server import Coordinator
+
+    monkeypatch.setenv("TRINO_TPU_TIMESERIES_INTERVAL_MS", "100")
+    monkeypatch.setenv("TRINO_TPU_TIMESERIES_SAMPLES", "16")
+    coord = Coordinator(QueryRunner.tpch("tiny")).start()
+    try:
+        assert coord.timeseries is not None and coord.timeseries.running
+        deadline = time.monotonic() + 10
+        while (not coord.timeseries.samples()
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        with urllib.request.urlopen(
+            f"{coord.uri}/v1/cluster/timeseries"
+        ) as resp:
+            payload = json.loads(resp.read())
+        assert payload["samples"]
+        assert payload["interval_ms"] == 100.0
+        rows = coord.runner.execute(
+            "select count(*) from system.runtime.cluster_metrics"
+        ).rows
+        assert rows[0][0] > 0
+    finally:
+        coord.stop()
+    assert coord.timeseries is None
+
+
+def test_timeseries_endpoint_404_and_no_thread_when_disabled(monkeypatch):
+    import threading
+    import urllib.error
+
+    from trino_tpu.server import Coordinator
+
+    monkeypatch.delenv("TRINO_TPU_TIMESERIES_INTERVAL_MS", raising=False)
+    coord = Coordinator(QueryRunner.tpch("tiny")).start()
+    try:
+        assert coord.timeseries is None
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{coord.uri}/v1/cluster/timeseries")
+        assert exc.value.code == 404
+        assert "cluster-timeseries" not in [
+            t.name for t in threading.enumerate()
+        ]
+    finally:
+        coord.stop()
+
+
+def test_timeseries_parse_prometheus():
+    text = (
+        "# HELP x y\n# TYPE x counter\n"
+        'x_total{a="b"} 3.5\nbad line here nan? no\nplain 7\n'
+    )
+    out = TA._parse_prometheus(text)
+    assert out['x_total{a="b"}'] == 3.5
+    assert out["plain"] == 7.0
+
+
+# ---------------------------------------------------------------------------
+# Post-mortem bundles
+# ---------------------------------------------------------------------------
+
+
+def test_diagnostics_bundle_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_DIAG_DIR", str(tmp_path))
+    trace = Trace(_span("q", "query", 0.0, 10.0))
+    bundle = diagnostics.build_bundle(
+        "qdiag1",
+        error="ValueError: boom",
+        sql="select 1",
+        trace=trace,
+        task_stats=[{
+            "stage_id": "0", "task_id": "0.0", "attempt": 0,
+            "partition_rows": {"0": 5, "1": 7},
+        }],
+        residency={("0", 0): "http://w1"},
+        metrics_before={"a": 1.0, "gone": 2.0},
+        metrics_after={"a": 3.0, "gone": 2.0, "new": 4.0},
+    )
+    assert bundle["error_class"] == "ValueError"
+    assert bundle["metric_deltas"] == {"a": 2.0, "new": 4.0}
+    assert bundle["partition_histograms"][0]["partition_rows"] == {
+        "0": 5, "1": 7,
+    }
+    assert bundle["residency"] == {"0/0": "http://w1"}
+    assert bundle["trace"]["name"] == "q"
+    path = diagnostics.record_bundle(bundle)
+    assert path == str(tmp_path / "qdiag1.json")
+    assert json.load(open(path))["query_id"] == "qdiag1"
+    assert tracker.QUERY_INFO.get_diagnostics("qdiag1") is bundle
+
+
+def test_diagnostics_no_dir_memory_only(monkeypatch):
+    monkeypatch.delenv("TRINO_TPU_DIAG_DIR", raising=False)
+    bundle = diagnostics.build_bundle("qdiag2", error="boom")
+    assert diagnostics.record_bundle(bundle) is None
+    assert "path" not in bundle
+    assert tracker.QUERY_INFO.get_diagnostics("qdiag2") is bundle
+
+
+# ---------------------------------------------------------------------------
+# Fleet tier: skew detection end to end
+# ---------------------------------------------------------------------------
+
+
+def _spawn_worker(port: int) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "trino_tpu.server.worker",
+            "--port", str(port),
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/info", timeout=1
+            ) as resp:
+                json.loads(resp.read())
+                return proc
+        except Exception:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker died: {proc.stdout.read()[:4000]}"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise TimeoutError("worker did not come up")
+            time.sleep(0.3)
+
+
+@pytest.fixture(scope="module")
+def workers():
+    procs = [_spawn_worker(BASE_PORT + i) for i in range(2)]
+    yield [f"http://127.0.0.1:{BASE_PORT + i}" for i in range(2)]
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+@pytest.fixture()
+def fleet(workers, tmp_path):
+    md = Metadata()
+    md.register_catalog("tpch", TpchConnector())
+    session = Session(catalog="tpch", schema="tiny")
+    # a broadcast join would not hash-partition the probe side at all
+    session.properties["join_distribution_type"] = "PARTITIONED"
+    return FleetRunner(
+        workers, md, session,
+        spool_root=str(tmp_path / "spool"), n_partitions=4,
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    data = QueryRunner.tpch("tiny").metadata.connector("tpch").data("tiny")
+    return load_tpch_sqlite(data)
+
+
+#: ~90% of orders collapse onto custkey 1 (a zipf-style hot key); the
+#: twin keeps the natural near-uniform o_custkey distribution
+_SKEWED_SQL = (
+    "SELECT c.c_mktsegment, count(*) AS n, sum(o.o_totalprice) AS rev "
+    "FROM (SELECT CASE WHEN o_orderkey % 10 < 9 THEN 1 "
+    "ELSE o_custkey END AS k, o_totalprice FROM orders) o "
+    "JOIN customer c ON o.k = c.c_custkey "
+    "GROUP BY c.c_mktsegment ORDER BY 1"
+)
+_UNIFORM_SQL = (
+    "SELECT c.c_mktsegment, count(*) AS n, sum(o.o_totalprice) AS rev "
+    "FROM (SELECT o_custkey AS k, o_totalprice FROM orders) o "
+    "JOIN customer c ON o.k = c.c_custkey "
+    "GROUP BY c.c_mktsegment ORDER BY 1"
+)
+
+
+def _run_checked(fleet, oracle, sql):
+    res = fleet.execute(sql)
+    expected = oracle.execute(to_sqlite(sql)).fetchall()
+    assert_rows_match(res.rows, expected, ordered=res.ordered,
+                      abs_tol=1e-6)
+    return res
+
+
+def _probe_side_ratio(res):
+    """Max per-edge skew over the stages that actually carry the join
+    input (rows_out >= 1000 keeps tiny final-gather stages out)."""
+    best = 0.0
+    for st in res.stage_stats:
+        skew = st.get("partition_skew") or {}
+        if int(skew.get("partitions", 0) or 0) > 1 and st["rows_out"] >= 1000:
+            best = max(best, float(skew["max_mean_ratio"]))
+    return best
+
+
+def test_fleet_skew_detection(fleet, oracle):
+    skewed = _run_checked(fleet, oracle, _SKEWED_SQL)
+    uniform = _run_checked(fleet, oracle, _UNIFORM_SQL)
+    assert _probe_side_ratio(skewed) >= 2.0
+    assert _probe_side_ratio(uniform) <= 1.5
+
+    # histogram/row-count consistency on every hash edge, both runs
+    for res in (skewed, uniform):
+        for st in res.stage_stats:
+            hist = st.get("partition_rows") or {}
+            if hist:
+                assert sum(hist.values()) == st["rows_out"], st["stage_id"]
+
+    # the wall-clock decomposition holds on a real fleet query too
+    bd = uniform.time_breakdown
+    assert abs(sum(bd["buckets"].values()) - bd["wall_ms"]) \
+        <= 0.10 * bd["wall_ms"]
+
+
+def test_fleet_skew_rendered_in_explain_analyze(fleet, oracle):
+    res = fleet.execute("EXPLAIN ANALYZE " + _SKEWED_SQL)
+    text = "\n".join(r[0] for r in res.rows)
+    assert "Time breakdown (wall" in text
+    assert "exchange partitions:" in text
+    assert "Critical path:" in text
+
+
+def test_fleet_failure_writes_bundle(fleet, tmp_path, monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_DIAG_DIR", str(tmp_path / "diag"))
+    fleet.session.properties["query_max_memory"] = "100kB"
+    fleet.session.properties["query_max_memory_per_node"] = "100kB"
+    with pytest.raises(Exception):
+        fleet.execute(_UNIFORM_SQL)
+    files = os.listdir(tmp_path / "diag")
+    assert len(files) == 1
+    bundle = json.load(open(tmp_path / "diag" / files[0]))
+    assert bundle["state"] == "FAILED"
+    assert bundle["plan"]
+    assert bundle["trace"]
+    assert bundle["stages"]
+    assert tracker.QUERY_INFO.get_diagnostics(bundle["query_id"])

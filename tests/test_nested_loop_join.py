@@ -12,8 +12,17 @@ from trino_tpu.metadata import Metadata
 from trino_tpu.testing.golden import (
     assert_rows_match,
     load_tpch_sqlite,
+    sqlite_supports,
     to_sqlite,
 )
+
+#: oracle needs RIGHT/FULL OUTER JOIN (sqlite 3.39+) for these shapes
+_OUTER_QIDS = {"right_range", "full_expr"}
+
+
+def _require_oracle(qid: str) -> None:
+    if qid in _OUTER_QIDS and not sqlite_supports("full_join"):
+        pytest.skip("sqlite oracle lacks RIGHT/FULL OUTER JOIN")
 
 
 @pytest.fixture(scope="module")
@@ -71,9 +80,11 @@ def check(r, oracle, sql):
 
 @pytest.mark.parametrize("qid", sorted(QUERIES))
 def test_non_equi_local(runner, oracle, qid):
+    _require_oracle(qid)
     check(runner, oracle, QUERIES[qid])
 
 
 @pytest.mark.parametrize("qid", sorted(QUERIES))
 def test_non_equi_distributed(mesh_runner, oracle, qid):
+    _require_oracle(qid)
     check(mesh_runner, oracle, QUERIES[qid])

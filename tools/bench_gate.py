@@ -67,6 +67,10 @@ _RATE_KEYS = [
     ("detail.fleet_spool_q09_ms", False),
     ("detail.exchange_direct_fetch_ratio", True),
 ]
+# NOT banded: the per-query ``detail.{q}_time_breakdown`` dicts
+# (BENCH_r08+, flight recorder) are informational — dict-valued and
+# too machine-sensitive to gate; like every key outside _RATE_KEYS
+# they SKIP rather than fail against any baseline.
 
 #: compile-count keys: lower is better, absolute slack not a pure band
 _COUNT_KEYS = [

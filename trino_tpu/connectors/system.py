@@ -70,6 +70,17 @@ _MEMORY_SCHEMA = TableSchema("memory", [
 ])
 
 
+#: cluster time-series (flight-recorder view): one row per (sample
+#: timestamp, node, metric series) from the coordinator's background
+#: recorder ring; empty when time-series recording is disabled
+_CLUSTER_METRICS_SCHEMA = TableSchema("cluster_metrics", [
+    ("sample_ts", T.DOUBLE),
+    ("node", T.VARCHAR),
+    ("metric", T.VARCHAR),
+    ("value", T.DOUBLE),
+])
+
+
 class SystemConnector(Connector):
     """Read-only views over live engine state. ``source`` is the
     owning Coordinator (queries) and/or runner (nodes); either may be
@@ -86,7 +97,10 @@ class SystemConnector(Connector):
 
     def list_tables(self, schema: str) -> list[str]:
         if schema == "runtime":
-            return ["queries", "nodes", "memory", "tasks"]
+            return [
+                "queries", "nodes", "memory", "tasks",
+                "cluster_metrics",
+            ]
         return []
 
     def table_schema(self, schema: str, table: str) -> TableSchema:
@@ -100,6 +114,8 @@ class SystemConnector(Connector):
             return _MEMORY_SCHEMA
         if table == "tasks":
             return _TASKS_SCHEMA
+        if table == "cluster_metrics":
+            return _CLUSTER_METRICS_SCHEMA
         raise KeyError(f"{schema}.{table}")
 
     def _query_rows(self):
@@ -217,6 +233,19 @@ class SystemConnector(Connector):
                 ))
         return out
 
+    def _cluster_metric_rows(self):
+        from trino_tpu import telemetry_analysis
+
+        rec = getattr(self.coordinator, "timeseries", None) or (
+            telemetry_analysis.active_recorder()
+        )
+        if rec is None:
+            return []
+        return [
+            (float(ts), str(node), str(metric), float(value))
+            for ts, node, metric, value in rec.rows()
+        ]
+
     def _rows(self, table: str):
         if table == "queries":
             return self._query_rows()
@@ -224,6 +253,8 @@ class SystemConnector(Connector):
             return self._memory_rows()
         if table == "tasks":
             return self._task_rows()
+        if table == "cluster_metrics":
+            return self._cluster_metric_rows()
         return self._node_rows()
 
     def row_count(self, schema: str, table: str) -> int:

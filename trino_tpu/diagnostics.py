@@ -1,0 +1,146 @@
+"""Post-mortem diagnostic bundles.
+
+When a query dies — failure, memory kill, deadline, retry exhaustion —
+the fleet assembles ONE JSON document holding everything a post-mortem
+needs: the final plan and fragmented stage DAG, the full trace tree,
+per-task stats (with per-partition exchange histograms), the fault
+injections the attempt absorbed, registry metric deltas over the query
+window, and the scheduler's worker residency/attempt map. The bundle is
+retained on :data:`~trino_tpu.tracker.QUERY_INFO` (served at
+``GET /v1/query/{id}/diagnostics``) and, when ``TRINO_TPU_DIAG_DIR`` is
+set, written to ``<dir>/<query_id>.json`` so it survives the process.
+
+Assembly is best-effort by design: a diagnostics failure must never
+mask the original query error, so :func:`record_bundle` swallows its
+own exceptions and every section degrades to ``None`` independently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from trino_tpu import telemetry, tracker
+
+__all__ = ["build_bundle", "write_bundle", "record_bundle", "diag_dir"]
+
+#: bundle layout version, bumped on schema changes
+SCHEMA_VERSION = 1
+
+
+def diag_dir() -> Optional[str]:
+    """Bundle output directory, or None (= in-memory retention only)
+    when ``TRINO_TPU_DIAG_DIR`` is unset/empty."""
+    return os.environ.get("TRINO_TPU_DIAG_DIR") or None
+
+
+def _metric_deltas(before: Optional[Dict[str, float]],
+                   after: Optional[Dict[str, float]]
+                   ) -> Optional[Dict[str, float]]:
+    """Registry movement over the query window; absent series count
+    from zero, zero-delta series are dropped for signal."""
+    if before is None or after is None:
+        return None
+    out: Dict[str, float] = {}
+    for name, val in after.items():
+        d = val - before.get(name, 0.0)
+        if d:
+            out[name] = round(d, 6)
+    return out
+
+
+def build_bundle(
+    query_id: str,
+    *,
+    error: str,
+    sql: Optional[str] = None,
+    state: str = "FAILED",
+    plan: Optional[str] = None,
+    stages: Optional[Any] = None,
+    trace=None,
+    task_stats: Optional[list] = None,
+    residency: Optional[Dict[Any, str]] = None,
+    fault_records: Optional[list] = None,
+    metrics_before: Optional[Dict[str, float]] = None,
+    metrics_after: Optional[Dict[str, float]] = None,
+    time_breakdown: Optional[Dict[str, Any]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Assemble one post-mortem bundle dict (pure — no I/O)."""
+    task_stats = list(task_stats or ())
+    histograms = [
+        {
+            "stage_id": row.get("stage_id"),
+            "task_id": row.get("task_id"),
+            "attempt": row.get("attempt"),
+            "partition_rows": row.get("partition_rows"),
+            "partition_bytes": row.get("partition_bytes"),
+        }
+        for row in task_stats
+        if row.get("partition_rows")
+    ]
+    bundle = {
+        "schema_version": SCHEMA_VERSION,
+        "query_id": query_id,
+        "state": state,
+        "error": error,
+        "error_class": error.split(":", 1)[0] if error else "unknown",
+        "sql": sql,
+        "created_at": time.time(),
+        "plan": plan,
+        "stages": stages,
+        "trace": trace.to_dict() if hasattr(trace, "to_dict") else trace,
+        "task_stats": task_stats,
+        "partition_histograms": histograms,
+        "residency": {
+            "/".join(str(p) for p in key) if isinstance(key, tuple)
+            else str(key): worker
+            for key, worker in (residency or {}).items()
+        },
+        "fault_injections": list(fault_records or ()),
+        "metric_deltas": _metric_deltas(metrics_before, metrics_after),
+        "time_breakdown": time_breakdown,
+    }
+    if extra:
+        bundle.update(extra)
+    return bundle
+
+
+def write_bundle(bundle: Dict[str, Any],
+                 directory: Optional[str] = None) -> Optional[str]:
+    """Persist a bundle under ``directory`` (default
+    ``TRINO_TPU_DIAG_DIR``); returns the path, or None when no
+    directory is configured."""
+    directory = directory or diag_dir()
+    if not directory:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    safe = str(bundle.get("query_id", "query")).replace("/", "_")
+    path = os.path.join(directory, f"{safe}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(bundle, f, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def record_bundle(bundle: Dict[str, Any]) -> Optional[str]:
+    """Retain a bundle on QUERY_INFO and write it to disk if a diag
+    directory is configured. Never raises — the bundle documents a
+    failure, it must not cause one."""
+    path = None
+    try:
+        telemetry.DIAG_BUNDLES.inc(
+            error_class=str(bundle.get("error_class") or "unknown")
+        )
+        tracker.QUERY_INFO.set_diagnostics(
+            str(bundle.get("query_id") or ""), bundle
+        )
+        path = write_bundle(bundle)
+        if path:
+            bundle["path"] = path
+    except Exception:
+        pass
+    return path

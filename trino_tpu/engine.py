@@ -71,6 +71,11 @@ class QueryResult:
     #: everything after it
     planning_ms: float = 0.0
     execution_ms: float = 0.0
+    #: wall-clock decomposition into named buckets (queued, planning,
+    #: compile, scan, compute, exchange, straggler slack, ...) plus the
+    #: critical path — telemetry_analysis.compute_time_breakdown over
+    #: the finished trace; None when tracing was not active
+    time_breakdown: dict | None = field(default=None, repr=False)
 
     @property
     def query_info(self) -> dict | None:
@@ -90,9 +95,11 @@ class QueryResult:
         """The profile artifact bench.py --profile-dir writes."""
         import json
 
+        info = dict(self.query_info or {})
+        if self.time_breakdown is not None:
+            info["time_breakdown"] = self.time_breakdown
         return json.dumps(
-            self.query_info or {}, indent=indent, default=str,
-            sort_keys=True,
+            info, indent=indent, default=str, sort_keys=True,
         )
 
 
@@ -332,6 +339,26 @@ class QueryRunner:
                     result.trace = tracer.finish()
                     result.planning_ms = plan_ms
                     result.execution_ms = max(elapsed_ms - plan_ms, 0.0)
+                    from trino_tpu import telemetry_analysis
+
+                    result.time_breakdown = (
+                        telemetry_analysis.compute_time_breakdown(
+                            result.trace, elapsed_ms, op_stats=op_stats,
+                        )
+                    )
+                    if (
+                        result.time_breakdown
+                        and result.names == ["Query Plan"]
+                        and result.stage_stats
+                    ):
+                        # local EXPLAIN ANALYZE (stage_stats filled by
+                        # _explain; plain EXPLAIN has none yet): the
+                        # breakdown footer rides the rendered plan
+                        result.rows.extend(
+                            (line,)
+                            for line in telemetry_analysis
+                            .format_breakdown(result.time_breakdown)
+                        )
                     if not result.stage_stats:
                         # local execution is one pseudo-stage; the fleet
                         # runner fills real per-stage aggregates instead
@@ -409,6 +436,9 @@ class QueryRunner:
                 maybe_log_slow_query(
                     listeners, self.session, query_id, sql,
                     elapsed_ms, op_stats, state=state,
+                    time_breakdown=(
+                        result.time_breakdown if result else None
+                    ),
                 )
 
     def _execute(self, sql: str) -> QueryResult:
@@ -800,8 +830,15 @@ class QueryRunner:
         # runners) are untouched
         ex.execute = timed
         xstats = getattr(ex, "exchange_stats", None)
-        # snapshot-delta (never reset shared counters)
+        # snapshot-delta (never reset shared counters); histograms are
+        # nested dicts, so deep-copy the edge maps for their delta
         x0 = dict(xstats) if xstats is not None else None
+        p0 = {
+            e: dict(h)
+            for e, h in (
+                (xstats or {}).get("partition_rows") or {}
+            ).items()
+        }
         skew0 = getattr(ex, "skew_joins", 0)
         esc0 = getattr(ex, "exchange_escalations", 0)
         # per-operator XLA cost attribution rides on the profiler the
@@ -876,6 +913,28 @@ class QueryRunner:
                 f"bucket escalations: "
                 f"{getattr(ex, 'exchange_escalations', 0) - esc0}"
             )
+        if xstats is not None:
+            from trino_tpu import telemetry_analysis
+
+            for edge, hist in sorted(
+                (xstats.get("partition_rows") or {}).items()
+            ):
+                base = p0.get(edge, {})
+                delta = {
+                    p: int(v) - int(base.get(p, 0))
+                    for p, v in hist.items()
+                    if int(v) - int(base.get(p, 0)) > 0
+                }
+                skew = telemetry_analysis.partition_skew(delta)
+                if skew["partitions"] > 1:
+                    # per-edge shard routing skew (only recorded when
+                    # the exchange_partition_counters debug sync is on)
+                    lines.append(
+                        f"Exchange {edge}: "
+                        f"{skew['partitions']} partitions, "
+                        f"max/mean {skew['max_mean_ratio']:.2f}, "
+                        f"cv {skew['cv']:.2f}"
+                    )
         for entry in (getattr(ex, "scan_log", None) or [])[scan0:]:
             # storage pushdown effectiveness (the connector-metrics
             # lines Trino's EXPLAIN ANALYZE renders per scan)

@@ -130,12 +130,13 @@ def fire_slow_query(listeners, record: dict) -> None:
 def maybe_log_slow_query(
     listeners, session, query_id: str, sql: str, elapsed_ms: float,
     operator_stats: list | None, state: str = "FINISHED",
+    time_breakdown: dict | None = None,
 ) -> None:
     """Fire one structured slow-query record when the statement ran
     past the ``slow_query_log_threshold`` session property (0 = off).
     The record is a profile *summary* — the top-3 operators by self
-    time — not the full tree; ``GET /v1/query/{id}`` and
-    ``profile_json()`` serve the rest."""
+    time plus the wall-clock bucket decomposition — not the full tree;
+    ``GET /v1/query/{id}`` and ``profile_json()`` serve the rest."""
     if not listeners:
         return
     from trino_tpu import session_properties as SP
@@ -173,6 +174,10 @@ def maybe_log_slow_query(
             }
             for r in top
         ],
+        **(
+            {"time_breakdown": time_breakdown.get("buckets")}
+            if time_breakdown else {}
+        ),
     })
 
 

@@ -706,6 +706,28 @@ class MeshExecutor(LocalExecutor):
                 cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
             from trino_tpu import session_properties as SP
 
+            if SP.get(self.session, "exchange_partition_counters"):
+                # skew observability (forces a host sync, so gated the
+                # same way as the coverage check): per-destination live
+                # row counts for this named edge, folded into
+                # exchange_stats histograms and the
+                # trino_exchange_partition_rows metric family
+                d_host, live_host = jax.device_get((dest, sp.mask))
+                counts = np.bincount(
+                    np.asarray(d_host).ravel()[
+                        np.asarray(live_host).ravel().astype(bool)
+                    ],
+                    minlength=n,
+                )
+                hist = self.exchange_stats.setdefault(
+                    "partition_rows", {}
+                ).setdefault(edge, {})
+                for p, c in enumerate(counts):
+                    if c:
+                        hist[p] = hist.get(p, 0) + int(c)
+                        telemetry.EXCHANGE_PARTITION_ROWS.inc(
+                            int(c), edge=edge, partition=str(p)
+                        )
             if SP.get(self.session, "check_exchange_coverage"):
                 # debug assertion (forces a host sync): an all_to_all
                 # must conserve live rows — any loss here is exactly

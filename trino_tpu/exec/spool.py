@@ -472,8 +472,11 @@ def write_task_output(
     the worker's direct-exchange buffer pool hooks in here so buffered
     bytes are exactly the committed on-disk bytes.
 
-    Returns ``{"rows": n, "bytes": total_file_bytes}`` for per-task
-    output stats."""
+    Returns ``{"rows": n, "bytes": total_file_bytes,
+    "partition_rows": {part: rows}, "partition_bytes": {part:
+    encoded_bytes}}`` for per-task output stats — the per-partition
+    maps are the skew histograms the fleet folds into per-edge
+    ``stage_stats`` (ROADMAP skew item, deliverable (a))."""
     import queue as _queue
     import threading as _threading
     import time as _time
@@ -493,6 +496,8 @@ def write_task_output(
         parts = np.zeros(n, dtype=np.int64)
     written = []
     manifest: dict[str, int] = {}
+    partition_rows: dict[int, int] = {}
+    partition_bytes: dict[int, int] = {}
 
     # async background commit: encoding (the CPU-bound half) stays on
     # the caller's thread while a writer thread lands files + markers
@@ -536,6 +541,8 @@ def write_task_output(
             raw, crc = encode_partition(payload, sel)
             manifest[name] = crc
             written.append(int(p))
+            partition_rows[int(p)] = int(len(sel))
+            partition_bytes[int(p)] = int(len(raw))
             work.put((int(p), name, raw, crc))
         if not written:
             # empty output still ships its schema (consumers need a
@@ -546,6 +553,8 @@ def write_task_output(
             )
             manifest[name] = crc
             written.append(0)
+            partition_rows[0] = 0
+            partition_bytes[0] = int(len(raw))
             work.put((0, name, raw, crc))
     finally:
         work.put(None)
@@ -576,7 +585,20 @@ def write_task_output(
     # the spool IS the fleet's exchange tier: rows committed here are
     # rows moved between stages
     telemetry.EXCHANGE_ROWS.inc(int(n))
-    return {"rows": int(n), "bytes": int(total)}
+    for p in written:
+        telemetry.EXCHANGE_PARTITION_ROWS.inc(
+            partition_rows.get(p, 0),
+            edge=str(stage_id), partition=str(p),
+        )
+        telemetry.EXCHANGE_PARTITION_BYTES.inc(
+            partition_bytes.get(p, 0),
+            edge=str(stage_id), partition=str(p),
+        )
+    return {
+        "rows": int(n), "bytes": int(total),
+        "partition_rows": partition_rows,
+        "partition_bytes": partition_bytes,
+    }
 
 
 def committed_attempt(root: str, stage_id: str, task_id: str) -> int | None:

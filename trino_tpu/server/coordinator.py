@@ -136,6 +136,10 @@ class Coordinator:
         #: query_max_queued_time / query_max_execution_time
         #: (MAIN/execution/QueryTracker.java enforceTimeLimits analog)
         self.query_tracker = QueryTracker(self)
+        #: cluster time-series recorder — constructed in start() ONLY
+        #: when TRINO_TPU_TIMESERIES_INTERVAL_MS enables it (None =
+        #: disabled = no background scrape thread exists at all)
+        self.timeseries = None
         # system.runtime tables over live coordinator state
         # (MAIN/connector/system/ analog)
         from trino_tpu.connectors.system import SystemConnector
@@ -205,6 +209,40 @@ class Coordinator:
                     # light row per known query
                     self._send(200, coordinator.query_info_list())
                     return
+                if self.path == "/v1/cluster/timeseries":
+                    # the bounded metric ring the background recorder
+                    # keeps (404 when time-series is disabled — no
+                    # recorder means no thread AND no endpoint)
+                    rec = coordinator.timeseries
+                    if rec is None:
+                        self._send(
+                            404, {"error": "time-series disabled"}
+                        )
+                    else:
+                        self._send(200, {
+                            "interval_ms": rec.interval_ms,
+                            "samples": rec.samples(),
+                        })
+                    return
+                if (
+                    len(parts) == 4
+                    and parts[:2] == ["v1", "query"]
+                    and parts[3] == "diagnostics"
+                ):
+                    # post-mortem bundle of a failed query (404 while
+                    # it runs, succeeds, or after retention sweeps it)
+                    from trino_tpu import tracker as _tracker
+
+                    bundle = _tracker.QUERY_INFO.get_diagnostics(
+                        parts[2]
+                    )
+                    if bundle is None:
+                        self._send(
+                            404, {"error": "no diagnostics bundle"}
+                        )
+                    else:
+                        self._send(200, bundle)
+                    return
                 if len(parts) == 3 and parts[:2] == ["v1", "query"]:
                     # full stage -> task -> operator tree, served live
                     # while the query is still running
@@ -262,9 +300,30 @@ class Coordinator:
         )
         self._thread.start()
         self.query_tracker.start()
+        from trino_tpu import telemetry_analysis
+
+        self.timeseries = telemetry_analysis.ClusterTimeseriesRecorder.from_env(
+            # live-resolved so fleet worker eviction/readmission is
+            # reflected scrape-to-scrape; a local runner has no workers
+            lambda: [
+                w.uri
+                for w in getattr(self.runner, "workers", ()) or ()
+                if getattr(w, "alive", True)
+            ]
+        )
+        if self.timeseries is not None:
+            self.timeseries.start()
+            telemetry_analysis.set_active_recorder(self.timeseries)
         return self
 
     def stop(self):
+        if self.timeseries is not None:
+            from trino_tpu import telemetry_analysis
+
+            self.timeseries.stop()
+            if telemetry_analysis.active_recorder() is self.timeseries:
+                telemetry_analysis.set_active_recorder(None)
+            self.timeseries = None
         self.query_tracker.stop()
         self._httpd.shutdown()
         self._httpd.server_close()

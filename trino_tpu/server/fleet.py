@@ -45,7 +45,15 @@ import uuid
 from collections import deque
 from dataclasses import dataclass
 
-from trino_tpu import fault, memory, profiler, telemetry, tracker
+from trino_tpu import (
+    diagnostics,
+    fault,
+    memory,
+    profiler,
+    telemetry,
+    telemetry_analysis,
+    tracker,
+)
 from trino_tpu import session_properties as sp
 from trino_tpu.connectors.base import ColumnDomain, Split
 from trino_tpu.engine import (
@@ -313,6 +321,14 @@ class FleetRunner:
         self._task_stats: list[dict] = []
         self._retries_by_stage: dict[str, int] = {}
         self._plan_ms = 0.0
+        #: per-worker wall-clock offsets, learned from the now_ms
+        #: stamp on every task-status response; persistent across
+        #: queries (the offset is a property of the worker process)
+        self._clock_skew = telemetry_analysis.ClockSkewEstimator()
+        #: trace of the last execution attempt, success or failure
+        #: (post-mortem bundles need the tree of a FAILED attempt)
+        self._last_trace = None
+        self._last_stages: list[Stage] | None = None
         #: absolute monotonic deadline / cooperative cancel for the
         #: statement in flight (set per execute())
         self._exec_deadline: float | None = None
@@ -381,6 +397,13 @@ class FleetRunner:
         t0 = time.perf_counter()
         error = None
         result = None
+        # a failure before any attempt ran (validation, planning) must
+        # not pick up the previous statement's state in its bundle
+        self._last_trace = None
+        self._last_stages = None
+        self._last_plan = None
+        self._task_stats = []
+        metrics_before = telemetry.REGISTRY.snapshot()
         try:
             result = self._execute_stmt(stmt, cancel_event)
             if explain_analyze:
@@ -391,6 +414,30 @@ class FleetRunner:
             raise
         finally:
             state = "FAILED" if error else "FINISHED"
+            if error:
+                # post-mortem bundle: everything a "why did this die"
+                # needs, assembled while the attempt's state is still
+                # on the runner (best-effort — never masks the error)
+                diagnostics.record_bundle(diagnostics.build_bundle(
+                    public_qid,
+                    error=error,
+                    sql=sql,
+                    state=state,
+                    plan=(
+                        P.plan_tree_str(self._last_plan)
+                        if getattr(self, "_last_plan", None) is not None
+                        else None
+                    ),
+                    stages=self._stages_summary(),
+                    trace=self._last_trace,
+                    task_stats=list(self._task_stats),
+                    residency=dict(
+                        getattr(self._scheduler, "_locations", {}) or {}
+                    ) if self._scheduler is not None else None,
+                    fault_records=list(self.failure_log),
+                    metrics_before=metrics_before,
+                    metrics_after=telemetry.REGISTRY.snapshot(),
+                ))
             tracker.QUERY_INFO.finish(
                 public_qid,
                 state=state,
@@ -452,6 +499,29 @@ class FleetRunner:
                     ),
                 ))
 
+    def _stages_summary(self) -> list[dict] | None:
+        """Lightweight fragmented-DAG description for post-mortem
+        bundles (stage ids, output partitioning, input edges)."""
+        stages = getattr(self, "_last_stages", None)
+        if not stages:
+            return None
+        return [
+            {
+                "stage_id": s.stage_id,
+                "partitioning": s.partitioning,
+                "hash_symbols": list(s.hash_symbols),
+                "inputs": [
+                    {
+                        "source_id": i.source_id,
+                        "stage_id": i.stage_id,
+                        "mode": i.mode,
+                    }
+                    for i in s.inputs
+                ],
+            }
+            for s in stages
+        ]
+
     def _maybe_log_slow_query(
         self, sql: str, elapsed_ms: float, result, query_id: str,
     ) -> None:
@@ -465,6 +535,9 @@ class FleetRunner:
         maybe_log_slow_query(
             getattr(self.metadata, "event_listeners", ()),
             self.session, query_id, sql, elapsed_ms, flat,
+            time_breakdown=(
+                result.time_breakdown if result is not None else None
+            ),
         )
 
     def _render_fleet_analyze(self, res: QueryResult) -> QueryResult:
@@ -520,6 +593,14 @@ class FleetRunner:
                 )
         for st in stats:
             lines.append(_stage_stats_line(f"Stage {st['stage_id']}", st))
+            skew = st.get("partition_skew") or {}
+            if int(skew.get("partitions", 0) or 0) > 1:
+                lines.append(
+                    f"  exchange partitions: {skew['partitions']}, "
+                    f"max/mean {skew['max_mean_ratio']:.2f}, "
+                    f"cv {skew['cv']:.2f} "
+                    f"(hottest {int(skew['max'])} rows)"
+                )
             for name, o in sorted(
                 ops_by_stage.get(st["stage_id"], {}).items(),
                 key=lambda kv: kv[1]["self_ms"], reverse=True,
@@ -539,10 +620,14 @@ class FleetRunner:
                     if util is not None:
                         line += f" ({util * 100:.1f}% of roofline)"
                 lines.append(line)
+        lines.extend(
+            telemetry_analysis.format_breakdown(res.time_breakdown)
+        )
         plan = getattr(self, "_last_plan", None)
         if plan is not None:
             lines.extend(P.plan_tree_str(plan).splitlines())
         out = QueryResult(["Query Plan"], [(line,) for line in lines])
+        out.time_breakdown = res.time_breakdown
         out.stage_stats = res.stage_stats
         out.task_stats = res.task_stats
         out.trace = res.trace
@@ -644,6 +729,7 @@ class FleetRunner:
                         (time.perf_counter() - t_plan) * 1e3
                     )
                     self._last_plan = plan
+                    self._last_stages = stages
                 return self._execute_attempt(plan, stages, query_retries)
             except Exception as e:
                 if policy != "QUERY" or not _query_tier_retryable(e):
@@ -670,6 +756,13 @@ class FleetRunner:
         plan_ms = getattr(self, "_plan_ms", 0.0)
         if plan_ms:
             psp = tracer.start("planning", "planning")
+            # planning happened BEFORE this attempt's root opened:
+            # backdate the synthetic span so the timeline is truthful
+            # and the wall-clock decomposition (which clips children to
+            # the root interval and accounts planning via its explicit
+            # planning_ms input) never double-counts it against the
+            # stage spans it would otherwise overlap
+            psp.start_ms -= plan_ms
             psp.duration_ms = plan_ms
             psp._open = False
         self._stage_spans: dict[str, telemetry.Span] = {}
@@ -711,8 +804,27 @@ class FleetRunner:
                 if spn._open:
                     spn.finish()
             res.trace = trace
+            res.time_breakdown = telemetry_analysis.compute_time_breakdown(
+                trace,
+                plan_ms + res.execution_ms,
+                planning_ms=plan_ms,
+                task_stats=res.task_stats,
+            )
             return res
         finally:
+            # seal the trace even when the attempt died mid-flight —
+            # the post-mortem bundle wants the tree as far as it got
+            # (Span.finish is idempotent, so the success path's own
+            # finish above is unaffected)
+            if self._tracer is not None:
+                try:
+                    tr = self._tracer.finish()
+                    for spn in tr.root.walk():
+                        if spn._open:
+                            spn.finish()
+                    self._last_trace = tr
+                except Exception:
+                    pass
             self._tracer = None
             if (
                 self.dispatcher is not None
@@ -761,6 +873,7 @@ class FleetRunner:
                 "retries": 0, "peak_memory_bytes": 0,
                 "admission_wait_ms": 0.0,
                 "direct_bytes": 0, "spooled_bytes": 0,
+                "partition_rows": {}, "partition_bytes": {},
             })
 
         for ts in self._task_stats:
@@ -783,9 +896,23 @@ class FleetRunner:
             )
             st["direct_bytes"] += int(ts.get("direct_bytes", 0) or 0)
             st["spooled_bytes"] += int(ts.get("spooled_bytes", 0) or 0)
+            # per-partition exchange histograms: the stage's output
+            # edge, summed over its committed tasks (deliverable (a)
+            # of the ROADMAP skew item)
+            for field, src in (
+                ("partition_rows", ts.get("partition_rows")),
+                ("partition_bytes", ts.get("partition_bytes")),
+            ):
+                for p, v in (src or {}).items():
+                    st[field][str(p)] = (
+                        st[field].get(str(p), 0) + int(v or 0)
+                    )
         for sid, n in self._retries_by_stage.items():
             entry(sid)["retries"] = n
         for st in by_stage.values():
+            st["partition_skew"] = telemetry_analysis.partition_skew(
+                st["partition_rows"]
+            )
             # fraction of exchange input bytes a stage's tasks pulled
             # straight from producer memory (vs. the durable spool)
             tot = st["direct_bytes"] + st["spooled_bytes"]
@@ -1687,6 +1814,18 @@ class FleetRunner:
                             {"edge_rows": tstats["edge_rows"]}
                             if "edge_rows" in tstats else {}
                         ),
+                        # per-output-partition histograms off the spool
+                        # commit (rows + encoded bytes) — the fleet
+                        # folds these into per-edge skew stats
+                        **(
+                            {
+                                "partition_rows":
+                                    tstats["partition_rows"],
+                                "partition_bytes":
+                                    tstats.get("partition_bytes") or {},
+                            }
+                            if tstats.get("partition_rows") else {}
+                        ),
                     }
                     self._task_stats.append(task_row)
                     # live introspection: GET /v1/query/{id} serves
@@ -1696,7 +1835,16 @@ class FleetRunner:
                         task_row,
                     )
                     if self._tracer is not None and state.get("spans"):
-                        self._tracer.attach(state["spans"])
+                        # worker subtrees carry the WORKER's wall
+                        # clock; shift onto the coordinator's timeline
+                        # before stitching so Chrome traces and
+                        # critical-path math never go negative
+                        off = self._clock_skew.offset_ms(w.uri)
+                        self._tracer.attach(
+                            telemetry_analysis.shift_span_tree(
+                                state["spans"], off
+                            )
+                        )
                     runtimes.setdefault(sid, []).append(
                         time.monotonic() - t0
                     )
@@ -1983,12 +2131,20 @@ class FleetRunner:
         # eviction threshold, like a real unresponsive worker
         fault.check("rpc", tag=f"poll:{task_id}", attempt=attempt)
         t_rpc = time.perf_counter()
+        t_send = time.time() * 1e3
         try:
             with urllib.request.urlopen(
                 f"{w.uri}/v1/stagetask/{task_id}.{attempt}",
                 timeout=self.rpc_timeout_s,
             ) as resp:
-                return json.loads(resp.read())
+                state = json.loads(resp.read())
+            # every status response carries the worker's wall clock:
+            # the NTP midpoint estimate keeps a per-worker offset fresh
+            # for span stitching
+            self._clock_skew.observe(
+                w.uri, t_send, time.time() * 1e3, state.get("now_ms")
+            )
+            return state
         finally:
             telemetry.RPC_LATENCY.observe(
                 time.perf_counter() - t_rpc, op="poll"

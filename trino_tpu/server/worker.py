@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import urllib.request
 import uuid
 from collections import OrderedDict
@@ -245,6 +246,11 @@ class WorkerServer:
                 payload["pool"] = (
                     worker.runner.executor.memory_pool.snapshot()
                 )
+                # this process's wall clock, stamped per response: the
+                # coordinator's NTP-style skew estimator turns these
+                # into per-worker offsets so stitched span subtrees
+                # share one timeline
+                payload["now_ms"] = time.time() * 1e3
                 self._send(200, payload)
 
             def _buffer_fetch(self, task_id, attempt, part, query):
@@ -907,7 +913,11 @@ class WorkerServer:
                                     ),
                                 ) or out_stats
                                 write_sp.finish()
-                                write_sp.attrs.update(out_stats)
+                                write_sp.attrs.update({
+                                    k: out_stats[k]
+                                    for k in ("rows", "bytes")
+                                    if k in out_stats
+                                })
                         finally:
                             jit_cache.set_active_span(None)
                             ex.profiler = None
@@ -944,6 +954,22 @@ class WorkerServer:
                             "direct_bytes": int(direct_bytes),
                             "spooled_bytes": int(spooled_bytes),
                             "edge_rows": edge_rows,
+                            **(
+                                {
+                                    "partition_rows": {
+                                        str(p): r for p, r in
+                                        out_stats["partition_rows"].items()
+                                    },
+                                    "partition_bytes": {
+                                        str(p): b for p, b in
+                                        out_stats.get(
+                                            "partition_bytes", {}
+                                        ).items()
+                                    },
+                                }
+                                if out_stats.get("partition_rows")
+                                else {}
+                            ),
                             **(
                                 {"col_ranges": col_ranges}
                                 if col_ranges else {}

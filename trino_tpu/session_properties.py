@@ -223,6 +223,17 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "Forces host syncs; keep OFF outside debugging",
             "boolean", False, hidden=True,
         ),
+        _P(
+            "exchange_partition_counters",
+            "Record per-destination live-row counts on every mesh "
+            "all_to_all edge (the trino_exchange_partition_rows metric "
+            "family plus exchange_stats histograms) — the skew "
+            "observability feed behind salted repartitioning. Forces a "
+            "host sync per exchange; keep OFF outside skew debugging "
+            "(the spool boundary records its histograms unconditionally "
+            "and cheaply)",
+            "boolean", False, hidden=True,
+        ),
         # ---- local execution (exec.local) -----------------------------
         _P(
             "cross_join_chunk_rows",

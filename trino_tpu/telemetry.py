@@ -346,6 +346,10 @@ class Gauge(_Metric):
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
 
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
     def render(self) -> List[str]:
         with self._lock:
             merged = dict(self._values)
@@ -383,6 +387,14 @@ class Histogram(_Metric):
     def count(self, **labels: str) -> int:
         with self._lock:
             return self._totals.get(_label_key(labels), 0)
+
+    def total_count(self) -> int:
+        with self._lock:
+            return sum(self._totals.values())
+
+    def total_sum(self) -> float:
+        with self._lock:
+            return sum(self._sums.values())
 
     def render(self) -> List[str]:
         out: List[str] = []
@@ -439,6 +451,22 @@ class MetricsRegistry:
             lines.extend(m.header())
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat scalar snapshot of every family — counters and gauges
+        collapse across label sets; histograms report ``_count`` and
+        ``_sum``. The before/after substrate of diagnostic-bundle metric
+        deltas and the cluster time-series recorder's self-scrape."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        out: Dict[str, float] = {}
+        for m in metrics:
+            if isinstance(m, Histogram):
+                out[f"{m.name}_count"] = float(m.total_count())
+                out[f"{m.name}_sum"] = float(m.total_sum())
+            elif isinstance(m, (Counter, Gauge)):
+                out[m.name] = float(m.total())
+        return out
 
 
 #: Process-global registry served at GET /v1/metrics.
@@ -576,6 +604,24 @@ SCAN_BYTES_READ = REGISTRY.counter(
 SCAN_BATCHES = REGISTRY.counter(
     "trino_scan_batches",
     "Row-group batches streamed through the out-of-core scan operator")
+EXCHANGE_PARTITION_ROWS = REGISTRY.counter(
+    "trino_exchange_partition_rows",
+    "Rows routed to each output partition across exchange edges "
+    "(spool boundary always; mesh all_to_all when the "
+    "exchange_partition_counters debug sync is on)")
+EXCHANGE_PARTITION_BYTES = REGISTRY.counter(
+    "trino_exchange_partition_bytes",
+    "Encoded bytes routed to each output partition at the spool "
+    "exchange boundary")
+DIAG_BUNDLES = REGISTRY.counter(
+    "trino_diag_bundles_total",
+    "Post-mortem diagnostic bundles assembled, by trigger error class")
+TIMESERIES_SAMPLES = REGISTRY.counter(
+    "trino_timeseries_samples_total",
+    "Cluster time-series scrape rounds recorded into the ring")
+TIMESERIES_SCRAPE_FAILURES = REGISTRY.counter(
+    "trino_timeseries_scrape_failures_total",
+    "Worker /v1/metrics scrapes that failed during a time-series round")
 
 
 # ---------------------------------------------------------------------------

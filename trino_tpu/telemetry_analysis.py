@@ -1,0 +1,546 @@
+"""Analysis layer over the telemetry substrate.
+
+The raw instrumentation (trace spans, the Prometheus registry, the
+roofline profiler) answers "what happened"; this module answers "where
+did the time go":
+
+* **Critical-path attribution** — walk a finished :class:`~trino_tpu.
+  telemetry.Trace` span tree and decompose query wall-clock into named
+  buckets (queued, slot-wait, planning, XLA compile, scheduler
+  admission-wait, scan, compute, exchange, straggler slack). The
+  decomposition is exact by construction: a sweep line attributes
+  every instant of the root interval to exactly ONE bucket (the
+  highest-priority span class active at that instant — work beats
+  waiting), so concurrent worker subtrees never double-count and the
+  buckets sum to the root span's duration; whatever the trace did not
+  cover lands in an explicit ``other`` bucket rather than silently
+  vanishing.
+* **Partition-skew statistics** — max/mean ratio and coefficient of
+  variation over a per-partition row/byte histogram (the derived stats
+  the fleet publishes per hash-exchange edge).
+* **Clock-skew correction** — per-worker wall-clock offsets estimated
+  from task RPC request/response timestamps (the NTP midpoint
+  estimate), applied to worker span subtrees before stitching so
+  Chrome traces and critical-path math never go negative across
+  machines.
+* **Cluster time-series recorder** — a bounded in-memory ring of
+  periodic registry scrapes (coordinator's own + every worker's
+  ``/v1/metrics``), behind ``TRINO_TPU_TIMESERIES_{INTERVAL_MS,
+  SAMPLES}``; served at ``GET /v1/cluster/timeseries`` and
+  ``system.runtime.cluster_metrics``.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+from trino_tpu import telemetry
+
+__all__ = [
+    "BUCKETS",
+    "compute_time_breakdown",
+    "critical_path",
+    "format_breakdown",
+    "partition_skew",
+    "straggler_slack_ms",
+    "shift_span_tree",
+    "ClockSkewEstimator",
+    "ClusterTimeseriesRecorder",
+    "active_recorder",
+    "set_active_recorder",
+]
+
+
+# ---------------------------------------------------------------------------
+# Critical-path attribution
+# ---------------------------------------------------------------------------
+
+#: Bucket names, in the order breakdowns render. ``other`` absorbs
+#: trace self-time with no better classification (RPC control plane,
+#: coordinator loop overhead) plus any wall-clock the trace missed.
+BUCKETS = (
+    "queued", "slot_wait", "planning", "xla_compile",
+    "admission_wait", "scan", "compute", "exchange",
+    "straggler_slack", "other",
+)
+
+#: internal marker for execution-span self time, split into scan vs
+#: compute afterwards using operator self-time fractions
+_EXEC = "_exec"
+
+
+def _classify(span) -> str:
+    """Bucket for one span's self time."""
+    kind = span.kind
+    if kind == "planning":
+        return "planning"
+    if kind == "compile":
+        return "xla_compile"
+    if kind in ("spool", "exchange"):
+        return "exchange"
+    if kind == "stage":
+        # a stage span's self time is the part not covered by its rpc
+        # and (stitched) worker task children: admission + poll gaps
+        return "admission_wait"
+    if kind == "execution":
+        return _EXEC
+    if kind == "task":
+        # worker task overhead outside spool-read/execute/spool-write
+        return "compute"
+    return "other"
+
+
+#: when several classes are active at the same instant (concurrent
+#: workers, coordinator loop under a busy stage), the wall second goes
+#: to the FIRST active class in this order — work beats waiting
+_PRIORITY = (
+    _EXEC, "xla_compile", "exchange", "compute", "planning",
+    "admission_wait", "other",
+)
+
+
+def _self_intervals(span, clip_lo: float, clip_hi: float,
+                    out: List[tuple]) -> None:
+    """Emit (lo, hi, class) for every span's *self* region — its
+    interval (clipped to the parent's) minus the union of its
+    children's. The root covers its whole window, so the emitted
+    regions cover every instant of the root interval at least once;
+    overlap across concurrent subtrees is resolved by the sweep in
+    :func:`compute_time_breakdown`."""
+    lo = max(float(span.start_ms), clip_lo)
+    hi = min(float(span.start_ms) + max(float(span.duration_ms), 0.0),
+             clip_hi)
+    if hi <= lo:
+        return
+    child_iv = []
+    for c in span.children:
+        c_lo = max(float(c.start_ms), lo)
+        c_hi = min(float(c.start_ms) + max(float(c.duration_ms), 0.0),
+                   hi)
+        if c_hi > c_lo:
+            child_iv.append((c_lo, c_hi))
+        _self_intervals(c, lo, hi, out)
+    cls = _classify(span)
+    cur = lo
+    for i_lo, i_hi in sorted(child_iv):
+        if i_lo > cur:
+            out.append((cur, i_lo, cls))
+        cur = max(cur, i_hi)
+    if hi > cur:
+        out.append((cur, hi, cls))
+
+
+def _sweep(intervals: List[tuple]) -> Dict[str, float]:
+    """Attribute every instant covered by ≥1 self-interval to exactly
+    one class (the highest-priority active one), so the class totals
+    partition the covered wall-clock — concurrent spans never
+    double-count."""
+    events: List[tuple] = []
+    for lo, hi, cls in intervals:
+        events.append((lo, 0, cls))   # open before close at a tie
+        events.append((hi, 1, cls))
+    events.sort(key=lambda e: (e[0], e[1]))
+    active = {c: 0 for c in _PRIORITY}
+    out: Dict[str, float] = {}
+    prev = None
+    for pos, kind, cls in events:
+        if prev is not None and pos > prev:
+            best = None
+            for c in _PRIORITY:
+                if active[c]:
+                    best = c
+                    break
+            if best is not None:
+                out[best] = out.get(best, 0.0) + (pos - prev)
+        active[cls] = active.get(cls, 0) + (1 if kind == 0 else -1)
+        prev = pos
+    return out
+
+
+def straggler_slack_ms(task_stats: Optional[Iterable[dict]]) -> float:
+    """Per-stage max-task elapsed minus median-task elapsed, summed —
+    wall-clock the query spent waiting on one task after its siblings
+    were done (the salted-repartitioning motivation number)."""
+    by_stage: Dict[str, List[float]] = {}
+    for row in task_stats or ():
+        if row.get("state") != "FINISHED":
+            continue
+        by_stage.setdefault(str(row.get("stage_id")), []).append(
+            float(row.get("elapsed_ms", 0.0) or 0.0)
+        )
+    slack = 0.0
+    for times in by_stage.values():
+        if len(times) > 1:
+            slack += max(times) - statistics.median(times)
+    return slack
+
+
+def _scan_fraction(op_stats: Optional[Iterable[dict]]) -> float:
+    """Fraction of operator self time spent in scan operators."""
+    scan = total = 0.0
+    for row in op_stats or ():
+        ms = float(row.get("self_ms", 0.0) or 0.0)
+        total += ms
+        if "scan" in str(row.get("node_type") or row.get("name") or "").lower():
+            scan += ms
+    return scan / total if total > 0 else 0.0
+
+
+def compute_time_breakdown(
+    trace, wall_ms: float, *,
+    queued_ms: float = 0.0,
+    slot_wait_ms: float = 0.0,
+    planning_ms: float = 0.0,
+    task_stats: Optional[List[dict]] = None,
+    op_stats: Optional[List[dict]] = None,
+    compile_ms: float = 0.0,
+) -> Optional[Dict[str, Any]]:
+    """Decompose ``wall_ms`` into the named :data:`BUCKETS`.
+
+    ``queued_ms``/``slot_wait_ms``/``planning_ms`` cover time before
+    the trace root opened (the fleet backdates its synthetic planning
+    span out of the root interval and passes the measured planning
+    wall here instead). ``op_stats`` (or the per-task ``operator_stats`` inside
+    ``task_stats``) splits execution self-time into scan vs compute;
+    ``compile_ms`` (a compile-counter delta) reroutes compile time the
+    span tree could not see out of compute. Straggler slack is carved
+    out of the compute bucket — while a straggler runs alone, the wall
+    it burns shows up as (unioned) execute time.
+
+    Returns ``{"wall_ms", "buckets": {...}, "coverage",
+    "critical_path"}``; buckets sum to ``wall_ms`` up to float noise,
+    with the trace-uncovered remainder in ``other``.
+    """
+    if trace is None or getattr(trace, "root", None) is None:
+        return None
+    root = trace.root
+    buckets: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+    buckets["queued"] = max(float(queued_ms), 0.0)
+    buckets["slot_wait"] = max(float(slot_wait_ms), 0.0)
+    buckets["planning"] = max(float(planning_ms), 0.0)
+    root_lo = float(root.start_ms)
+    root_hi = root_lo + max(float(root.duration_ms), 0.0)
+    intervals: List[tuple] = []
+    _self_intervals(root, root_lo, root_hi, intervals)
+    raw = _sweep(intervals)
+    for k, v in raw.items():
+        if k != _EXEC:
+            buckets[k] = buckets.get(k, 0.0) + v
+    exec_ms = raw.get(_EXEC, 0.0)
+    if op_stats is None and task_stats:
+        op_stats = [
+            row
+            for ts in task_stats
+            for row in ts.get("operator_stats") or ()
+        ]
+    frac = _scan_fraction(op_stats)
+    buckets["scan"] += exec_ms * frac
+    buckets["compute"] += exec_ms * (1.0 - frac)
+    # compile work the span tree missed (real backend compiles inside
+    # execute spans emit no span of their own — only the counters see
+    # them): reroute the counter delta out of compute
+    extra_compile = max(float(compile_ms) - buckets["xla_compile"], 0.0)
+    moved = min(extra_compile, buckets["compute"])
+    buckets["xla_compile"] += moved
+    buckets["compute"] -= moved
+    slack = min(straggler_slack_ms(task_stats), buckets["compute"])
+    buckets["straggler_slack"] += slack
+    buckets["compute"] -= slack
+    attributed = sum(buckets.values())
+    if wall_ms > attributed:
+        buckets["other"] += wall_ms - attributed
+    total = sum(buckets.values())
+    return {
+        "wall_ms": round(float(wall_ms), 3),
+        "buckets": {b: round(buckets[b], 3) for b in BUCKETS},
+        "coverage": round(total / wall_ms, 4) if wall_ms > 0 else 1.0,
+        "critical_path": critical_path(trace),
+    }
+
+
+def critical_path(trace, limit: int = 16) -> List[Dict[str, Any]]:
+    """The longest chain through the span tree: from the root, descend
+    into the latest-*ending* child at every level (the span everything
+    after it had to wait for). One entry per hop, root first."""
+    path: List[Dict[str, Any]] = []
+    sp = getattr(trace, "root", None)
+    while sp is not None and len(path) < limit:
+        path.append({
+            "name": sp.name,
+            "kind": sp.kind,
+            "node": sp.node or "coordinator",
+            "duration_ms": round(max(float(sp.duration_ms), 0.0), 3),
+        })
+        kids = [c for c in sp.children if float(c.duration_ms) > 0.0]
+        if not kids:
+            break
+        sp = max(
+            kids,
+            key=lambda c: float(c.start_ms) + float(c.duration_ms),
+        )
+    return path
+
+
+def format_breakdown(breakdown: Optional[Dict[str, Any]]) -> List[str]:
+    """EXPLAIN ANALYZE footer lines for one time breakdown."""
+    if not breakdown:
+        return []
+    wall = float(breakdown.get("wall_ms", 0.0) or 0.0)
+    lines = [
+        f"Time breakdown (wall {wall:.1f} ms, "
+        f"coverage {float(breakdown.get('coverage', 1.0)) * 100:.0f}%):"
+    ]
+    buckets = breakdown.get("buckets") or {}
+    for name in BUCKETS:
+        v = float(buckets.get(name, 0.0) or 0.0)
+        if v < 0.05:
+            continue
+        pct = f" ({v / wall * 100:.1f}%)" if wall > 0 else ""
+        lines.append(f"  {name:<16} {v:>10.1f} ms{pct}")
+    cp = breakdown.get("critical_path") or []
+    if cp:
+        tail = cp[-1]
+        lines.append(
+            f"Critical path: {' -> '.join(seg['name'] for seg in cp)} "
+            f"(tail {tail['duration_ms']:.1f} ms on {tail['node']})"
+        )
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# Partition-skew statistics
+# ---------------------------------------------------------------------------
+
+
+def partition_skew(hist: Optional[Dict[Any, Any]]) -> Dict[str, float]:
+    """Skew stats over a per-partition histogram (rows or bytes).
+
+    ``max_mean_ratio`` is 1.0 for a perfectly uniform distribution and
+    grows with the hot partition's share; ``cv`` is the population
+    coefficient of variation. Keys may be ints or (post-JSON) strings.
+    """
+    vals = [float(v) for v in (hist or {}).values()]
+    if not vals:
+        return {"partitions": 0, "max": 0.0, "mean": 0.0,
+                "max_mean_ratio": 0.0, "cv": 0.0}
+    mean = sum(vals) / len(vals)
+    mx = max(vals)
+    cv = statistics.pstdev(vals) / mean if mean > 0 else 0.0
+    return {
+        "partitions": len(vals),
+        "max": mx,
+        "mean": round(mean, 3),
+        "max_mean_ratio": round(mx / mean, 4) if mean > 0 else 0.0,
+        "cv": round(cv, 4),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Cross-process clock-skew correction
+# ---------------------------------------------------------------------------
+
+
+def shift_span_tree(span_dict: Dict[str, Any],
+                    offset_ms: float) -> Dict[str, Any]:
+    """Shift a serialized span subtree's wall-clock timestamps in place
+    (worker clock -> coordinator clock) before ``Tracer.attach``."""
+    if not offset_ms:
+        return span_dict
+    span_dict["start_ms"] = (
+        float(span_dict.get("start_ms", 0.0)) + offset_ms
+    )
+    for c in span_dict.get("children") or ():
+        shift_span_tree(c, offset_ms)
+    return span_dict
+
+
+class ClockSkewEstimator:
+    """Per-node wall-clock offset from RPC request/response timestamps.
+
+    Workers stamp their own wall clock (``now_ms``) on task-status
+    responses; the coordinator records its send/receive wall times
+    around the RPC. The NTP midpoint estimate —
+    ``(send + receive) / 2 - remote_now`` — is the milliseconds to ADD
+    to a remote timestamp to land it on the coordinator's clock,
+    smoothed with an EWMA so one slow response does not jerk the
+    estimate. Single-threaded by construction (only the fleet's
+    ``_run_dag`` RPC loop touches it)."""
+
+    def __init__(self, alpha: float = 0.25) -> None:
+        self.alpha = float(alpha)
+        self._offsets: Dict[str, float] = {}
+
+    def observe(self, node: str, send_ms: float, recv_ms: float,
+                remote_now_ms: Optional[float]) -> None:
+        if remote_now_ms is None:
+            return
+        est = (float(send_ms) + float(recv_ms)) / 2.0 - float(
+            remote_now_ms
+        )
+        cur = self._offsets.get(node)
+        self._offsets[node] = (
+            est if cur is None else cur + self.alpha * (est - cur)
+        )
+
+    def offset_ms(self, node: str) -> float:
+        return float(self._offsets.get(node, 0.0))
+
+    def offsets(self) -> Dict[str, float]:
+        return dict(self._offsets)
+
+
+# ---------------------------------------------------------------------------
+# Cluster time-series recorder
+# ---------------------------------------------------------------------------
+
+
+def _parse_prometheus(text: str) -> Dict[str, float]:
+    """Prometheus text exposition -> ``{series: value}`` (full series
+    names, labels included)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+class ClusterTimeseriesRecorder:
+    """Bounded ring of periodic cluster-wide metric scrapes.
+
+    Each sample is ``{"ts": epoch_seconds, "nodes": {node: {series:
+    value}}}``: the coordinator's own registry snapshot plus one parsed
+    ``/v1/metrics`` scrape per reachable worker. Gated entirely by
+    ``TRINO_TPU_TIMESERIES_INTERVAL_MS`` — when unset (the default) no
+    recorder is constructed and NO background thread runs.
+    """
+
+    def __init__(self, worker_uris=(), interval_ms: float = 1000.0,
+                 max_samples: int = 512) -> None:
+        #: static list or zero-arg callable returning current URIs
+        self.worker_uris = worker_uris
+        self.interval_ms = float(interval_ms)
+        self._samples: deque = deque(maxlen=max(int(max_samples), 1))
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def from_env(worker_uris=()) -> Optional["ClusterTimeseriesRecorder"]:
+        """Recorder per env config, or None (= no scrape thread) when
+        ``TRINO_TPU_TIMESERIES_INTERVAL_MS`` is unset or non-positive."""
+        raw = os.environ.get("TRINO_TPU_TIMESERIES_INTERVAL_MS", "")
+        try:
+            interval = float(raw)
+        except ValueError:
+            return None
+        if interval <= 0:
+            return None
+        try:
+            samples = int(
+                os.environ.get("TRINO_TPU_TIMESERIES_SAMPLES", "512")
+            )
+        except ValueError:
+            samples = 512
+        return ClusterTimeseriesRecorder(
+            worker_uris=worker_uris, interval_ms=interval,
+            max_samples=samples,
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ClusterTimeseriesRecorder":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="cluster-timeseries", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_ms / 1000.0):
+            try:
+                self.sample()
+            except Exception:
+                pass  # the recorder must outlive any one bad scrape
+
+    # -- sampling -------------------------------------------------------
+
+    def _uris(self) -> List[str]:
+        uris = self.worker_uris
+        if callable(uris):
+            uris = uris()
+        return list(uris or ())
+
+    def sample(self) -> Dict[str, Any]:
+        """Take one scrape round now (also callable from tests without
+        the thread)."""
+        nodes: Dict[str, Dict[str, float]] = {
+            "coordinator": telemetry.REGISTRY.snapshot(),
+        }
+        for uri in self._uris():
+            try:
+                with urllib.request.urlopen(
+                    f"{uri}/v1/metrics", timeout=2.0
+                ) as r:
+                    nodes[uri] = _parse_prometheus(r.read().decode())
+            except Exception:
+                telemetry.TIMESERIES_SCRAPE_FAILURES.inc()
+        entry = {"ts": time.time(), "nodes": nodes}
+        with self._lock:
+            self._samples.append(entry)
+        telemetry.TIMESERIES_SAMPLES.inc()
+        return entry
+
+    # -- read side ------------------------------------------------------
+
+    def samples(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._samples)
+
+    def rows(self) -> List[tuple]:
+        """Flat ``(ts, node, series, value)`` rows for
+        ``system.runtime.cluster_metrics``."""
+        out: List[tuple] = []
+        for s in self.samples():
+            ts = float(s["ts"])
+            for node, series in s["nodes"].items():
+                for name, value in series.items():
+                    out.append((ts, node, name, float(value)))
+        return out
+
+
+#: the recorder the system connector reads; set by whichever
+#: coordinator started one (None = time-series disabled)
+_ACTIVE_RECORDER: Optional[ClusterTimeseriesRecorder] = None
+
+
+def set_active_recorder(
+    rec: Optional[ClusterTimeseriesRecorder],
+) -> None:
+    global _ACTIVE_RECORDER
+    _ACTIVE_RECORDER = rec
+
+
+def active_recorder() -> Optional[ClusterTimeseriesRecorder]:
+    return _ACTIVE_RECORDER

@@ -22,8 +22,50 @@ from trino_tpu.types import format_date
 
 __all__ = [
     "load_tpch_sqlite", "load_tpcds_sqlite", "assert_rows_match",
-    "to_sqlite",
+    "to_sqlite", "sqlite_supports",
 ]
+
+
+def _probe_capabilities() -> frozenset:
+    """Feature-probe the embedded sqlite the oracle runs on. Older
+    builds (3.34 and earlier) lack the SQL math functions (``exp``,
+    ``ln``, ... — 3.35, and only when compiled with
+    SQLITE_ENABLE_MATH_FUNCTIONS) and RIGHT/FULL OUTER JOIN (3.39).
+    Tests that need the oracle to evaluate those shapes skip instead
+    of failing on environments with an old library."""
+    caps = set()
+    conn = sqlite3.connect(":memory:")
+    try:
+        try:
+            conn.execute("SELECT exp(1.0)").fetchone()
+            caps.add("math_functions")
+        except sqlite3.OperationalError:
+            pass
+        try:
+            conn.execute(
+                "SELECT * FROM (SELECT 1 a) x "
+                "FULL JOIN (SELECT 1 b) y ON x.a = y.b"
+            ).fetchall()
+            caps.add("full_join")
+        except sqlite3.OperationalError:
+            pass
+    finally:
+        conn.close()
+    return frozenset(caps)
+
+
+_CAPABILITIES: frozenset | None = None
+
+
+def sqlite_supports(capability: str) -> bool:
+    """True when the oracle's sqlite build has ``capability``
+    (``"math_functions"`` | ``"full_join"``). Probed once per
+    process by executing a representative statement — version
+    sniffing would miss compile-time feature flags."""
+    global _CAPABILITIES
+    if _CAPABILITIES is None:
+        _CAPABILITIES = _probe_capabilities()
+    return capability in _CAPABILITIES
 
 
 def load_tpcds_sqlite(data, tables: list[str] | None = None) -> sqlite3.Connection:

@@ -168,6 +168,8 @@ class QueryInfoRegistry:
                 #: (stage_id, task_id, attempt) -> task row (with
                 #: operator_stats); latest attempt wins per task
                 "tasks": {},
+                #: post-mortem diagnostic bundle (failed queries only)
+                "diagnostics": None,
             }
         return e
 
@@ -229,6 +231,19 @@ class QueryInfoRegistry:
                     "operator_stats": operator_stats,
                 }
             self._sweep_locked()
+
+    def set_diagnostics(self, query_id: str, bundle: dict) -> None:
+        """Retain a post-mortem bundle; served by
+        ``GET /v1/query/{id}/diagnostics`` until the entry sweeps."""
+        if not query_id:
+            return
+        with self._lock:
+            self._entry(query_id)["diagnostics"] = bundle
+
+    def get_diagnostics(self, query_id: str) -> dict | None:
+        with self._lock:
+            e = self._entries.get(query_id)
+            return e["diagnostics"] if e else None
 
     # -- read side ------------------------------------------------------
 
