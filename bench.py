@@ -150,6 +150,14 @@ def main(argv=None) -> int:
         "a byte-equality check between the two modes' results",
     )
     ap.add_argument(
+        "--skew", action="store_true",
+        help="also run the adversarial-skew A/B: a single-hot-key and "
+        "a zipf-like join on a live 2-worker fleet, salted-vs-unsalted "
+        "and adaptive-vs-static, recording wall-clock, observed "
+        "per-task input balance, straggler slack, and row-identity "
+        "between the plans",
+    )
+    ap.add_argument(
         "--trace-dir", default=os.environ.get("BENCH_TRACE_DIR"),
         help="export each warmup query's trace as Chrome trace-event "
         "JSON (<dir>/<qid>.trace.json — load in chrome://tracing or "
@@ -538,6 +546,15 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         _exchange_section(detail)
 
     if (
+        args.skew or _section_enabled("BENCH_SKEW", False)
+    ) and fits("skew", 240.0):
+        # adversarial-skew A/B (BENCH_r09): the ROADMAP skew item's
+        # (d) deliverable — salted-vs-unsalted and adaptive-vs-static
+        # on a hot-key and a zipf-like key distribution, against a
+        # real 2-process fleet. Ports 19220+ (exchange owns 19200+).
+        _skew_section(detail)
+
+    if (
         args.serving or _section_enabled("BENCH_SERVING", False)
     ) and fits("serving", 240.0):
         # multi-query serving (BENCH_r08): N closed-loop clients
@@ -708,6 +725,102 @@ def _exchange_section(detail) -> None:
         rows_by_mode["SPOOL"][q] == rows_by_mode["DIRECT"][q]
         for q in qids
     )
+
+
+def _skew_section(detail) -> None:
+    import tempfile
+
+    from trino_tpu.testing import chaos as chaos_mod
+    from trino_tpu.testing.chaos import _SKEW_SQL
+    from trino_tpu.testing.golden import assert_rows_match
+
+    # zipf-like geometric head over 5 customers: ~50/25/12.5/6/6 % of
+    # orders (the zipf(1.2) stand-in expressible in pure SQL over the
+    # fixed TPC-H tiny data — heavy head, long-ish tail)
+    zipf_sql = (
+        "SELECT c.c_mktsegment, count(*) AS n, "
+        "sum(o.o_totalprice) AS rev "
+        "FROM (SELECT CASE WHEN o_orderkey % 16 < 8 THEN 1 "
+        "WHEN o_orderkey % 16 < 12 THEN 2 "
+        "WHEN o_orderkey % 16 < 14 THEN 4 "
+        "WHEN o_orderkey % 16 < 15 THEN 5 "
+        "ELSE o_custkey END AS k, o_totalprice FROM orders) o "
+        "JOIN customer c ON o.k = c.c_custkey "
+        "GROUP BY c.c_mktsegment ORDER BY 1"
+    )
+    # sf1, not tiny: salting trades per-task overhead (~20 ms of HTTP
+    # submit+poll per extra salt task) for hot-task compute — on tiny
+    # the hot partition computes in under a millisecond and the trade
+    # can only lose; at sf1 the hot task straggles for ~10 s and the
+    # salted plan halves the wall clock
+    skew_schema = os.environ.get("BENCH_SKEW_SF", "sf1")
+    procs, uris = chaos_mod.spawn_workers(2, base_port=19220)
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench-skew-") as sp:
+
+            def run(sql, label, **props):
+                fleet = chaos_mod.make_fleet(uris, sp, schema=skew_schema)
+                p = fleet.session.properties
+                p["join_distribution_type"] = "PARTITIONED"
+                p.update(props)
+                fleet.execute(sql)  # warmup: compile caches, residency
+                t0 = time.perf_counter()
+                res = fleet.execute(sql)
+                ms = (time.perf_counter() - t0) * 1e3
+                balance = max((
+                    float(
+                        (st.get("input_skew") or {})
+                        .get("max_mean_ratio", 0.0)
+                    )
+                    for st in res.stage_stats
+                    if st.get("rows_in", 0) >= 1000
+                ), default=0.0)
+                slack = 0.0
+                if res.time_breakdown:
+                    slack = float(
+                        res.time_breakdown["buckets"]
+                        .get("straggler_slack", 0.0)
+                    )
+                detail[f"skew_{label}_ms"] = round(ms, 1)
+                detail[f"skew_{label}_input_skew"] = round(balance, 3)
+                detail[f"skew_{label}_straggler_slack_ms"] = round(
+                    slack, 1
+                )
+                return res
+
+            def rows_match(a, b, ordered):
+                try:
+                    assert_rows_match(
+                        a, b, ordered=ordered, abs_tol=1e-6
+                    )
+                    return True
+                except AssertionError:
+                    return False
+
+            for dist, sql in (("hot", _SKEW_SQL), ("zipf", zipf_sql)):
+                base = run(sql, f"{dist}_unsalted")
+                salted = run(
+                    sql, f"{dist}_salted",
+                    skew_salt_threshold=2.0, skew_salt_factor=8,
+                )
+                detail[f"skew_{dist}_salted_edges"] = (
+                    salted.salted_edges
+                )
+                detail[f"skew_{dist}_rows_identical"] = rows_match(
+                    salted.rows, base.rows, salted.ordered
+                )
+            # adaptive-vs-static on the hot-key shape (static numbers
+            # are the hot_unsalted run above)
+            adaptive = run(
+                _SKEW_SQL, "hot_adaptive",
+                adaptive_partition_growth_factor=0.5,
+                adaptive_partition_max=8,
+            )
+            detail["skew_adaptive_repartitions"] = (
+                adaptive.adaptive_repartitions
+            )
+    finally:
+        chaos_mod.stop_workers(procs)
 
 
 def _serving_section(detail) -> None:

@@ -66,6 +66,14 @@ _RATE_KEYS = [
     ("detail.fleet_spool_q05_ms", False),
     ("detail.fleet_spool_q09_ms", False),
     ("detail.exchange_direct_fetch_ratio", True),
+    # skew keys (BENCH_r09+, ``bench.py --skew``): SKIP against
+    # baselines that predate salted repartition / adaptive growth
+    ("detail.skew_hot_unsalted_ms", False),
+    ("detail.skew_hot_salted_ms", False),
+    ("detail.skew_hot_salted_input_skew", False),
+    ("detail.skew_zipf_salted_ms", False),
+    ("detail.skew_zipf_salted_input_skew", False),
+    ("detail.skew_hot_adaptive_ms", False),
 ]
 # NOT banded: the per-query ``detail.{q}_time_breakdown`` dicts
 # (BENCH_r08+, flight recorder) are informational — dict-valued and

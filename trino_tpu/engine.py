@@ -54,6 +54,13 @@ class QueryResult:
     #: one ran under a fresh spool epoch); 0 when the first execution
     #: succeeded or the policy is NONE/TASK
     query_retries: int = 0
+    #: skew mitigation counters (fleet tier): exchange edges the
+    #: coordinator re-planned as SALTED after hot-partition detection
+    #: (skew_salt_threshold), and stages whose output partition count
+    #: was grown at runtime after an input edge blew past its
+    #: cardinality estimate (adaptive_partition_growth_factor)
+    salted_edges: int = 0
+    adaptive_repartitions: int = 0
     #: memory governance (QueryStats peakUserMemoryReservation analog):
     #: the query's peak concurrent reservation, total and per node
     peak_memory_bytes: int = 0

@@ -706,7 +706,29 @@ class MeshExecutor(LocalExecutor):
                 cols.append(Column(c.type, data, valid, c.dictionary, c.hash_pool))
             from trino_tpu import session_properties as SP
 
-            if SP.get(self.session, "exchange_partition_counters"):
+            count_now = bool(
+                SP.get(self.session, "exchange_partition_counters")
+            )
+            if not count_now:
+                # sampled mode: count every Nth all_to_all instead of
+                # every one. The host sync the counters force costs the
+                # whole dispatch pipeline, so exact counting taxes
+                # every exchange; 1/N sampling keeps skew observability
+                # on by default at 1/N of that tax. Tradeoff: absolute
+                # rows under-report by ~N (the metric is a sample, not
+                # a census) but max/mean and cv are preserved in
+                # expectation — hot-partition DETECTION survives
+                # sampling, exact conservation accounting does not.
+                n_sample = int(
+                    SP.get(
+                        self.session, "exchange_partition_counter_sample"
+                    )
+                )
+                if n_sample > 0:
+                    seq = getattr(self, "_exchange_count_seq", 0)
+                    self._exchange_count_seq = seq + 1
+                    count_now = (seq % n_sample) == 0
+            if count_now:
                 # skew observability (forces a host sync, so gated the
                 # same way as the coverage check): per-destination live
                 # row counts for this named edge, folded into

@@ -253,6 +253,36 @@ def _concat_payloads(payloads: list[dict]) -> dict:
     return {"names": first["names"], "types": first["types"], "cols": cols}
 
 
+def salt_filter(payload: dict, salt: int, factor: int) -> dict:
+    """Keep one deterministic 1/``factor`` row slice of a host payload:
+    rows whose index ``% factor == salt``. The SALTED exchange's
+    fan-out half — K salt tasks each apply a different ``salt`` to the
+    SAME hot-partition payload, producing a disjoint exact cover of its
+    rows.
+
+    Determinism across task attempts rests on the payload row order
+    being a pure function of the pinned read: ``read_partition`` (and
+    the worker's byte-identical direct-read mirror) concatenates
+    producer tasks in pinned order with ascending partitions inside
+    each, and each partition file's row order is fixed at producer
+    commit. Key-hash salting would be useless here — every row of ONE
+    hot key shares a hash — so the slice is positional, which balances
+    even a single-key hot partition."""
+    cols = payload.get("cols")
+    if not cols:
+        return payload
+    n = len(cols[0][0])
+    sel = (np.arange(n) % int(factor)) == int(salt)
+    return {
+        "names": payload["names"],
+        "types": payload["types"],
+        "cols": [
+            (v[sel], None if valid is None else valid[sel])
+            for v, valid in cols
+        ],
+    }
+
+
 # ---- file format -----------------------------------------------------------
 
 def encode_partition(payload: dict, sel: np.ndarray) -> tuple[bytes, int]:

@@ -35,7 +35,7 @@ from trino_tpu.plan import nodes as P
 from trino_tpu.metadata import Session
 from trino_tpu.plan import stats as S
 
-__all__ = ["add_exchanges"]
+__all__ = ["add_exchanges", "fragment_saltable"]
 
 #: builds beyond this many rows never broadcast regardless of the cost
 #: model — each shard must hold a full replica in HBM (session
@@ -47,6 +47,60 @@ DEFAULT_BROADCAST_ROW_LIMIT = 2_000_000
 _SELF_COMBINING = {
     "min", "max", "any_value", "arbitrary", "bool_and", "bool_or",
 }
+
+
+def fragment_saltable(root: P.PlanNode) -> tuple[bool, str]:
+    """Whether a stage fragment may legally run SALTED — i.e. with one
+    hot input partition split row-wise across salt tasks (the other
+    aligned inputs replicated to every salt) and the sub-results simply
+    unioned by the downstream exchange.
+
+    A row split of one input distributes over filters, projections,
+    inner joins (the replicated side sees every row), and PARTIAL
+    aggregates (partials merge in the consumer's FINAL step) — exactly
+    the operator set ``add_exchanges`` leaves inside a partitioned-join
+    fragment. It does NOT distribute over outer/semi joins (preserved
+    or marked rows would duplicate across salts), FINAL/SINGLE
+    aggregates, window functions, or order/count-sensitive operators.
+    Returns ``(ok, reason)`` with ``reason`` naming the first blocking
+    operator."""
+    verdict: list = [True, ""]
+    seen: set[int] = set()
+
+    def flag(msg: str) -> None:
+        if verdict[0]:
+            verdict[0], verdict[1] = False, msg
+
+    def walk(n: P.PlanNode) -> None:
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        if isinstance(n, P.Join):
+            if n.kind != "inner":
+                flag(
+                    f"{n.kind} join does not distribute over a row "
+                    f"split of one input"
+                )
+        elif isinstance(n, P.Aggregate):
+            if n.step != "PARTIAL":
+                flag(
+                    f"{n.step} aggregate does not merge across salted "
+                    f"sub-partitions"
+                )
+        elif isinstance(n, (P.Sort, P.TopN)):
+            flag("order-sensitive operator above the salted exchange")
+        elif isinstance(n, P.Limit):
+            flag("count-sensitive Limit above the salted exchange")
+        elif isinstance(n, P.Window):
+            flag("window functions require whole partitions")
+        elif isinstance(n, P.SemiJoin):
+            flag("semi-join marks do not merge across salted "
+                 "sub-partitions")
+        for s in n.sources:
+            walk(s)
+
+    walk(root)
+    return bool(verdict[0]), str(verdict[1])
 
 
 class _Ctx:

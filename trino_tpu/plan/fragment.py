@@ -25,7 +25,7 @@ from dataclasses import replace as dc_replace
 
 from trino_tpu.plan import nodes as P
 
-__all__ = ["StageInput", "Stage", "fragment_plan"]
+__all__ = ["StageInput", "Stage", "fragment_plan", "salt_stage"]
 
 
 @dataclass
@@ -54,6 +54,22 @@ class Stage:
     partitioning: str
     hash_symbols: list[str] = field(default_factory=list)
     inputs: list[StageInput] = field(default_factory=list)
+    #: SALTED exchange mode (coordinator skew mitigation): when set,
+    #: ``{"source": source_id, "factor": K, "hot": [partition, ...]}``
+    #: — each hot input partition is read by K tasks instead of one;
+    #: the named input fans its rows out across the K salts (each task
+    #: keeps a disjoint 1/K row slice) while every OTHER aligned input
+    #: is replicated to all K salt tasks. Hot keys therefore spread
+    #: over K workers with results identical to the unsalted plan
+    #: (the SkewedPartitionRebalancer generalized to the read side of
+    #: a join exchange).
+    salt_plan: dict | None = None
+    #: output partition count override (runtime-adaptive repartitioning,
+    #: RuntimeAdaptivePartitioningRewriter analog): 0 = the fleet
+    #: default; set by the coordinator before admission when an input
+    #: edge blew past its cardinality estimate. Consumers size their
+    #: aligned task lists from their producers' effective value.
+    out_partitions: int = 0
 
     def scans(self) -> list[P.TableScan]:
         out = []
@@ -133,3 +149,36 @@ class _Fragmenter:
         if srcs:
             node = _replace_sources(node, srcs)
         return node
+
+
+def salt_stage(
+    stage: Stage, source_id: str, factor: int, hot: list[int]
+) -> Stage:
+    """Rewrite ``stage`` in place to read ``source_id`` as a salted
+    exchange: each hot partition fans out across ``factor`` salt tasks
+    (the named input split by row slice, all other aligned inputs
+    replicated). The fragment itself is untouched — salting changes
+    only which rows each task reads, so plan wire format, operator
+    shapes, and results are identical to the unsalted stage. Raises
+    ``ValueError`` on a structurally impossible salt plan; semantic
+    eligibility (only mergeable operators above the salted join) is
+    ``plan.distribute.fragment_saltable``'s call, enforced again by
+    ``plan.validate.validate_stages``."""
+    declared = {i.source_id: i for i in stage.inputs}
+    inp = declared.get(source_id)
+    if inp is None or inp.mode != "aligned":
+        raise ValueError(
+            f"stage {stage.stage_id}: salted source {source_id!r} is "
+            f"not an aligned input"
+        )
+    if int(factor) < 2:
+        raise ValueError(f"salt factor must be >= 2, got {factor}")
+    hot_sorted = sorted({int(p) for p in hot})
+    if not hot_sorted or hot_sorted[0] < 0:
+        raise ValueError(f"bad hot partition list {hot!r}")
+    stage.salt_plan = {
+        "source": source_id,
+        "factor": int(factor),
+        "hot": hot_sorted,
+    }
+    return stage

@@ -565,6 +565,100 @@ def validate_stages(stages, phase: str = "fragment_plan"):
                     f"partitions on {list(producer.hash_symbols)}",
                 )
 
+    # SALTED exchange invariants (coordinator skew mitigation): the
+    # salt plan must be structurally sound AND the fragment must
+    # distribute over a row split of the salted input, or a broken
+    # salted re-plan would return wrong rows silently
+    for stage in stages:
+        salt = getattr(stage, "salt_plan", None)
+        if salt is None:
+            continue
+        declared = {i.source_id: i for i in stage.inputs}
+        src = salt.get("source")
+        inp = declared.get(src)
+        if inp is None or inp.mode != "aligned":
+            fail(
+                "salted-exchange",
+                f"stage {stage.stage_id}: salted source {src!r} is "
+                f"not a declared aligned input",
+            )
+            continue
+        factor = salt.get("factor")
+        if not isinstance(factor, int) or factor < 2:
+            fail(
+                "salted-exchange",
+                f"stage {stage.stage_id}: salt count {factor!r} must "
+                f"be an integer >= 2 (consistent across the edge)",
+            )
+        hot = salt.get("hot")
+        if (
+            not isinstance(hot, list) or not hot
+            or any(not isinstance(p, int) or p < 0 for p in hot)
+            or len(set(hot)) != len(hot)
+        ):
+            fail(
+                "salted-exchange",
+                f"stage {stage.stage_id}: bad hot partition list "
+                f"{hot!r}",
+            )
+        # probe-replication closure: every co-aligned input replicates
+        # its hot partitions to all salts, which presumes well-defined
+        # hash partitions on the producer side
+        for other in stage.inputs:
+            if other.source_id == src or other.mode != "aligned":
+                continue
+            prod = by_id.get(other.stage_id)
+            if prod is not None and prod.partitioning != "hash":
+                fail(
+                    "salted-exchange",
+                    f"stage {stage.stage_id}: replicated input "
+                    f"{other.source_id!r} comes from a "
+                    f"{prod.partitioning}-partitioned producer — "
+                    f"probe-replication closure broken",
+                )
+        from trino_tpu.plan.distribute import fragment_saltable
+
+        ok, reason = fragment_saltable(stage.root)
+        if not ok:
+            fail(
+                "salted-exchange",
+                f"stage {stage.stage_id}: fragment is not saltable — "
+                f"{reason}",
+            )
+
+    # runtime-adaptive partition counts: an override only makes sense
+    # on a hash-partitioned stage, and every aligned producer of one
+    # consumer must agree on its effective output fan-out (a consumer
+    # task reads partition p of ALL its aligned inputs)
+    for stage in stages:
+        op = int(getattr(stage, "out_partitions", 0) or 0)
+        if op < 0:
+            fail(
+                "adaptive-repartition",
+                f"stage {stage.stage_id}: negative output partition "
+                f"override {op}",
+            )
+        if op and stage.partitioning != "hash":
+            fail(
+                "adaptive-repartition",
+                f"stage {stage.stage_id}: output partition override "
+                f"{op} on a {stage.partitioning}-partitioned stage",
+            )
+    for stage in stages:
+        eff = {
+            inp.stage_id: int(
+                getattr(by_id[inp.stage_id], "out_partitions", 0) or 0
+            )
+            for inp in stage.inputs
+            if inp.mode == "aligned" and inp.stage_id in by_id
+        }
+        if len(set(eff.values())) > 1:
+            fail(
+                "adaptive-repartition",
+                f"stage {stage.stage_id}: aligned producers disagree "
+                f"on output partition count {eff}",
+            )
+
     # acyclicity + topological order (children before parents)
     seen: set[str] = set()
     for stage in stages:
@@ -608,9 +702,12 @@ def check_edge_coverage(stages, task_stats: list[dict]) -> None:
     consumers observed on that edge must sum to the rows the producer
     stage committed. An aligned (hash) edge is read exactly once
     across the consumer stage's partitions; an "all" (gather/
-    broadcast) edge is read in full by every consumer task. Raises
-    :class:`ExchangeCoverageError` naming the first edge that dropped
-    or duplicated rows."""
+    broadcast) edge is read in full by every consumer task. SALTED
+    edges still conserve live rows: the fan-out edge's per-salt row
+    slices form a disjoint exact cover (sum == produced), while each
+    replicated co-input is priced at produced + (factor-1) x hot
+    partition rows. Raises :class:`ExchangeCoverageError` naming the
+    first edge that dropped or duplicated rows."""
     by_stage_out: dict[str, int] = {}
     finished: dict[str, list[dict]] = {}
     for row in task_stats:
@@ -630,6 +727,7 @@ def check_edge_coverage(stages, task_stats: list[dict]) -> None:
         # row counts (older workers / root reads don't)
         if any("edge_rows" not in r for r in rows):
             continue
+        salt = getattr(stage, "salt_plan", None)
         for inp in stage.inputs:
             produced = by_stage_out.get(inp.stage_id)
             if produced is None:
@@ -647,10 +745,33 @@ def check_edge_coverage(stages, task_stats: list[dict]) -> None:
             )
             if inp.mode == "aligned":
                 got = sum(per_task)
-                if got != produced:
+                expected = produced
+                detail = f"per-partition reads {per_task}"
+                if salt is not None and inp.source_id != salt["source"]:
+                    # replicated-to-salts edge: each hot partition is
+                    # read once per salt task instead of once, so the
+                    # edge conserves produced + (K-1) x hot rows. (The
+                    # fan-out edge conserves exactly: the K salt
+                    # slices of a hot partition form a disjoint cover.)
+                    prows = finished.get(inp.stage_id) or []
+                    if any("partition_rows" not in r for r in prows):
+                        continue  # no producer histogram to price it
+                    hist: dict[str, int] = {}
+                    for r in prows:
+                        for p, v in (r.get("partition_rows") or {}).items():
+                            hist[str(p)] = hist.get(str(p), 0) + int(v or 0)
+                    extra = (int(salt["factor"]) - 1) * sum(
+                        hist.get(str(p), 0) for p in salt["hot"]
+                    )
+                    expected = produced + extra
+                    detail += (
+                        f" (salted x{salt['factor']}, hot partitions "
+                        f"{salt['hot']} replicated: +{extra} rows "
+                        f"expected)"
+                    )
+                if got != expected:
                     raise ExchangeCoverageError(
-                        edge, produced, got,
-                        detail=f"per-partition reads {per_task}",
+                        edge, expected, got, detail=detail,
                     )
             else:
                 for r, got in zip(rows, per_task):

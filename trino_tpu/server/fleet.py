@@ -67,7 +67,7 @@ from trino_tpu.exec.local import QueryCancelled
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.plan import nodes as P
 from trino_tpu.plan import validate
-from trino_tpu.plan.fragment import Stage, fragment_plan
+from trino_tpu.plan.fragment import Stage, fragment_plan, salt_stage
 from trino_tpu.plan.serde import plan_to_json
 from trino_tpu.scheduler import EventDrivenScheduler
 from trino_tpu.sql import ast
@@ -184,6 +184,11 @@ class _TaskSpec:
     #: FINISHED (coordinator-level dynamic filtering: the merged range
     #: becomes a storage domain on held probe-side scan stages)
     report_ranges: list[str] | None = None
+    #: salted sub-task index for a hot input partition (None = plain
+    #: aligned task). A hot partition of a SALTED stage runs
+    #: ``salt_plan["factor"]`` tasks; each reads every 1-in-K row of
+    #: the fanout source and the WHOLE partition of replicate sources
+    salt: int | None = None
 
 
 class FleetRunner:
@@ -601,6 +606,22 @@ class FleetRunner:
                     f"cv {skew['cv']:.2f} "
                     f"(hottest {int(skew['max'])} rows)"
                 )
+            salted = st.get("salted")
+            if salted:
+                noun = (
+                    "partition" if len(salted["hot"]) == 1
+                    else "partitions"
+                )
+                hot = ", ".join(str(p) for p in salted["hot"])
+                lines.append(
+                    f"  exchange input {salted['source']} salted "
+                    f"×{salted['factor']}, hot {noun} {hot}"
+                )
+            if st.get("adaptive_repartitions"):
+                lines.append(
+                    f"  partitions grown {self.n_partitions}"
+                    f"→{st['out_partitions']} (adaptive)"
+                )
             for name, o in sorted(
                 ops_by_stage.get(st["stage_id"], {}).items(),
                 key=lambda kv: kv[1]["self_ms"], reverse=True,
@@ -636,6 +657,8 @@ class FleetRunner:
         out.peak_memory_bytes = res.peak_memory_bytes
         out.peak_memory_per_node = res.peak_memory_per_node
         out.query_retries = res.query_retries
+        out.salted_edges = res.salted_edges
+        out.adaptive_repartitions = res.adaptive_repartitions
         return out
 
     def _execute_stmt(self, stmt, cancel_event=None) -> QueryResult:
@@ -654,6 +677,12 @@ class FleetRunner:
         self.retry_delays = []
         self.failure_log = []
         self.df_scan_log = []
+        # per-statement (not per-attempt): salted/adaptive re-plans
+        # mutate the Stage objects, which are reused across query-level
+        # retries — the logs describe the statement's final plan
+        self._salt_log = []
+        self._adaptive_log = []
+        self._stage_estimates = {}
         seed = sp.get(self.session, "retry_backoff_seed")
         self._retry_rng = random.Random(seed or None)
         # inconsistent memory caps fail the statement before any task
@@ -730,6 +759,15 @@ class FleetRunner:
                     )
                     self._last_plan = plan
                     self._last_stages = stages
+                    if float(sp.get(
+                        self.session,
+                        "adaptive_partition_growth_factor",
+                    )) > 0:
+                        # adaptive growth compares committed rows
+                        # against these per-stage CBO estimates
+                        self._stage_estimates = (
+                            self._estimate_stage_rows(stages)
+                        )
                 return self._execute_attempt(plan, stages, query_retries)
             except Exception as e:
                 if policy != "QUERY" or not _query_tier_retryable(e):
@@ -799,6 +837,15 @@ class FleetRunner:
             res.execution_ms = (time.perf_counter() - t0) * 1e3
             res.task_stats = list(self._task_stats)
             res.stage_stats = self._aggregate_stage_stats(stages)
+            # counted off the (mutated) stage list, not the event logs:
+            # a query-level retry reuses the already-salted/grown plan
+            # without re-detecting, and the counts must still report it
+            res.salted_edges = sum(
+                1 for s in stages if getattr(s, "salt_plan", None)
+            )
+            res.adaptive_repartitions = sum(
+                1 for s in stages if getattr(s, "out_partitions", 0)
+            )
             trace = tracer.finish()
             for spn in trace.root.walk():
                 if spn._open:
@@ -874,12 +921,21 @@ class FleetRunner:
                 "admission_wait_ms": 0.0,
                 "direct_bytes": 0, "spooled_bytes": 0,
                 "partition_rows": {}, "partition_bytes": {},
+                "adaptive_repartitions": 0,
             })
 
+        #: per-stage committed rows_in per task — the post-salt balance
+        #: observable (a salted hot partition's rows spread across its
+        #: K sub-tasks, which the producer-side output histogram cannot
+        #: see because read-side salting never rewrites spool files)
+        rows_in_by_stage: dict[str, list] = {}
         for ts in self._task_stats:
             st = entry(ts["stage_id"])
             if ts.get("state") != "FINISHED":
                 continue
+            rows_in_by_stage.setdefault(ts["stage_id"], []).append(
+                int(ts.get("rows_in", 0) or 0)
+            )
             st["tasks"] += 1
             st["rows_in"] += int(ts.get("rows_in", 0) or 0)
             st["rows_out"] += int(ts.get("rows_out", 0) or 0)
@@ -909,10 +965,23 @@ class FleetRunner:
                     )
         for sid, n in self._retries_by_stage.items():
             entry(sid)["retries"] = n
-        for st in by_stage.values():
+        for s in stages:
+            st = by_stage.get(s.stage_id)
+            if st is None:
+                continue
+            if getattr(s, "salt_plan", None):
+                st["salted"] = dict(s.salt_plan)
+            if getattr(s, "out_partitions", 0):
+                st["out_partitions"] = int(s.out_partitions)
+                st["adaptive_repartitions"] = 1
+        for sid, st in by_stage.items():
             st["partition_skew"] = telemetry_analysis.partition_skew(
                 st["partition_rows"]
             )
+            st["input_skew"] = telemetry_analysis.partition_skew({
+                str(i): v
+                for i, v in enumerate(rows_in_by_stage.get(sid) or [])
+            })
             # fraction of exchange input bytes a stage's tasks pulled
             # straight from producer memory (vs. the durable spool)
             tot = st["direct_bytes"] + st["spooled_bytes"]
@@ -1012,9 +1081,211 @@ class FleetRunner:
             f"task {task_id} corruption recovery failed: {last_err}"
         )
 
+    # ---- runtime re-planning: salted repartition + adaptive growth -------
+
+    def _stage_partition_hist(self, sid: str) -> dict:
+        """Fold a stage's committed per-partition output histogram
+        from FINISHED task stats (deliverable (a) of the ROADMAP skew
+        item feeds (b): the same counters stage_stats renders)."""
+        hist: dict[str, int] = {}
+        for ts in self._task_stats:
+            if ts.get("stage_id") != sid or ts.get("state") != "FINISHED":
+                continue
+            for p, v in (ts.get("partition_rows") or {}).items():
+                hist[str(p)] = hist.get(str(p), 0) + int(v or 0)
+        return hist
+
+    def _stage_actual_rows(self, sid: str) -> int:
+        return sum(
+            int(ts.get("rows_out", 0) or 0)
+            for ts in self._task_stats
+            if ts.get("stage_id") == sid and ts.get("state") == "FINISHED"
+        )
+
+    def _maybe_salt_stage(
+        self, stage: Stage, stages: list[Stage], by_id: dict,
+        threshold: float, factor: int,
+    ) -> None:
+        """Hot-key mitigation at admission (ROADMAP skew item (b), the
+        reference's skewed-join salting under FTE): if one aligned
+        input's committed histogram shows max/mean above the threshold,
+        re-plan this edge SALTED — the hot partitions fan out across
+        ``factor`` sub-tasks slicing the skewed source row-wise, while
+        the other aligned inputs replicate to every salt. Results stay
+        byte-identical: the fragment must pass fragment_saltable (row
+        splits distribute over it) and the mutated stage list re-runs
+        plan validation before any task exists."""
+        if getattr(stage, "salt_plan", None) is not None or factor < 2:
+            return
+        aligned = [i for i in stage.inputs if i.mode == "aligned"]
+        if not aligned:
+            return
+        # replicate closure needs hash-aligned co-inputs; a gather or
+        # single-partition producer cannot be sliced per-partition
+        if any(
+            by_id[i.stage_id].partitioning != "hash" for i in aligned
+        ):
+            return
+        from trino_tpu.plan.distribute import fragment_saltable
+
+        ok, _reason = fragment_saltable(stage.root)
+        if not ok:
+            return
+        best = None  # (ratio, input, hist, mean)
+        for i in aligned:
+            hist = self._stage_partition_hist(i.stage_id)
+            # pad to the producer's full fabric: partitions that got
+            # ZERO rows never appear in committed histograms, and
+            # dropping them inflates the mean — an edge where every row
+            # hashes into one of four partitions is maximally skewed,
+            # not ratio-1.0
+            n_fab = int(
+                getattr(by_id[i.stage_id], "out_partitions", 0) or 0
+            ) or self.n_partitions
+            for p in range(n_fab):
+                hist.setdefault(str(p), 0)
+            skew = telemetry_analysis.partition_skew(hist)
+            if (
+                skew["partitions"] > 1
+                and skew["max_mean_ratio"] > threshold
+                and (best is None or skew["max_mean_ratio"] > best[0])
+            ):
+                best = (skew["max_mean_ratio"], i, hist, skew["mean"])
+        if best is None:
+            return
+        ratio, inp, hist, mean = best
+        hot = sorted(
+            int(p) for p, v in hist.items()
+            if mean > 0 and v > threshold * mean
+        )
+        if not hot:
+            return
+        salt_stage(stage, inp.source_id, factor, hot)
+        self._salt_log.append({
+            "stage_id": stage.stage_id,
+            "source": inp.source_id,
+            "factor": int(factor),
+            "hot": hot,
+            "max_mean_ratio": round(float(ratio), 4),
+        })
+        if validate.level(self.session) != "OFF":
+            validate.validate_stages(stages, phase="salted_replan")
+
+    def _maybe_grow_partitions(
+        self, stage: Stage, stages: list[Stage], by_id: dict,
+        started: set, factor: float, cap: int,
+    ) -> None:
+        """Runtime-adaptive partition count (ROADMAP skew item (c),
+        the reference's faulttolerant runtime-adaptive partitioning):
+        when an input edge's committed rows blow past the CBO estimate
+        by ``factor``, this un-admitted hash stage grows its OUTPUT
+        fan-out — the next exchange fabric — so its consumers run more,
+        smaller tasks. Producers that already ran keep their pinned
+        fan-out; sibling producers feeding a shared consumer grow as a
+        group (a consumer's aligned inputs must agree on partition
+        count) or not at all."""
+        if getattr(stage, "out_partitions", 0) or cap <= self.n_partitions:
+            return
+        if stage.partitioning != "hash":
+            return
+        est = getattr(self, "_stage_estimates", None) or {}
+        blowup = 0.0
+        for i in stage.inputs:
+            e = float(est.get(i.stage_id, 0.0) or 0.0)
+            if e <= 0:
+                continue
+            blowup = max(blowup, self._stage_actual_rows(i.stage_id) / e)
+        if blowup <= factor:
+            return
+        import math
+
+        # double at the trigger point, proportional beyond, power-of-2
+        # steps (partition counts stay friendly to the hash fold)
+        mult = 2 ** max(1, math.ceil(math.log2(blowup / factor)))
+        grown = min(int(cap), self.n_partitions * int(mult))
+        if grown <= self.n_partitions:
+            return
+        # sibling closure: every aligned producer sharing a consumer
+        # with this stage must adopt the same fan-out — abort if any is
+        # already started (its tasks were posted with the old count)
+        group = {stage.stage_id}
+        while True:
+            grew = False
+            for s in stages:
+                for i in s.inputs:
+                    if i.mode != "aligned" or i.stage_id not in group:
+                        continue
+                    for j in s.inputs:
+                        if (
+                            j.mode == "aligned"
+                            and j.stage_id not in group
+                        ):
+                            group.add(j.stage_id)
+                            grew = True
+            if not grew:
+                break
+        for sid in group:
+            if sid != stage.stage_id and (
+                sid in started
+                or by_id[sid].partitioning != "hash"
+                or getattr(by_id[sid], "out_partitions", 0)
+            ):
+                return
+        for sid in sorted(group):
+            by_id[sid].out_partitions = grown
+            telemetry.ADAPTIVE_REPARTITIONS.inc()
+            self._adaptive_log.append({
+                "stage_id": sid,
+                "from": self.n_partitions,
+                "to": grown,
+                "blowup": round(float(blowup), 2),
+            })
+        if validate.level(self.session) != "OFF":
+            validate.validate_stages(stages, phase="adaptive_replan")
+
+    def _estimate_stage_rows(self, stages: list[Stage]) -> dict:
+        """Per-stage CBO output-row estimates, children before parents.
+
+        Each fragment's RemoteSource leaves are seeded into the stats
+        cache with the producer stage's own estimate (identity-keyed
+        entries, plan.stats.estimate consults them before descending),
+        so an intermediate stage's estimate composes exactly the way
+        the monolithic planner's would."""
+        from trino_tpu.plan import stats as plan_stats
+
+        by_source = {
+            i.source_id: i.stage_id
+            for s in stages for i in s.inputs
+        }
+        est: dict[str, float] = {}
+        for s in stages:
+            cache: dict = {}
+            seen: set[int] = set()
+
+            def seed(n: P.PlanNode) -> None:
+                if id(n) in seen:
+                    return
+                seen.add(id(n))
+                if isinstance(n, P.RemoteSource):
+                    rows = est.get(by_source.get(n.source_id, ""), 0.0)
+                    cache[id(n)] = (n, plan_stats.PlanStats(float(rows)))
+                for src in n.sources:
+                    seed(src)
+
+            seed(s.root)
+            try:
+                est[s.stage_id] = float(
+                    plan_stats.estimate(s.root, self.metadata, cache).rows
+                )
+            except Exception:
+                est[s.stage_id] = 0.0
+        return est
+
     # ---- task construction -----------------------------------------------
 
-    def _make_tasks(self, stage: Stage) -> list[_TaskSpec]:
+    def _make_tasks(
+        self, stage: Stage, by_id: dict | None = None
+    ) -> list[_TaskSpec]:
         sid = stage.stage_id
         # serving mode: workers key live tasks by "task_id.attempt", so
         # concurrent queries sharing a fleet need query-unique task ids
@@ -1027,13 +1298,48 @@ class FleetRunner:
         )
         if stage.aligned:
             wire = plan_to_json(stage.root)
-            return [
-                _TaskSpec(
-                    f"{pfx}s{sid}p{p}", wire, p,
-                    fail_first=f"{sid}:{p}" in self.inject_failures,
-                )
-                for p in range(self.n_partitions)
-            ]
+            # an aligned stage runs one task per INPUT partition — the
+            # producers' effective fan-out, which adaptive growth may
+            # have raised above the fleet default
+            n_in = self.n_partitions
+            if by_id is not None:
+                for i in stage.inputs:
+                    if i.mode != "aligned" or i.stage_id not in by_id:
+                        continue
+                    op = int(
+                        getattr(by_id[i.stage_id], "out_partitions", 0)
+                        or 0
+                    )
+                    if op:
+                        n_in = op
+                        break
+            salt = getattr(stage, "salt_plan", None)
+            hot = set(salt["hot"]) if salt else set()
+            factor = int(salt["factor"]) if salt else 1
+            specs = []
+            for p in range(n_in):
+                if p in hot:
+                    # hot partition: K salted sub-tasks, each reading a
+                    # 1-in-K row slice of the fanout source (chaos key
+                    # "sid:p.s" targets one salted sub-task)
+                    specs.extend(
+                        _TaskSpec(
+                            f"{pfx}s{sid}p{p}x{s}", wire, p,
+                            fail_first=(
+                                f"{sid}:{p}.{s}" in self.inject_failures
+                            ),
+                            salt=s,
+                        )
+                        for s in range(factor)
+                    )
+                else:
+                    specs.append(
+                        _TaskSpec(
+                            f"{pfx}s{sid}p{p}", wire, p,
+                            fail_first=f"{sid}:{p}" in self.inject_failures,
+                        )
+                    )
+            return specs
         scans = stage.scans()
         if len(scans) == 1 and scans[0].split is None:
             scan = scans[0]
@@ -1271,6 +1577,22 @@ class FleetRunner:
         sched = EventDrivenScheduler(stages, mode=mode)
         self._scheduler = sched
 
+        # skew-proof exchanges (ROADMAP skew item (b)/(c)): both
+        # rewrites decide off COMPLETE producer statistics — the
+        # per-partition histograms of (a) for salting, committed
+        # rows_out vs the CBO estimate for adaptive growth — so a
+        # non-zero threshold holds every aligned consumer until its
+        # producers finish (the stage-materialization barrier the
+        # reference's faulttolerant AdaptivePlanner replans behind).
+        # Both default OFF, leaving pipelined admission untouched.
+        salt_thresh = float(sp.get(self.session, "skew_salt_threshold"))
+        salt_factor = int(sp.get(self.session, "skew_salt_factor"))
+        adapt_factor = float(
+            sp.get(self.session, "adaptive_partition_growth_factor")
+        )
+        adapt_max = int(sp.get(self.session, "adaptive_partition_max"))
+        skew_hold = salt_thresh > 0 or adapt_factor > 0
+
         # serving mode: register with the shared dispatcher — slot
         # grants arrive fair-share across resource groups, and ALL
         # status polling happens on its O(workers) reactor threads.
@@ -1323,6 +1645,16 @@ class FleetRunner:
             # build stages so admission sees the merged key ranges.
             holds = df_hold.get(stage.stage_id)
             if holds and not all(b in complete for b in holds):
+                return False
+            # skew hold: salting and adaptive growth re-plan a stage AT
+            # admission from its producers' final output statistics, so
+            # aligned consumers wait for complete inputs even under
+            # PIPELINED (scan/leaf stages are unaffected)
+            if (
+                skew_hold
+                and any(i.mode == "aligned" for i in stage.inputs)
+                and not ready(stage)
+            ):
                 return False
             return pipelined or ready(stage)
 
@@ -1537,7 +1869,21 @@ class FleetRunner:
                 targets = df_inject.pop(stage.stage_id, None)
                 if targets:
                     self._apply_scan_df(stage, targets, col_ranges)
-                specs = self._make_tasks(stage)
+                if skew_hold and stage.inputs:
+                    # producers are complete (skew hold): fold their
+                    # observed stats and re-plan this edge before any
+                    # task is constructed
+                    if salt_thresh > 0:
+                        self._maybe_salt_stage(
+                            stage, stages, by_id, salt_thresh,
+                            salt_factor,
+                        )
+                    if adapt_factor > 0:
+                        self._maybe_grow_partitions(
+                            stage, stages, by_id, started, adapt_factor,
+                            adapt_max,
+                        )
+                specs = self._make_tasks(stage, by_id)
                 rep = df_report.get(stage.stage_id)
                 if rep:
                     for spec in specs:
@@ -2072,6 +2418,30 @@ class FleetRunner:
                         and "workers" in pins[i.stage_id]
                         else {}
                     ),
+                    # salted sub-task: the fanout source ships the salt
+                    # index + factor (the worker keeps every 1-in-K
+                    # row); replicate co-inputs are tagged so telemetry
+                    # attributes their re-read rows
+                    **(
+                        {
+                            "salt": spec.salt,
+                            "salt_factor": int(
+                                stage.salt_plan["factor"]
+                            ),
+                        }
+                        if stage.salt_plan is not None
+                        and spec.salt is not None
+                        and i.source_id == stage.salt_plan["source"]
+                        else {}
+                    ),
+                    **(
+                        {"salt_role": "replicate"}
+                        if stage.salt_plan is not None
+                        and spec.salt is not None
+                        and i.mode == "aligned"
+                        and i.source_id != stage.salt_plan["source"]
+                        else {}
+                    ),
                 }
                 for i in stage.inputs
             ],
@@ -2079,7 +2449,13 @@ class FleetRunner:
                 "stage_id": stage.stage_id,
                 "partitioning": stage.partitioning,
                 "hash_symbols": stage.hash_symbols,
-                "n_partitions": self.n_partitions,
+                # adaptive growth raises a hash stage's fan-out above
+                # the fleet default; consumers size their task lists
+                # from the same field
+                "n_partitions": int(
+                    getattr(stage, "out_partitions", 0)
+                    or self.n_partitions
+                ),
             },
             "spool": qroot,
             "session": dict(self.session.properties),

@@ -787,9 +787,29 @@ class WorkerServer:
                                     on_bytes=nb.append,
                                 )
                                 spooled_bytes += sum(nb)
+                            # SALTED exchange, fan-out half: this salt
+                            # task keeps its disjoint 1/K row slice of
+                            # the hot partition (applied after the
+                            # direct/spool read so both paths stay
+                            # byte-identical); replicate sources read
+                            # the partition whole on every salt task
+                            sfac = int(src.get("salt_factor") or 0)
+                            salted = sfac > 1 and src.get("salt") is not None
+                            if salted:
+                                payload = spool.salt_filter(
+                                    payload, int(src["salt"]), sfac
+                                )
                             src_rows = 0
                             if payload.get("cols"):
                                 src_rows = len(payload["cols"][0][0])
+                            if salted:
+                                telemetry.EXCHANGE_SALTED_ROWS.inc(
+                                    src_rows, role="fanout"
+                                )
+                            elif src.get("salt_role") == "replicate":
+                                telemetry.EXCHANGE_SALTED_ROWS.inc(
+                                    src_rows, role="replicate"
+                                )
                             rows_in += src_rows
                             # per-edge accounting for the coordinator's
                             # exchange-coverage debug assertion

@@ -234,6 +234,60 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "and cheaply)",
             "boolean", False, hidden=True,
         ),
+        _P(
+            "exchange_partition_counter_sample",
+            "Sampled counting mode for the mesh-tier per-destination "
+            "exchange histograms: count every Nth all_to_all "
+            "invocation instead of every one, amortizing the host "
+            "sync the counters force to 1/N of exchanges so skew "
+            "observability can stay on by default. Sampled counts "
+            "preserve the max/mean ratio in expectation but "
+            "under-report absolute rows by ~N; set "
+            "exchange_partition_counters=true for exact per-exchange "
+            "counts (full sync tax), or 0 to disable sampling",
+            "bigint", 16,
+            _non_negative("exchange_partition_counter_sample"),
+        ),
+        _P(
+            "skew_salt_threshold",
+            "Salted-repartition trigger: when a completed exchange "
+            "edge's per-partition row histogram shows max/mean above "
+            "this ratio, the fleet re-plans the consumer stage as a "
+            "SALTED exchange — hot partitions fan out across "
+            "skew_salt_factor salt tasks, co-aligned inputs replicate "
+            "to every salt (SkewedPartitionRebalancer generalized to "
+            "joins). 0 disables. Detection needs producer histograms, "
+            "so enabling this holds aligned consumer stages until "
+            "their producers complete (stage-materialization "
+            "semantics, as under stage_admission=BARRIER)",
+            "double", 0.0, _non_negative("skew_salt_threshold"),
+        ),
+        _P(
+            "skew_salt_factor",
+            "Salt tasks per hot partition under salted repartitioning "
+            "(the fan-out of skew_salt_threshold)",
+            "bigint", 4, _positive("skew_salt_factor"),
+        ),
+        _P(
+            "adaptive_partition_growth_factor",
+            "Runtime-adaptive partition count "
+            "(RuntimeAdaptivePartitioningRewriter analog): when a "
+            "completed stage's observed output rows exceed its CBO "
+            "estimate by this factor, un-admitted consumer stages "
+            "grow their own output partition count before task "
+            "construction (recorded as stage_stats[*]."
+            "adaptive_repartitions). 0 disables. Like "
+            "skew_salt_threshold, holds aligned consumers until "
+            "producers complete",
+            "double", 0.0,
+            _non_negative("adaptive_partition_growth_factor"),
+        ),
+        _P(
+            "adaptive_partition_max",
+            "Upper bound on an adaptively grown stage output "
+            "partition count",
+            "bigint", 32, _positive("adaptive_partition_max"),
+        ),
         # ---- local execution (exec.local) -----------------------------
         _P(
             "cross_join_chunk_rows",

@@ -607,12 +607,21 @@ SCAN_BATCHES = REGISTRY.counter(
 EXCHANGE_PARTITION_ROWS = REGISTRY.counter(
     "trino_exchange_partition_rows",
     "Rows routed to each output partition across exchange edges "
-    "(spool boundary always; mesh all_to_all when the "
-    "exchange_partition_counters debug sync is on)")
+    "(spool boundary always; mesh all_to_all exactly when the "
+    "exchange_partition_counters debug sync is on, or every Nth "
+    "exchange under exchange_partition_counter_sample)")
 EXCHANGE_PARTITION_BYTES = REGISTRY.counter(
     "trino_exchange_partition_bytes",
     "Encoded bytes routed to each output partition at the spool "
     "exchange boundary")
+EXCHANGE_SALTED_ROWS = REGISTRY.counter(
+    "trino_exchange_salted_rows_total",
+    "Rows read through SALTED exchange edges (hot partitions fanned "
+    "out across salt tasks), labelled fanout vs replicate")
+ADAPTIVE_REPARTITIONS = REGISTRY.counter(
+    "trino_adaptive_repartitions_total",
+    "Stages whose output partition count was grown at runtime after "
+    "an input edge blew past its cardinality estimate")
 DIAG_BUNDLES = REGISTRY.counter(
     "trino_diag_bundles_total",
     "Post-mortem diagnostic bundles assembled, by trigger error class")

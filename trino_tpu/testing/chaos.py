@@ -47,7 +47,7 @@ from trino_tpu.testing.golden import (
 __all__ = [
     "CHAOS_BASE_PORT", "spawn_workers", "stop_workers",
     "make_fleet", "make_serving", "run_chaos_soak", "fired_sites",
-    "run_storage_chaos",
+    "run_storage_chaos", "run_skew_chaos",
 ]
 
 CHAOS_BASE_PORT = 18960
@@ -118,11 +118,13 @@ def stop_workers(procs) -> None:
             p.kill()
 
 
-def make_fleet(worker_uris, spool_root: str, **kwargs) -> FleetRunner:
+def make_fleet(
+    worker_uris, spool_root: str, schema: str = "tiny", **kwargs
+) -> FleetRunner:
     md = Metadata()
     md.register_catalog("tpch", TpchConnector())
     return FleetRunner(
-        list(worker_uris), md, Session(catalog="tpch", schema="tiny"),
+        list(worker_uris), md, Session(catalog="tpch", schema=schema),
         spool_root=spool_root, n_partitions=4, **kwargs
     )
 
@@ -378,6 +380,134 @@ def run_storage_chaos(seed: int = 0, root: str | None = None) -> dict:
         "seed": seed, "scenario": "scan-read", "fired": fired,
         "batches": int(entry["batches"]),
     }
+
+
+#: zipfian join: ~90% of synthetic order keys collapse onto customer 1
+#: (the PR 13 flight-recorder shape) — the probe edge's hash histogram
+#: shows one hot partition, which is exactly what salting re-plans
+_SKEW_SQL = (
+    "SELECT c.c_mktsegment, count(*) AS n, sum(o.o_totalprice) AS rev "
+    "FROM (SELECT CASE WHEN o_orderkey % 10 < 9 THEN 1 ELSE o_custkey "
+    "END AS k, o_totalprice FROM orders) o "
+    "JOIN customer c ON o.k = c.c_custkey "
+    "GROUP BY c.c_mktsegment ORDER BY 1"
+)
+
+
+def run_skew_chaos(
+    worker_uris, spool_root: str, seed: int = 0, oracle=None,
+) -> dict:
+    """Skew-robustness chaos (ROADMAP skew item (b)/(c) under faults):
+    the salted and adaptive re-plans must survive the same fault model
+    as every other exchange shape.
+
+    Scenario ``salted-kill``: a clean pre-run of the zipfian join
+    learns the salted plan (planning AND detection are deterministic —
+    same data, same histograms, same hot set), then the chaos run
+    kills one hot partition's salted sub-task on its first attempt.
+    Retry + first-commit-wins must reproduce the oracle rows with the
+    SAME task set: salt assignment is a pure function of the plan, so
+    the retried attempt re-reads the identical 1-in-K row slice.
+
+    Scenario ``adaptive-race``: adaptive growth re-fragments the
+    downstream exchange fabric while ``task-exec`` chaos is retrying
+    every attempt-0 task — the re-planned partition count must hold
+    across retries (attempt pins keep consumers on committed outputs).
+
+    Both run plan_validation=FULL so every runtime re-fragmentation
+    re-passes the structural invariants."""
+    if oracle is None:
+        data = (
+            QueryRunner.tpch("tiny").metadata.connector("tpch")
+            .data("tiny")
+        )
+        oracle = load_tpch_sqlite(data)
+    expected = oracle.execute(to_sqlite(_SKEW_SQL)).fetchall()
+    record: dict = {"seed": seed, "runs": []}
+
+    def skew_fleet(**props):
+        fleet = make_fleet(worker_uris, spool_root)
+        p = fleet.session.properties
+        p["join_distribution_type"] = "PARTITIONED"
+        p["plan_validation"] = "FULL"
+        p["speculation_enabled"] = False
+        p["retry_backoff_seed"] = seed
+        p["retry_initial_delay_ms"] = 5
+        p["retry_max_delay_ms"] = 20
+        p.update(props)
+        return fleet
+
+    # clean pre-run: learn the (deterministic) salted plan and the
+    # reference task set, with conservation checked across the salted
+    # edge (fanout reads sum exactly; replicate reads price in the
+    # (K-1)x re-read of hot partitions)
+    fleet = skew_fleet(
+        skew_salt_threshold=2.0, skew_salt_factor=4,
+        check_exchange_coverage=True,
+    )
+    clean = fleet.execute(_SKEW_SQL)
+    assert clean.salted_edges >= 1, "zipfian join did not salt"
+    assert_rows_match(
+        clean.rows, expected, ordered=clean.ordered, abs_tol=1e-6
+    )
+    salted = [
+        s for s in fleet._last_stages
+        if getattr(s, "salt_plan", None) is not None
+    ]
+    sid = salted[0].stage_id
+    hot = salted[0].salt_plan["hot"][0]
+    factor = salted[0].salt_plan["factor"]
+    clean_tasks = sorted(
+        ts["task_id"] for ts in clean.task_stats
+        if ts["stage_id"] == sid and ts.get("state") == "FINISHED"
+    )
+    assert f"s{sid}p{hot}x{factor - 1}" in clean_tasks, clean_tasks
+
+    # scenario 1: first attempt of one hot sub-task dies mid-stage
+    fleet = skew_fleet(skew_salt_threshold=2.0, skew_salt_factor=4)
+    fleet.inject_failures = {f"{sid}:{hot}.1"}
+    res = fleet.execute(_SKEW_SQL)
+    assert res.salted_edges >= 1
+    assert res.tasks_retried >= 1, "salted kill never fired"
+    assert_rows_match(
+        res.rows, expected, ordered=res.ordered, abs_tol=1e-6
+    )
+    killed_tasks = sorted(
+        ts["task_id"] for ts in res.task_stats
+        if ts["stage_id"] == sid and ts.get("state") == "FINISHED"
+    )
+    assert killed_tasks == clean_tasks, (
+        "salt assignment drifted across the retry:\n"
+        f"  clean: {clean_tasks}\n  chaos: {killed_tasks}"
+    )
+    record["runs"].append({
+        "scenario": "salted-kill", "stage": sid, "hot": int(hot),
+        "factor": int(factor), "tasks_retried": res.tasks_retried,
+        "salted_edges": res.salted_edges,
+    })
+
+    # scenario 2: adaptive re-fragmentation racing task retries
+    fleet = skew_fleet(
+        adaptive_partition_growth_factor=0.5, adaptive_partition_max=8,
+    )
+    inj = fault.FaultInjector(seed=seed, max_attempts=fleet.max_attempts)
+    inj.arm("task-exec", times=1)
+    fault.activate(inj)
+    try:
+        res = fleet.execute(_SKEW_SQL)
+    finally:
+        fault.deactivate()
+    assert res.adaptive_repartitions >= 1, "growth never triggered"
+    assert res.tasks_retried >= 1, "task-exec chaos never fired"
+    assert_rows_match(
+        res.rows, expected, ordered=res.ordered, abs_tol=1e-6
+    )
+    record["runs"].append({
+        "scenario": "adaptive-race",
+        "adaptive_repartitions": res.adaptive_repartitions,
+        "tasks_retried": res.tasks_retried,
+    })
+    return record
 
 
 def fired_sites(record: dict) -> set[str]:
