@@ -387,6 +387,35 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
     out["value"] = round(n_rows / ours["q01"], 1)
     out["vs_baseline"] = round(vs, 3)
 
+    if fits("kernel_catalog", 60.0):
+        # kernel observatory: the per-bucket compiled-program summaries
+        # (XLA cost model + HBM footprint) the core loop populated,
+        # plus each query's hot-op top-3 from a device-profile capture
+        # over one warm re-run — the trajectory records WHY numbers
+        # move, not just that they did
+        from trino_tpu import kernel_profile, program_catalog
+
+        detail["kernel_catalog"] = [
+            {
+                k: e[k]
+                for k in (
+                    "program_id", "label", "source", "hits",
+                    "compile_s", "flops", "bytes_accessed",
+                    "temp_bytes", "output_bytes",
+                )
+            }
+            for e in program_catalog.CATALOG.snapshot()
+        ]
+        for q in QUERY_IDS:
+            with kernel_profile.Capture(trigger="bench") as cap:
+                runner.execute(QUERIES[q])
+            s = cap.summary()
+            if s and s.get("scopes"):
+                detail[f"{q}_hot_ops"] = [
+                    {"scope": scope, "device_us": round(us, 1)}
+                    for scope, us in list(s["scopes"].items())[:3]
+                ]
+
     if fits("warm_process_probe", 120.0):
         # cross-process warmth: replay the core queries in a FRESH
         # process against the persistent XLA cache this run just

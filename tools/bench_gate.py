@@ -46,6 +46,9 @@ _DEFAULT_BASELINE = os.path.join(
 _RATE_KEYS = [
     ("value", True),
     ("vs_baseline", True),
+    # single-chip floor vs the hand-vectorized numpy baseline
+    # (BENCH_r02+ emit it; SKIPs against baselines that predate it)
+    ("detail.vs_numpy_geomean", True),
     ("detail.q01_ms", False),
     ("detail.q03_ms", False),
     ("detail.q18_ms", False),

@@ -81,6 +81,27 @@ _CLUSTER_METRICS_SCHEMA = TableSchema("cluster_metrics", [
 ])
 
 
+#: compiled-program catalog (kernel observatory): one row per XLA
+#: program the process compiled or deserialized — XLA's cost model
+#: (FLOPs / bytes) and HBM footprint (memory_analysis) per canonical
+#: bucket, queryable with plain SQL
+_PROGRAMS_SCHEMA = TableSchema("programs", [
+    ("program_id", T.VARCHAR),
+    ("source", T.VARCHAR),
+    ("operators", T.VARCHAR),
+    ("hits", T.BIGINT),
+    ("compile_ms", T.DOUBLE),
+    ("flops", T.DOUBLE),
+    ("bytes_accessed", T.DOUBLE),
+    ("argument_bytes", T.BIGINT),
+    ("output_bytes", T.BIGINT),
+    ("temp_bytes", T.BIGINT),
+    ("generated_code_bytes", T.BIGINT),
+    ("hlo_hash", T.VARCHAR),
+    ("hlo_scopes", T.BIGINT),
+])
+
+
 class SystemConnector(Connector):
     """Read-only views over live engine state. ``source`` is the
     owning Coordinator (queries) and/or runner (nodes); either may be
@@ -99,7 +120,7 @@ class SystemConnector(Connector):
         if schema == "runtime":
             return [
                 "queries", "nodes", "memory", "tasks",
-                "cluster_metrics",
+                "cluster_metrics", "programs",
             ]
         return []
 
@@ -116,6 +137,8 @@ class SystemConnector(Connector):
             return _TASKS_SCHEMA
         if table == "cluster_metrics":
             return _CLUSTER_METRICS_SCHEMA
+        if table == "programs":
+            return _PROGRAMS_SCHEMA
         raise KeyError(f"{schema}.{table}")
 
     def _query_rows(self):
@@ -246,6 +269,28 @@ class SystemConnector(Connector):
             for ts, node, metric, value in rec.rows()
         ]
 
+    def _program_rows(self):
+        from trino_tpu import program_catalog
+
+        out = []
+        for e in program_catalog.CATALOG.snapshot(resolve=True):
+            out.append((
+                e["program_id"],
+                e["source"],
+                e["label"],
+                int(e["hits"]),
+                float(e["compile_s"]) * 1e3,
+                float(e["flops"] or 0.0),
+                float(e["bytes_accessed"] or 0.0),
+                int(e["argument_bytes"] or 0),
+                int(e["output_bytes"] or 0),
+                int(e["temp_bytes"] or 0),
+                int(e["generated_code_bytes"] or 0),
+                e["hlo_hash"] or "",
+                int(e["scope_count"]),
+            ))
+        return out
+
     def _rows(self, table: str):
         if table == "queries":
             return self._query_rows()
@@ -255,6 +300,8 @@ class SystemConnector(Connector):
             return self._task_rows()
         if table == "cluster_metrics":
             return self._cluster_metric_rows()
+        if table == "programs":
+            return self._program_rows()
         return self._node_rows()
 
     def row_count(self, schema: str, table: str) -> int:

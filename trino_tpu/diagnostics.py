@@ -102,10 +102,25 @@ def build_bundle(
         "fault_injections": list(fault_records or ()),
         "metric_deltas": _metric_deltas(metrics_before, metrics_after),
         "time_breakdown": time_breakdown,
+        "programs": _program_snapshot(),
     }
     if extra:
         bundle.update(extra)
     return bundle
+
+
+def _program_snapshot() -> Optional[list]:
+    """Compiled-program catalog at failure time (kernel observatory):
+    which XLA programs the process was serving, their cost/HBM
+    analysis and hit counts — a post-mortem often starts with 'what
+    was the device running'. resolve=False: a bundle assembled on the
+    failure path must not trigger lazy AOT lowering."""
+    try:
+        from trino_tpu import program_catalog
+
+        return program_catalog.CATALOG.snapshot(resolve=False)
+    except Exception:
+        return None
 
 
 def write_bundle(bundle: Dict[str, Any],

@@ -83,6 +83,10 @@ class QueryResult:
     #: critical path — telemetry_analysis.compute_time_breakdown over
     #: the finished trace; None when tracing was not active
     time_breakdown: dict | None = field(default=None, repr=False)
+    #: per-HLO-scope device-time attribution from a kernel_profile
+    #: capture (kernel_profile.attribute summary); None unless the
+    #: session property was ON/AUTO and the capture succeeded
+    kernel_profile: dict | None = field(default=None, repr=False)
 
     @property
     def query_info(self) -> dict | None:
@@ -295,11 +299,27 @@ class QueryRunner:
             )
             prev_prof = self.executor.profiler
             self.executor.profiler = prof = OperatorProfiler()
+            kp_mode = str(
+                session_properties.get(self.session, "kernel_profile")
+                or "OFF"
+            ).upper()
             t0 = time.perf_counter()
             error = None
             result = None
             try:
-                result = self._execute(sql)
+                if kp_mode in ("ON", "AUTO"):
+                    # device-profile the statement; attribution lands
+                    # on QueryResult.kernel_profile (and, for AUTO, on
+                    # the slow-query record when the threshold fires)
+                    from trino_tpu import kernel_profile
+
+                    with kernel_profile.Capture(
+                        trigger="session" if kp_mode == "ON" else "auto"
+                    ) as kp_cap:
+                        result = self._execute(sql)
+                    result.kernel_profile = kp_cap.summary()
+                else:
+                    result = self._execute(sql)
                 result.peak_memory_bytes = qctx.peak_bytes
                 if qctx.peak_bytes:
                     result.peak_memory_per_node = {
@@ -445,6 +465,9 @@ class QueryRunner:
                     elapsed_ms, op_stats, state=state,
                     time_breakdown=(
                         result.time_breakdown if result else None
+                    ),
+                    kernel_profile=(
+                        result.kernel_profile if result else None
                     ),
                 )
 
@@ -857,10 +880,20 @@ class QueryRunner:
 
             ex.profiler = own_prof = OperatorProfiler()
         scan0 = len(getattr(ex, "scan_log", None) or [])
+        kp_cap = None
         try:
             t0 = time.perf_counter()
-            page = ex.execute(plan)
-            rows = page.to_pylist()
+            if stmt.verbose:
+                # VERBOSE tier: device-profile the run; to_pylist's
+                # host sync keeps every dispatch inside the window
+                from trino_tpu import kernel_profile
+
+                with kernel_profile.Capture(trigger="explain") as kp_cap:
+                    page = ex.execute(plan)
+                    rows = page.to_pylist()
+            else:
+                page = ex.execute(plan)
+                rows = page.to_pylist()
             total_ms = (time.perf_counter() - t0) * 1e3
         finally:
             del ex.execute
@@ -959,11 +992,80 @@ class QueryRunner:
                     f"streamed in {entry.get('batches', 0)} batches"
                 )
             lines.append(", ".join(parts))
+        # kernel observatory: the programs this query dispatched, in
+        # first-dispatch order (profiler records carry the jit keys)
+        from trino_tpu import program_catalog, telemetry
+
+        dispatched: list = []
+        for rec in prof.records:
+            for key in getattr(rec, "dispatch_keys", ()):
+                if key not in dispatched:
+                    dispatched.append(key)
+        # satellite: memory_analysis() temp+output vs what the
+        # MemoryContext actually reserved — the estimate-based
+        # governor's error, surfaced per query and as a gauge
+        est_bytes = 0
+        for key in dispatched:
+            m = program_catalog.CATALOG.memory(key)
+            if m is not None:
+                est_bytes += (m["temp_bytes"] or 0) + (
+                    m["output_bytes"] or 0
+                )
+        if est_bytes and peak_bytes:
+            ratio = est_bytes / peak_bytes
+            telemetry.MEMORY_ESTIMATE_RATIO.set(ratio)
+            lines.append(
+                f"Compiled-program HBM: {_fmt_bytes(est_bytes)} "
+                f"temp+output across {len(dispatched)} program(s) vs "
+                f"{_fmt_bytes(peak_bytes)} reserved "
+                f"(ratio {ratio:.2f})"
+            )
         lines.extend(
             _annotated_tree(plan, stats, profile=profile).splitlines()
         )
+        if stmt.verbose:
+            # VERBOSE tier: per-HLO-scope device time inside the fused
+            # programs, then each dispatched program's catalog entry
+            summary = kp_cap.summary() if kp_cap is not None else None
+            lines.append("Kernel profile (device time by HLO scope):")
+            if summary and summary.get("scopes"):
+                denom = (
+                    summary["attributed_us"]
+                    + summary["unattributed_us"]
+                ) or 1.0
+                for scope, us in summary["scopes"].items():
+                    lines.append(
+                        f"  {scope}: {us / 1e3:.3f} ms "
+                        f"({us / denom * 100:.0f}%)"
+                    )
+                if summary["unattributed_us"]:
+                    lines.append(
+                        "  (unattributed): "
+                        f"{summary['unattributed_us'] / 1e3:.3f} ms"
+                    )
+            else:
+                lines.append("  <no attributable device events captured>")
+            for key in dispatched:
+                e = program_catalog.CATALOG.entry_for(key, resolve=True)
+                if e is None:
+                    continue
+                flops = (
+                    f"{e.flops:.0f}" if e.flops is not None else "?"
+                )
+                temp = (
+                    _fmt_bytes(e.temp_bytes)
+                    if e.temp_bytes is not None else "?"
+                )
+                lines.append(
+                    f"  Program {e.program_id} [{e.label}] "
+                    f"({e.source}): {flops} flops, temp {temp}, "
+                    f"compile {e.compile_s * 1e3:.0f} ms, "
+                    f"hits {e.hits}"
+                )
         out = QueryResult(["Query Plan"], [(line,) for line in lines])
         out.stage_stats = stage_stats
+        if kp_cap is not None:
+            out.kernel_profile = kp_cap.summary()
         return out
 
 

@@ -131,6 +131,7 @@ def maybe_log_slow_query(
     listeners, session, query_id: str, sql: str, elapsed_ms: float,
     operator_stats: list | None, state: str = "FINISHED",
     time_breakdown: dict | None = None,
+    kernel_profile: dict | None = None,
 ) -> None:
     """Fire one structured slow-query record when the statement ran
     past the ``slow_query_log_threshold`` session property (0 = off).
@@ -177,6 +178,12 @@ def maybe_log_slow_query(
         **(
             {"time_breakdown": time_breakdown.get("buckets")}
             if time_breakdown else {}
+        ),
+        # per-HLO-scope device attribution, present when the session
+        # ran with kernel_profile=AUTO/ON (kernel observatory)
+        **(
+            {"kernel_profile": kernel_profile}
+            if kernel_profile else {}
         ),
     })
 

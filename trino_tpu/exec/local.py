@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from trino_tpu import memory, telemetry
+from trino_tpu import memory, program_catalog, telemetry
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec import shapes, stage
@@ -642,7 +642,8 @@ class LocalExecutor:
             self._layout_sig(page),
         )
         hit = self._jit_cache.get(key)
-        if hit is None:
+        was_miss = hit is None
+        if was_miss:
             in_layout = stage.ChainLayout(
                 names=list(page.names),
                 types={
@@ -682,9 +683,31 @@ class LocalExecutor:
                 (env_in, page.mask),
             )
             self._chain_avals[key] = abstract
+        if was_miss:
+            # catalog the freshly built program; the resolver lowers at
+            # the recorded avals on first cost/memory/HLO read
+            program_catalog.CATALOG.register(
+                key, source="local",
+                label="→".join(type(n).__name__ for n in chain),
+                resolver=program_catalog.aot_resolver(
+                    fn, self._chain_avals[key]
+                ),
+            )
+        else:
+            program_catalog.CATALOG.note_hit(key)
         if self.profiler is not None:
             self.profiler.note_dispatch(key)
-        env, mask, flags, n_live_dev = fn(env_in, page.mask)
+        if was_miss:
+            # the first call pays jit trace + backend compile (or a
+            # persistent-cache deserialize) before the async dispatch
+            # returns — that wall IS the program's compile cost
+            t0 = time.perf_counter()
+            env, mask, flags, n_live_dev = fn(env_in, page.mask)
+            program_catalog.CATALOG.note_compile_seconds(
+                key, time.perf_counter() - t0
+            )
+        else:
+            env, mask, flags, n_live_dev = fn(env_in, page.mask)
         if out_map is not None:
             # the cached program speaks canonical names; translate its
             # outputs back for this call (the cached out_layout is
@@ -694,33 +717,34 @@ class LocalExecutor:
 
     def chain_cost(self, key) -> dict | None:
         """XLA cost model ({'flops', 'bytes_accessed'}) for one cached
-        chain program, computed lazily on first request. The extra
-        ``lower().compile()`` resolves through the persistent
-        compilation cache as a deserialize of the program the dispatch
-        path already built — never a second real compile. A failed
-        analysis caches as None so it is not retried per query."""
+        chain program, read through the process-wide program catalog —
+        one lazy ``lower().compile()`` per program (a persistent-cache
+        deserialize of what the dispatch path already built), shared
+        with every other catalog consumer instead of recomputed per
+        lookup. A failed analysis memoizes as None per executor so it
+        is not retried per query."""
         if key in self._chain_costs:
             return self._chain_costs[key]
-        cost = None
-        # plain dict.get: a cost lookup is not a cache hit/miss event
-        # (CountingCache feeds trino_jit_cache_* counters tests pin)
-        hit = dict.get(self._jit_cache, key)
-        abstract = self._chain_avals.get(key)
-        if hit is not None and abstract is not None:
-            try:
-                fn = hit[0]
-                analysis = fn.lower(*abstract).compile().cost_analysis()
-                if isinstance(analysis, (list, tuple)):  # older jax
-                    analysis = analysis[0] if analysis else {}
-                if analysis:
-                    cost = {
-                        "flops": float(analysis.get("flops", 0.0)),
-                        "bytes_accessed": float(
-                            analysis.get("bytes accessed", 0.0)
-                        ),
-                    }
-            except Exception:
-                cost = None
+        if program_catalog.CATALOG.entry_for(key) is None:
+            # executor restored from a snapshot / catalog evicted: the
+            # program still lives in the jit cache, so re-catalog it.
+            # plain dict.get: a cost lookup is not a cache hit/miss
+            # event (CountingCache feeds trino_jit_cache_* counters)
+            hit = dict.get(self._jit_cache, key)
+            abstract = self._chain_avals.get(key)
+            if hit is None or abstract is None:
+                self._chain_costs[key] = None
+                return None
+            codes = {"F": "Filter", "P": "Project", "A": "Aggregate",
+                     "S": "Sort", "T": "TopN", "L": "Limit"}
+            label = "→".join(
+                codes.get(k[0], str(k[0])) for k in key[1]
+            ) or "chain"
+            program_catalog.CATALOG.register(
+                key, source="local", label=label,
+                resolver=program_catalog.aot_resolver(hit[0], abstract),
+            )
+        cost = program_catalog.CATALOG.cost(key)
         self._chain_costs[key] = cost
         return cost
 

@@ -25,6 +25,7 @@ HTTP exchange -> ExchangeOperator, SURVEY.md §3.4) — rebuilt SPMD:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import jax
@@ -32,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
-from trino_tpu import telemetry, types as T
+from trino_tpu import program_catalog, telemetry, types as T
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec import shapes as shape_policy
 from trino_tpu.exec import stage
@@ -524,11 +525,32 @@ class MeshExecutor(LocalExecutor):
                 )
                 hit = (prog, out_layout, meta)
                 self._mesh_jit_cache[key] = hit
+                # catalog the sharded program at its full (unsharded)
+                # leaf avals — lowering there reuses the same jit trace
+                program_catalog.CATALOG.register(
+                    key, source="mesh",
+                    label="→".join(type(n).__name__ for n in chain),
+                    resolver=program_catalog.aot_resolver(
+                        prog,
+                        tuple(
+                            jax.ShapeDtypeStruct(l.shape, l.dtype)
+                            for l in leaves
+                        ),
+                    ),
+                )
+                t_compile = time.perf_counter()
+            else:
+                program_catalog.CATALOG.note_hit(key)
+                t_compile = None
             prog, out_layout, meta = hit
             leaves, _ = _page_leaves(sp)
             env, mask, flags = self._attempt(
                 "chain", lambda: prog(*leaves)
             )
+            if t_compile is not None:
+                program_catalog.CATALOG.note_compile_seconds(
+                    key, time.perf_counter() - t_compile
+                )
             if flags:
                 vals = jax.device_get(flags)
                 overflowed = [i for i, v in vals.items() if v]

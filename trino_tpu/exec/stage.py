@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field, replace as dc_replace
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -170,26 +171,32 @@ def build_chain(chain: list[P.PlanNode], layout: ChainLayout, caps: dict[int, li
     telemetry.CHAINS_BUILT.inc()
     steps = []
     for i, nd in enumerate(chain):
+        # positional scope label: jax.named_scope stamps it into the
+        # per-instruction HLO op_name metadata (fusions included), so
+        # a captured device profile can attribute time to this plan
+        # operator INSIDE the fused program (kernel observatory)
+        scope = f"op{i}:{type(nd).__name__}"
         if isinstance(nd, P.Filter):
-            steps.append(_filter_step(nd, layout))
+            steps.append((scope, _filter_step(nd, layout)))
         elif isinstance(nd, P.Project):
             step, layout = _project_step(nd, layout)
-            steps.append(step)
+            steps.append((scope, step))
         elif isinstance(nd, P.Aggregate):
             step, layout = _aggregate_step(nd, layout, caps[i][0], i)
-            steps.append(step)
+            steps.append((scope, step))
         elif isinstance(nd, (P.Sort, P.TopN)):
             step, layout = _sort_step(nd, layout)
-            steps.append(step)
+            steps.append((scope, step))
         elif isinstance(nd, P.Limit):
-            steps.append(_limit_step(nd))
+            steps.append((scope, _limit_step(nd)))
         else:
             raise NotImplementedError(type(nd).__name__)
 
     def fn(env, mask):
         flags = {}
-        for step in steps:
-            env, mask, flags = step(env, mask, flags)
+        for scope, step in steps:
+            with jax.named_scope(scope):
+                env, mask, flags = step(env, mask, flags)
         return env, mask, flags
 
     return fn, layout

@@ -1,0 +1,184 @@
+"""On-demand device profiling with per-operator HLO attribution.
+
+The roofline profiler (PR 7) and flight recorder (PR 12) stop at the
+*operator* boundary — but a fused chain is ONE XLA program, so "where
+does q03's time go" was unanswerable below the chain. This module
+closes that gap:
+
+1. ``exec.stage.build_chain`` wraps each operator's lowering in
+   ``jax.named_scope("opN:Type")``, which XLA stamps into every HLO
+   instruction's ``op_name`` metadata (fusions included);
+2. :class:`Capture` runs ``jax.profiler.trace`` around a window of
+   device work and parses the Chrome-trace output it writes (gzip'd
+   JSON — stdlib only, no tensorboard dependency);
+3. trace events name HLO instructions; the program catalog's
+   instruction→scope map (:func:`program_catalog.scope_map_from_hlo`)
+   folds their durations back onto named plan operators.
+
+Triggers: the ``kernel_profile`` session property (ON / AUTO),
+``POST /v1/profile?duration_ms=`` on coordinator and workers, and —
+via AUTO — the slow-query log. Captures are process-exclusive
+(``jax.profiler.start_trace`` raises if one is active), so a nested
+Capture degrades to a no-op rather than poisoning the outer one.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+from trino_tpu import program_catalog, telemetry
+
+__all__ = ["Capture", "capture_for", "parse_trace_dir", "attribute"]
+
+#: process-wide exclusivity: jax allows one active trace per process
+_capture_lock = threading.Lock()
+
+
+def parse_trace_dir(trace_dir: str) -> list[dict]:
+    """Complete ("X") events from every ``*.trace.json.gz`` the
+    profiler wrote under ``trace_dir``. Each event keeps its name,
+    duration (µs), and any ``hlo_op`` arg."""
+    events: list[dict] = []
+    pattern = os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz"
+    )
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with gzip.open(path, "rt") as f:
+                doc = json.load(f)
+        except Exception:
+            continue
+        for ev in doc.get("traceEvents", []) or []:
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            events.append({
+                "name": ev.get("name", ""),
+                "dur_us": float(ev["dur"]),
+                "hlo_op": (ev.get("args") or {}).get("hlo_op"),
+            })
+    return events
+
+
+def attribute(
+    events: list[dict], scope_map: dict[str, str] | None = None
+) -> dict:
+    """Fold event durations onto named plan-operator scopes.
+
+    An event belongs to an HLO instruction when its ``hlo_op`` arg (or
+    its name) appears in the catalog's instruction→scope map; device
+    work that maps to no named scope — glue ops XLA emitted outside
+    any operator's lowering, other processes' modules — lands in
+    ``unattributed_us`` so the totals stay honest."""
+    if scope_map is None:
+        scope_map = program_catalog.CATALOG.scope_union()
+    scopes: dict[str, float] = {}
+    unattributed = 0.0
+    matched_events = 0
+    for ev in events:
+        instr = ev.get("hlo_op") or ev.get("name") or ""
+        # trace instruction names may carry a "%" sigil or a
+        # ".suffix" the HLO text form does not
+        instr = instr.lstrip("%")
+        scope = scope_map.get(instr)
+        if scope is None and "." in instr:
+            scope = scope_map.get(instr.split(".")[0])
+        if scope is None:
+            m = program_catalog._SCOPE_RE.search(ev.get("name") or "")
+            if m is not None:
+                scope = m.group(0)
+        if scope is not None:
+            scopes[scope] = scopes.get(scope, 0.0) + ev["dur_us"]
+            matched_events += 1
+        elif ev.get("hlo_op"):
+            # only count device-side HLO work as unattributed; plain
+            # host python events would drown the denominator
+            unattributed += ev["dur_us"]
+    return {
+        "scopes": dict(
+            sorted(scopes.items(), key=lambda kv: -kv[1])
+        ),
+        "attributed_us": round(sum(scopes.values()), 1),
+        "unattributed_us": round(unattributed, 1),
+        "events": len(events),
+        "matched_events": matched_events,
+    }
+
+
+class Capture:
+    """Context manager around one ``jax.profiler.trace`` window.
+
+    ``active`` is False when another capture already holds the process
+    lock (or the profiler fails to start) — the body still runs, the
+    capture is just a no-op and ``summary()`` returns None."""
+
+    def __init__(self, trigger: str = "manual"):
+        self.trigger = trigger
+        self.active = False
+        self._dir: str | None = None
+        self._summary: dict | None = None
+
+    def __enter__(self):
+        # the hold legitimately spans __enter__→__exit__: released in
+        # __exit__'s finally, or below when the profiler fails to start
+        if not _capture_lock.acquire(blocking=False):  # lint: disable=LCK001
+            return self
+        try:
+            import jax
+
+            self._dir = tempfile.mkdtemp(prefix="trino-kernel-prof-")
+            jax.profiler.start_trace(self._dir)
+            self.active = True
+            telemetry.KERNEL_PROFILES.inc(trigger=self.trigger)
+        except Exception:
+            self._cleanup()
+            _capture_lock.release()
+        return self
+
+    def __exit__(self, *exc):
+        if not self.active:
+            return False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            events = parse_trace_dir(self._dir)
+            self._summary = attribute(events)
+            self._summary["trigger"] = self.trigger
+        except Exception:
+            self._summary = None
+        finally:
+            self.active = False
+            self._cleanup()
+            _capture_lock.release()
+        return False
+
+    def _cleanup(self) -> None:
+        if self._dir:
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+
+    def summary(self) -> dict | None:
+        return self._summary
+
+
+def capture_for(duration_ms: float, trigger: str = "endpoint") -> dict:
+    """Blocking wall-clock capture (the ``POST /v1/profile`` body):
+    trace whatever device work runs during the window, attribute it.
+    Returns ``{"error": ...}`` instead of raising when another capture
+    holds the process lock."""
+    duration_ms = max(float(duration_ms), 1.0)
+    with Capture(trigger=trigger) as cap:
+        if not cap.active:
+            return {"error": "profiler busy: another capture is active"}
+        time.sleep(duration_ms / 1000.0)
+    out = cap.summary() or {"error": "capture produced no trace"}
+    if "error" not in out:
+        out["duration_ms"] = duration_ms
+    return out

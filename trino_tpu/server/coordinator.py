@@ -166,6 +166,28 @@ class Coordinator:
                 self.wfile.write(body)
 
             def do_POST(self):
+                path, _, query = self.path.partition("?")
+                if path == "/v1/profile":
+                    # kernel observatory: blocking device-profile
+                    # capture on the coordinator process (local/mesh
+                    # executors run in-process here)
+                    from urllib.parse import parse_qs
+
+                    from trino_tpu import kernel_profile
+
+                    dur = (
+                        parse_qs(query).get("duration_ms") or [500]
+                    )[0]
+                    try:
+                        dur = float(dur)
+                    except (TypeError, ValueError):
+                        self._send(400, {"error": "bad duration_ms"})
+                        return
+                    out = kernel_profile.capture_for(
+                        dur, trigger="endpoint"
+                    )
+                    self._send(200 if "error" not in out else 409, out)
+                    return
                 if self.path != "/v1/statement":
                     self._send(404, {"error": "not found"})
                     return
@@ -251,6 +273,27 @@ class Coordinator:
                         self._send(404, {"error": "query not found"})
                     else:
                         self._send(200, info)
+                    return
+                if parts == ["v1", "programs"]:
+                    # compiled-program catalog (kernel observatory):
+                    # same payload system.runtime.programs serves
+                    from trino_tpu import program_catalog
+
+                    self._send(200, {
+                        "programs": program_catalog.CATALOG.snapshot(),
+                    })
+                    return
+                if (
+                    len(parts) == 3
+                    and parts[:2] == ["v1", "programs"]
+                ):
+                    from trino_tpu import program_catalog
+
+                    e = program_catalog.CATALOG.get(parts[2])
+                    if e is None:
+                        self._send(404, {"error": "no such program"})
+                    else:
+                        self._send(200, e.to_dict(include_hlo=True))
                     return
                 if (
                     len(parts) == 6

@@ -214,6 +214,30 @@ class WorkerServer:
                     task = worker.submit_stage(req)
                     self._send(200, {"taskId": task.task_id})
                     return
+                path, _, query = self.path.partition("?")
+                if path == "/v1/profile":
+                    # kernel observatory: blocking device-profile
+                    # capture over a wall-clock window; whatever task
+                    # work dispatches during it gets attributed to
+                    # named HLO scopes via the program catalog
+                    from urllib.parse import parse_qs
+
+                    from trino_tpu import kernel_profile
+
+                    dur = (
+                        parse_qs(query).get("duration_ms")
+                        or [req.get("duration_ms", 500)]
+                    )[0]
+                    try:
+                        dur = float(dur)
+                    except (TypeError, ValueError):
+                        self._send(400, {"error": "bad duration_ms"})
+                        return
+                    out = kernel_profile.capture_for(
+                        dur, trigger="endpoint"
+                    )
+                    self._send(200 if "error" not in out else 409, out)
+                    return
                 self._send(404, {"error": "not found"})
 
             def _task_status(self, task_id: str, token: int | None):
@@ -363,6 +387,28 @@ class WorkerServer:
                             worker.runner.executor.memory_pool.snapshot()
                         ),
                     })
+                    return
+                if parts == ["v1", "programs"]:
+                    # compiled-program catalog: every XLA program this
+                    # worker compiled/deserialized, with cost and HBM
+                    # footprint analysis
+                    from trino_tpu import program_catalog
+
+                    self._send(200, {
+                        "programs": program_catalog.CATALOG.snapshot(),
+                    })
+                    return
+                if (
+                    len(parts) == 3
+                    and parts[:2] == ["v1", "programs"]
+                ):
+                    from trino_tpu import program_catalog
+
+                    e = program_catalog.CATALOG.get(parts[2])
+                    if e is None:
+                        self._send(404, {"error": "no such program"})
+                    else:
+                        self._send(200, e.to_dict(include_hlo=True))
                     return
                 self._send(404, {"error": "not found"})
 

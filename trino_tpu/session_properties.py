@@ -456,6 +456,16 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "0 = disabled",
             "varchar", "0s", _duration("slow_query_log_threshold"),
         ),
+        _P(
+            "kernel_profile",
+            "Device profile capture around query execution: ON traces "
+            "every statement and attaches per-HLO-scope device times "
+            "to QueryResult.kernel_profile; AUTO captures too but "
+            "attaches the attribution to the slow-query record only "
+            "when slow_query_log_threshold fires; OFF disables",
+            "varchar", "OFF",
+            _one_of("kernel_profile", {"OFF", "ON", "AUTO"}),
+        ),
         # ---- test/failure injection (hidden) --------------------------
         _P(
             "task_delay_ms",

@@ -394,6 +394,9 @@ class Explain(Statement):
     statement: Statement
     analyze: bool = False
     type_: str = "logical"  # logical | distributed | io
+    #: EXPLAIN ANALYZE VERBOSE: add the kernel-observatory tier
+    #: (per-HLO-scope device times, compiled-program footprints)
+    verbose: bool = False
 
 
 @dataclass

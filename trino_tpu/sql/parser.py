@@ -116,7 +116,13 @@ class _Parser:
             return ast.Deallocate(self.ident())
         if self.accept_kw("explain"):
             analyze = self.accept_kw("analyze")
-            return ast.Explain(self.statement(), analyze=analyze)
+            # "verbose" is not a reserved keyword — it lexes as IDENT
+            verbose = analyze and self._at_ident("verbose")
+            if verbose:
+                self.next()
+            return ast.Explain(
+                self.statement(), analyze=analyze, verbose=verbose
+            )
         if self.accept_kw("show"):
             if self.accept_kw("session"):
                 return ast.ShowSession()

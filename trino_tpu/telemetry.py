@@ -631,6 +631,23 @@ TIMESERIES_SAMPLES = REGISTRY.counter(
 TIMESERIES_SCRAPE_FAILURES = REGISTRY.counter(
     "trino_timeseries_scrape_failures_total",
     "Worker /v1/metrics scrapes that failed during a time-series round")
+PROGRAM_CATALOG_ENTRIES = REGISTRY.gauge(
+    "trino_program_catalog_entries",
+    "Compiled XLA programs currently retained in the program catalog")
+PROGRAM_REGISTRATIONS = REGISTRY.counter(
+    "trino_program_catalog_registrations_total",
+    "Compiled programs registered in the catalog, by registering source")
+PROGRAM_EVICTIONS = REGISTRY.counter(
+    "trino_program_catalog_evictions_total",
+    "Program-catalog entries evicted past the retention cap (LRU)")
+MEMORY_ESTIMATE_RATIO = REGISTRY.gauge(
+    "trino_memory_estimate_ratio",
+    "memory_analysis() temp+output bytes over the MemoryContext "
+    "reservation for the same query — the estimate-based governor's "
+    "error, last measured query")
+KERNEL_PROFILES = REGISTRY.counter(
+    "trino_kernel_profiles_total",
+    "Device profile captures taken by the kernel observatory, by trigger")
 
 
 # ---------------------------------------------------------------------------
