@@ -596,6 +596,12 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         # execution) is auditable, not asserted. Ports 18970+ (bench
         # chaos owns 18980+, stage-admission 18990+).
         _serving_section(detail)
+        # synthetic diurnal phase: the same closed-loop mix while the
+        # fleet scales 2 -> 4 -> 2 live (membership add_worker, then
+        # graceful drain), both transitions under in-flight load —
+        # zero query failures is the elastic-fleet contract. Ports
+        # 19400+ so the fixed-size serving fleet above never collides.
+        _serving_diurnal_section(detail)
 
     if (
         args.chaos or _section_enabled("BENCH_CHAOS", False)
@@ -929,6 +935,111 @@ def _serving_section(detail) -> None:
         detail["serving_wall_s"] = round(wall_s, 1)
     finally:
         chaos_mod.stop_workers(procs)
+
+
+def _serving_diurnal_section(detail) -> None:
+    import tempfile
+    import threading
+    import urllib.request
+
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.testing import chaos as chaos_mod
+
+    n_clients = int(os.environ.get("BENCH_DIURNAL_CLIENTS", "6"))
+    per_client = int(os.environ.get("BENCH_DIURNAL_STATEMENTS", "3"))
+    mix = [QUERIES["q01"], QUERIES["q03"], QUERIES["q06"]]
+    procs, uris = chaos_mod.spawn_workers(2, base_port=19400)
+    extra_procs, extra_uris = chaos_mod.spawn_workers(
+        2, base_port=19402
+    )
+    errors: list[str] = []
+    phases: dict[str, dict] = {}
+    try:
+        with tempfile.TemporaryDirectory(
+            prefix="bench-diurnal-"
+        ) as spool:
+            serving = chaos_mod.make_serving(uris, spool)
+            try:
+                for sql in mix:  # warmup: compile + scan residency
+                    serving.execute(sql)
+
+                def run_phase(name: str, transition=None) -> None:
+                    lat: list[float] = []
+                    lock = threading.Lock()
+
+                    def client(cid: int):
+                        try:
+                            for i in range(per_client):
+                                sql = mix[(cid + i) % len(mix)]
+                                t = time.perf_counter()
+                                serving.execute(sql)
+                                dt = time.perf_counter() - t
+                                with lock:
+                                    lat.append(dt)
+                        except Exception as e:
+                            errors.append(
+                                f"{name}: {type(e).__name__}: {e}"
+                            )
+
+                    threads = [
+                        threading.Thread(target=client, args=(c,))
+                        for c in range(n_clients)
+                    ]
+                    for t in threads:
+                        t.start()
+                    if transition is not None:
+                        # scale WHILE the phase load is in flight: the
+                        # zero-failure assertion covers the transition
+                        transition()
+                    for t in threads:
+                        t.join()
+                    lat.sort()
+
+                    def pct(p: float) -> float:
+                        if not lat:
+                            return 0.0
+                        i = int(round(p * (len(lat) - 1)))
+                        return lat[min(i, len(lat) - 1)]
+
+                    phases[name] = {
+                        "p50_ms": round(pct(0.50) * 1e3, 1),
+                        "p99_ms": round(pct(0.99) * 1e3, 1),
+                        "workers": sum(
+                            1 for w in serving.workers
+                            if w.alive and not w.draining
+                        ),
+                        "statements": len(lat),
+                    }
+
+                def scale_up():
+                    for u in extra_uris:
+                        serving.add_worker(u)
+
+                def scale_down():
+                    for u in extra_uris:
+                        req = urllib.request.Request(
+                            f"{u}/v1/drain", data=b"", method="POST"
+                        )
+                        with urllib.request.urlopen(
+                            req, timeout=5
+                        ) as r:
+                            r.read()
+
+                run_phase("low1")
+                run_phase("high", transition=scale_up)
+                run_phase("low2", transition=scale_down)
+            finally:
+                serving.stop()
+    finally:
+        chaos_mod.stop_workers(procs + extra_procs)
+    detail["serving_diurnal_failures"] = len(errors)
+    if errors:
+        detail["serving_diurnal_errors"] = errors[:5]
+    for name, ph in phases.items():
+        detail[f"serving_diurnal_{name}_p50_ms"] = ph["p50_ms"]
+        detail[f"serving_diurnal_{name}_p99_ms"] = ph["p99_ms"]
+        detail[f"serving_diurnal_{name}_workers"] = ph["workers"]
+        detail[f"serving_diurnal_{name}_statements"] = ph["statements"]
 
 
 if __name__ == "__main__":

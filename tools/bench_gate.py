@@ -77,6 +77,11 @@ _RATE_KEYS = [
     ("detail.skew_zipf_salted_ms", False),
     ("detail.skew_zipf_salted_input_skew", False),
     ("detail.skew_hot_adaptive_ms", False),
+    # elastic keys (BENCH_r10+, diurnal 2->4->2 scale under load):
+    # SKIP against baselines that predate the membership layer
+    ("detail.serving_diurnal_low1_p99_ms", False),
+    ("detail.serving_diurnal_high_p99_ms", False),
+    ("detail.serving_diurnal_low2_p99_ms", False),
 ]
 # NOT banded: the per-query ``detail.{q}_time_breakdown`` dicts
 # (BENCH_r08+, flight recorder) are informational — dict-valued and

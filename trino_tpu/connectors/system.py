@@ -35,6 +35,7 @@ _NODES_SCHEMA = TableSchema("nodes", [
     ("node_id", T.VARCHAR),
     ("kind", T.VARCHAR),
     ("state", T.VARCHAR),
+    ("heartbeat_age_s", T.DOUBLE),
 ])
 
 #: per-task runtime stats (system.runtime.tasks analog,
@@ -185,13 +186,28 @@ class SystemConnector(Connector):
         return out
 
     def _node_rows(self):
+        # live membership wins: announced workers carry real lifecycle
+        # state and heartbeat age; the mesh-topology synthesis remains
+        # the fixed-fleet / embedded fallback
+        registry = getattr(self.coordinator, "membership", None)
+        members = registry.members() if registry is not None else []
+        if members:
+            return [("local-0", "coordinator", "ACTIVE", 0.0)] + [
+                (
+                    m.node_id,
+                    "worker",
+                    m.state,
+                    round(registry.heartbeat_age(m.node_id) or 0.0, 3),
+                )
+                for m in members
+            ]
         runner = self.runner
         if runner is None and self.coordinator is not None:
             runner = self.coordinator.runner
         if runner is None or runner.mesh is None:
-            return [("local-0", "coordinator+worker", "ACTIVE")]
-        return [("local-0", "coordinator", "ACTIVE")] + [
-            (f"shard-{i}", "worker", "ACTIVE")
+            return [("local-0", "coordinator+worker", "ACTIVE", 0.0)]
+        return [("local-0", "coordinator", "ACTIVE", 0.0)] + [
+            (f"shard-{i}", "worker", "ACTIVE", 0.0)
             for i in range(runner.mesh.devices.size)
         ]
 

@@ -306,6 +306,32 @@ class Dispatcher:
         with self._lock:
             self._mark_dead_locked(w)
 
+    def add_worker(self, w) -> None:
+        """A worker joined the live cluster (membership layer): give
+        it slots and a poll-reactor thread, then pump — queued tickets
+        land on it immediately. Idempotent per URI: a re-join of a
+        known worker just clears its unschedulable flags."""
+        t = None
+        with self._lock:
+            for existing in self.workers:
+                if existing.uri == w.uri:
+                    existing.alive = True
+                    existing.fails = 0
+                    existing.draining = False
+                    self._pump_locked()
+                    return
+            self.workers.append(w)
+            self._in_use.setdefault(w.uri, 0)
+            self._active_by_worker.setdefault(w.uri, set())
+            t = threading.Thread(
+                target=self._worker_loop, args=(w,),
+                name=f"dispatch-poll-{w.uri.split('//')[-1]}",
+                daemon=True,
+            )
+            self._threads[w.uri] = t
+            self._pump_locked()
+        t.start()
+
     def poll_thread_count(self) -> int:
         """Live RPC-poll reactor threads — the O(workers) invariant
         tests assert against."""
@@ -556,6 +582,8 @@ class ServingRunner:
         self._lock = threading.Lock()
         #: public query id -> its live per-query FleetRunner
         self._active: dict[str, "FleetRunner"] = {}
+        #: MembershipRegistry once attach_membership() wires one in
+        self.membership = None
         self.mesh = None  # duck-typing parity with QueryRunner
 
     # -- per-query machinery ------------------------------------------------
@@ -628,6 +656,74 @@ class ServingRunner:
     def running_queries(self) -> list[str]:
         with self._lock:
             return list(self._active)
+
+    # -- live membership ----------------------------------------------------
+
+    def add_worker(self, uri: str):
+        """A worker joined the live cluster: it becomes grantable for
+        every in-flight query (per-query FleetRunners share this
+        worker list by reference, so their dispatch loops see it on
+        the next iteration). Idempotent per URI — a re-join just
+        clears the unschedulable flags via Dispatcher.add_worker."""
+        from trino_tpu.server.fleet import FleetRunner, FleetWorker
+
+        uri = uri.rstrip("/")
+        found = None
+        with self._lock:
+            for w in self.workers:
+                if w.uri == uri:
+                    found = w
+                    break
+            if found is None:
+                found = FleetWorker(uri)
+                self.workers.append(found)
+        if uri not in self.worker_devices:
+            try:
+                self.worker_devices[uri] = FleetRunner._probe_devices(
+                    uri
+                )
+            except Exception:
+                self.worker_devices[uri] = 1
+        self.dispatcher.add_worker(found)
+        return found
+
+    def attach_membership(self, registry) -> None:
+        """Wire a MembershipRegistry to the serving fleet: joins add
+        live workers, leaves (drain / damped heartbeat loss) mark
+        them unschedulable-but-alive, and the registry's drain gate
+        consults the union of every live query's residency pins."""
+        self.membership = registry
+        registry.residency_providers.append(self.pinned_worker_uris)
+        registry.on_join.append(self._on_member_join)
+        registry.on_leave.append(self._on_member_leave)
+
+    def pinned_worker_uris(self) -> set:
+        """Worker URIs any live query's scheduler still pins buffers
+        on — while non-empty for a URI, that worker must keep serving
+        its exchange buffers/spool reads even when DRAINED."""
+        with self._lock:
+            runners = list(self._active.values())
+        pinned: set = set()
+        for fr in runners:
+            sched = getattr(fr, "_scheduler", None)
+            if sched is not None:
+                try:
+                    pinned |= sched.pinned_workers()
+                except Exception:
+                    pass
+        return pinned
+
+    def _on_member_join(self, member) -> None:
+        self.add_worker(member.uri)
+
+    def _on_member_leave(self, member, reason: str) -> None:
+        # unschedulable-but-alive: the FTE tier (poll eviction +
+        # re-admission probes) stays the only path that declares a
+        # worker dead
+        uri = member.uri.rstrip("/")
+        for w in self.workers:
+            if w.uri == uri:
+                w.draining = True
 
     # -- cluster memory governance across queries ---------------------------
 

@@ -50,6 +50,9 @@ class QueryResult:
     tasks_speculated: int = 0
     speculation_wins: int = 0
     workers_readmitted: int = 0
+    #: workers that live-joined the placement pool mid-query after
+    #: announcing into the membership registry (elastic fleet)
+    workers_joined: int = 0
     #: whole-statement re-executions under retry_policy=QUERY (each
     #: one ran under a fresh spool epoch); 0 when the first execution
     #: succeeded or the policy is NONE/TASK

@@ -21,6 +21,13 @@ Sites (one string per architectural seam):
     ``exchange-fetch`` direct producer-memory partition fetches
                     (server/worker.py consumer side; a fired fault
                     falls back to the spool, never fails the task)
+    ``announce-drop`` worker membership announcements (the PUT
+                    /v1/announce client path; a dropped announce is
+                    invisible to the worker — the registry just never
+                    hears from it that round)
+    ``heartbeat-loss`` periodic membership heartbeats after the
+                    initial announce (same seam, separate site so a
+                    schedule can let a worker join and then go quiet)
 
 Schedules: ``arm`` (attempts 0..times-1 fail — the classic retry
 shape), ``arm_nth`` (exactly the n-th matching call fails), and
@@ -51,7 +58,8 @@ __all__ = [
 #: the closed set of injection sites (typo'd arms fail fast)
 SITES = frozenset(
     ["rpc", "spool-write", "spool-read", "task-exec", "device-oom",
-     "planner", "compile-deserialize", "scan-read", "exchange-fetch"]
+     "planner", "compile-deserialize", "scan-read", "exchange-fetch",
+     "heartbeat-loss", "announce-drop"]
 )
 
 

@@ -1,0 +1,446 @@
+"""Live cluster membership: announce/heartbeat, drain, size gating.
+
+The fleet's worker list was frozen at construction time; this module is
+the membership layer Trino builds from ``DiscoveryNodeManager`` (the
+active/inactive node tracker fed by announcements), ``ClusterSizeMonitor``
+(scheduling held until a minimum worker count is met), and
+``GracefulShutdownHandler`` (ACTIVE -> DRAINING -> DRAINED -> gone).
+
+One :class:`MembershipRegistry` lives on the coordinator. Workers PUT
+``/v1/announce`` on boot and then heartbeat the same endpoint every
+``ttl_s / 3`` seconds, reporting their lifecycle state
+(``server/worker.py``'s ACTIVE / DRAINING / DRAINED). The registry runs
+a TTL state machine over the announcements:
+
+* a member whose heartbeat goes stale past ``ttl_s`` turns INACTIVE —
+  the transition is *recorded* immediately but the member is not
+  **evicted** (handed to ``on_leave`` subscribers, who stop scheduling
+  onto it) until INACTIVE has persisted for ``damping_s``. A worker
+  that bounces active<->inactive inside the damping window therefore
+  never leaves the schedulable set, and its re-announce fires no
+  ``on_join`` — no eviction churn, no double admission (the
+  HeartbeatFailureDetector's flap suppression, made explicit);
+* INACTIVE persisting past ``gone_after_s`` becomes GONE and is
+  dropped. A later announce from the same node is a fresh join;
+* a DRAINING member is **unschedulable but alive**: ``on_leave`` fires
+  (no new tasks) while its HTTP surface keeps serving direct-exchange
+  buffers and spool reads. It may deregister only when (a) the worker
+  itself reports DRAINED (running tasks finished) AND (b) no residency
+  provider still pins one of its buffers — the PR 10 residency hints
+  are exactly the coordinator's knowledge of which consumers have not
+  yet committed their reads. Until both hold, the announce response is
+  ``{"deregister": false}`` and the worker keeps serving.
+
+Eviction here never declares a worker dead: ``on_leave`` consumers mark
+it unschedulable (``FleetWorker.draining``) and the FTE tier (poll
+eviction, re-admission probes, speculation first-commit-wins) remains
+the one crash path.
+
+:class:`ClusterSizeMonitor` gates dispatch: ``wait_for_minimum`` parks
+until the schedulable count reaches ``min_workers`` and raises the
+typed :class:`InsufficientResourcesError` (coordinator error code 134,
+``INSUFFICIENT_RESOURCES``) when the deadline lapses first.
+
+``announce_once`` is the worker-side client half; its fault seams
+(``announce-drop`` for the initial announce, ``heartbeat-loss`` for
+every later round) make flaky membership a seeded, schedulable chaos
+ingredient like any other site.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional
+
+from trino_tpu import fault, telemetry
+
+__all__ = [
+    "Member", "Transition", "MembershipRegistry",
+    "ClusterSizeMonitor", "InsufficientResourcesError",
+    "announce_once",
+]
+
+#: membership lifecycle states (worker-reported states plus the
+#: registry's own staleness tiers)
+STATES = ("ACTIVE", "DRAINING", "DRAINED", "INACTIVE", "GONE")
+
+
+class InsufficientResourcesError(RuntimeError):
+    """The cluster cannot meet ``min_workers`` before the deadline.
+    Typed so the coordinator maps it to error code 134
+    (``INSUFFICIENT_RESOURCES``) instead of a generic failure."""
+
+
+@dataclass
+class Transition:
+    """One membership state-machine edge, for post-mortems and the
+    ``system.runtime.nodes`` heartbeat story."""
+
+    node_id: str
+    src: str
+    dst: str
+    at: float
+    reason: str = ""
+
+
+@dataclass
+class Member:
+    node_id: str
+    uri: str
+    state: str = "ACTIVE"
+    first_seen: float = 0.0
+    last_seen: float = 0.0
+    active_tasks: int = 0
+    #: clock stamp of the ACTIVE->INACTIVE edge (damping window start)
+    inactive_since: Optional[float] = None
+    #: on_leave already fired — the member left the schedulable set
+    evicted: bool = False
+    #: clock stamp of the first DRAINING announce
+    drain_started: Optional[float] = None
+    announces: int = 0
+    flaps: int = 0
+
+
+class MembershipRegistry:
+    """TTL-tracked membership with flap damping and drain-aware
+    deregistration. Thread-safe; ``on_join``/``on_leave`` callbacks
+    fire outside the registry lock (subscribers take their own)."""
+
+    def __init__(
+        self,
+        ttl_s: float = 3.0,
+        *,
+        gone_after_s: Optional[float] = None,
+        damping_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        max_transitions: int = 256,
+    ):
+        self.ttl_s = float(ttl_s)
+        self.gone_after_s = (
+            float(gone_after_s) if gone_after_s is not None
+            else 3.0 * self.ttl_s
+        )
+        self.damping_s = (
+            float(damping_s) if damping_s is not None
+            else 0.5 * self.ttl_s
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._members: Dict[str, Member] = {}
+        self._transitions: List[Transition] = []
+        self._max_transitions = int(max_transitions)
+        #: set-of-pinned-worker-URI callables — the coordinator's
+        #: residency knowledge (scheduler ``_locations`` unions over
+        #: live queries). A DRAINED worker deregisters only when no
+        #: provider pins it.
+        self.residency_providers: List[Callable[[], Iterable[str]]] = []
+        #: called with the Member on a fresh (or re-) admission
+        self.on_join: List[Callable[[Member], None]] = []
+        #: called with (Member, reason) when it leaves the
+        #: schedulable set (drain, damped staleness, gone)
+        self.on_leave: List[Callable[[Member, str], None]] = []
+
+    # ---- state machine ------------------------------------------------
+
+    def _record(self, m: Member, dst: str, reason: str, now: float):
+        src = m.state
+        if src == dst:
+            return
+        m.state = dst
+        self._transitions.append(
+            Transition(m.node_id, src, dst, now, reason)
+        )
+        del self._transitions[:-self._max_transitions]
+        # "from" is a Python keyword — splat the label dict
+        telemetry.MEMBERSHIP_TRANSITIONS.inc(**{"from": src, "to": dst})
+
+    def _gauges_locked(self):
+        counts = {"active": 0, "draining": 0, "inactive": 0}
+        for m in self._members.values():
+            if m.state == "ACTIVE":
+                counts["active"] += 1
+            elif m.state in ("DRAINING", "DRAINED"):
+                counts["draining"] += 1
+            elif m.state == "INACTIVE":
+                counts["inactive"] += 1
+        for state, n in counts.items():
+            telemetry.CLUSTER_WORKERS.set(float(n), state=state)
+
+    def announce(
+        self,
+        node_id: str,
+        uri: str,
+        *,
+        state: str = "ACTIVE",
+        active_tasks: int = 0,
+    ) -> dict:
+        """Process one announcement/heartbeat; returns the wire
+        response (``{"state", "ttl_s", "deregister"}``)."""
+        state = str(state).upper()
+        if state not in STATES:
+            state = "ACTIVE"
+        now = self._clock()
+        joined: Optional[Member] = None
+        left: Optional[tuple] = None
+        dereg = False
+        with self._lock:
+            m = self._members.get(node_id)
+            if m is None:
+                m = Member(
+                    node_id=node_id, uri=uri.rstrip("/"),
+                    first_seen=now, last_seen=now,
+                )
+                self._members[node_id] = m
+                self._transitions.append(
+                    Transition(node_id, "GONE", "ACTIVE", now, "join")
+                )
+                del self._transitions[:-self._max_transitions]
+                telemetry.MEMBERSHIP_TRANSITIONS.inc(
+                    **{"from": "GONE", "to": "ACTIVE"}
+                )
+                if state == "ACTIVE":
+                    joined = m
+            m.last_seen = now
+            m.uri = uri.rstrip("/")
+            m.active_tasks = int(active_tasks)
+            m.announces += 1
+            if state == "ACTIVE":
+                if m.state == "INACTIVE":
+                    m.flaps += 1
+                    self._record(m, "ACTIVE", "reannounce", now)
+                    if m.evicted:
+                        # past the damping window: it really left and
+                        # is really back — re-admit
+                        m.evicted = False
+                        joined = m
+                    # inside the window: damped flap, no on_join
+                elif m.state in ("DRAINING", "DRAINED"):
+                    # a drain is not reversible through heartbeats;
+                    # keep it unschedulable until it deregisters
+                    pass
+                m.inactive_since = None
+            elif state in ("DRAINING", "DRAINED"):
+                if m.drain_started is None:
+                    m.drain_started = now
+                if m.state == "ACTIVE":
+                    self._record(m, "DRAINING", "drain", now)
+                    m.evicted = True
+                    left = (m, "drain")
+                if state == "DRAINED" and m.active_tasks == 0:
+                    if m.state == "DRAINING":
+                        self._record(m, "DRAINED", "tasks finished", now)
+                    dereg = self._deregisterable_locked(m)
+                    if dereg:
+                        self._record(m, "GONE", "drain complete", now)
+                        telemetry.DRAIN_DURATION.observe(
+                            max(0.0, now - m.drain_started)
+                        )
+                        self._members.pop(node_id, None)
+            swept = self._sweep_locked(now)
+            self._gauges_locked()
+        for sm, reason in swept:
+            self._fire_leave(sm, reason)
+        if left is not None:
+            self._fire_leave(*left)
+        if joined is not None:
+            self._fire_join(joined)
+        return {
+            "state": "GONE" if dereg else m.state,
+            "ttl_s": self.ttl_s,
+            "deregister": dereg,
+        }
+
+    def _deregisterable_locked(self, m: Member) -> bool:
+        """True when no residency provider still pins the member's
+        URI — every dependent consumer has committed its reads of the
+        worker's exchange buffers / spool outputs."""
+        for provider in self.residency_providers:
+            try:
+                pinned = {str(u).rstrip("/") for u in provider()}
+            except Exception:
+                continue
+            if m.uri in pinned:
+                return False
+        return True
+
+    def _sweep_locked(self, now: float) -> List[tuple]:
+        """Advance the TTL tiers; returns (member, reason) evictions
+        for the caller to fire outside the lock."""
+        leaves: List[tuple] = []
+        for node_id in list(self._members):
+            m = self._members[node_id]
+            stale = now - m.last_seen
+            if m.state in ("ACTIVE",) and stale > self.ttl_s:
+                self._record(m, "INACTIVE", "heartbeat stale", now)
+                m.inactive_since = now
+            if m.state == "INACTIVE":
+                quiet = now - (m.inactive_since or m.last_seen)
+                if not m.evicted and quiet >= self.damping_s:
+                    m.evicted = True
+                    leaves.append((m, "heartbeat lost"))
+                if quiet >= self.gone_after_s:
+                    self._record(m, "GONE", "expired", now)
+                    self._members.pop(node_id, None)
+            elif m.state in ("DRAINING", "DRAINED"):
+                # a draining worker that also stops heartbeating is a
+                # crash, not a drain: expire it on the same TTL tiers
+                if stale > self.gone_after_s:
+                    self._record(m, "GONE", "died while draining", now)
+                    self._members.pop(node_id, None)
+        return leaves
+
+    def sweep(self) -> None:
+        """Run the TTL state machine now (announce() also sweeps, so
+        an idle cluster still ages via any reader calling this)."""
+        with self._lock:
+            leaves = self._sweep_locked(self._clock())
+            self._gauges_locked()
+        for m, reason in leaves:
+            self._fire_leave(m, reason)
+
+    def _fire_join(self, m: Member):
+        for cb in list(self.on_join):
+            try:
+                cb(m)
+            except Exception:
+                pass
+
+    def _fire_leave(self, m: Member, reason: str):
+        for cb in list(self.on_leave):
+            try:
+                cb(m, reason)
+            except Exception:
+                pass
+
+    # ---- read side ----------------------------------------------------
+
+    def members(self) -> List[Member]:
+        self.sweep()
+        with self._lock:
+            return list(self._members.values())
+
+    def schedulable(self) -> List[Member]:
+        """Members new tasks may be placed on: ACTIVE and not inside
+        an eviction (a damped INACTIVE member is *still schedulable*
+        — that is the whole point of the damping window)."""
+        self.sweep()
+        with self._lock:
+            return [
+                m for m in self._members.values()
+                if not m.evicted and m.state in ("ACTIVE", "INACTIVE")
+            ]
+
+    def heartbeat_age(self, node_id: str) -> Optional[float]:
+        with self._lock:
+            m = self._members.get(node_id)
+            return None if m is None else max(
+                0.0, self._clock() - m.last_seen
+            )
+
+    def transitions(self) -> List[Transition]:
+        with self._lock:
+            return list(self._transitions)
+
+    def snapshot(self) -> dict:
+        """JSON-able summary for post-mortem bundles and debugging."""
+        self.sweep()
+        with self._lock:
+            now = self._clock()
+            return {
+                "members": [
+                    {
+                        "node_id": m.node_id,
+                        "uri": m.uri,
+                        "state": m.state,
+                        "evicted": m.evicted,
+                        "active_tasks": m.active_tasks,
+                        "heartbeat_age_s": round(now - m.last_seen, 3),
+                        "announces": m.announces,
+                        "flaps": m.flaps,
+                    }
+                    for m in self._members.values()
+                ],
+                "transitions": [
+                    {
+                        "node_id": t.node_id, "from": t.src,
+                        "to": t.dst, "reason": t.reason,
+                    }
+                    for t in self._transitions[-32:]
+                ],
+            }
+
+
+class ClusterSizeMonitor:
+    """Holds dispatch until the schedulable membership meets
+    ``min_workers`` (Trino's ClusterSizeMonitor: queries park rather
+    than fail while the fleet is still forming / mid-scale-down, and
+    fail *typed* when the wait is hopeless)."""
+
+    def __init__(
+        self,
+        registry: MembershipRegistry,
+        min_workers: int,
+        *,
+        poll_s: float = 0.02,
+    ):
+        self.registry = registry
+        self.min_workers = int(min_workers)
+        self.poll_s = float(poll_s)
+
+    def wait_for_minimum(self, timeout_s: float = 10.0) -> int:
+        """Park until ``min_workers`` schedulable members exist;
+        returns the count, or raises InsufficientResourcesError once
+        ``timeout_s`` lapses."""
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            n = len(self.registry.schedulable())
+            if n >= self.min_workers:
+                return n
+            if time.monotonic() >= deadline:
+                raise InsufficientResourcesError(
+                    f"cluster has {n} schedulable workers; query "
+                    f"requires {self.min_workers} "
+                    f"(waited {timeout_s:.1f}s)"
+                )
+            time.sleep(self.poll_s)
+
+
+def announce_once(
+    coordinator_uri: str,
+    node_id: str,
+    uri: str,
+    *,
+    state: str = "ACTIVE",
+    active_tasks: int = 0,
+    timeout_s: float = 2.0,
+    initial: bool = False,
+    attempt: int = 0,
+) -> dict:
+    """One announce/heartbeat PUT against the coordinator. Raises on
+    transport failure (the caller's loop just skips the round — a
+    missed heartbeat is exactly what the TTL machine absorbs). The
+    fault seams make membership flakiness seed-schedulable;
+    ``attempt`` is the heartbeat round, so ``times``/``prob``
+    schedules vary per round instead of firing forever."""
+    fault.check(
+        "announce-drop" if initial else "heartbeat-loss",
+        tag=node_id, attempt=int(attempt),
+    )
+    body = json.dumps({
+        "node_id": node_id,
+        "uri": uri,
+        "state": state,
+        "active_tasks": int(active_tasks),
+    }).encode()
+    req = urllib.request.Request(
+        coordinator_uri.rstrip("/") + "/v1/announce",
+        data=body,
+        method="PUT",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())

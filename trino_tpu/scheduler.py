@@ -271,6 +271,14 @@ class EventDrivenScheduler:
     def admission_wait_ms(self, tid: str) -> float:
         return float(self._admission_wait_ms.get(tid, 0.0))
 
+    def pinned_workers(self) -> set:
+        """Worker URIs some committed attempt's output currently
+        resides on — the membership layer's drain gate: a DRAINING
+        worker may not deregister while a live query could still
+        fetch one of these buffers (retract/quarantine removes the
+        entry; query end drops the whole scheduler)."""
+        return set(self._locations.values())
+
     def overlap_seconds(self) -> float:
         """Total producer/consumer overlap won so far (closed windows
         only; all windows close once every stage completes)."""

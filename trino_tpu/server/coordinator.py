@@ -52,6 +52,7 @@ ERROR_CODES = {
     "QueryRetriesExhaustedError": (132, "QUERY_RETRIES_EXHAUSTED"),
     "QueryCancelled": (130, "USER_CANCELED"),
     "ExceededMemoryLimitError": (133, "EXCEEDED_MEMORY_LIMIT"),
+    "InsufficientResourcesError": (134, "INSUFFICIENT_RESOURCES"),
 }
 
 
@@ -140,6 +141,16 @@ class Coordinator:
         #: when TRINO_TPU_TIMESERIES_INTERVAL_MS enables it (None =
         #: disabled = no background scrape thread exists at all)
         self.timeseries = None
+        #: live cluster membership (elastic fleet): adopt the
+        #: serving runner's registry when it wired one in, else own a
+        #: fresh one — workers started with --coordinator PUT
+        #: /v1/announce here either way
+        from trino_tpu.membership import MembershipRegistry
+
+        self.membership = (
+            getattr(self.runner, "membership", None)
+            or MembershipRegistry()
+        )
         # system.runtime tables over live coordinator state
         # (MAIN/connector/system/ analog)
         from trino_tpu.connectors.system import SystemConnector
@@ -164,6 +175,31 @@ class Coordinator:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_PUT(self):
+                path, _, _ = self.path.partition("?")
+                if path != "/v1/announce":
+                    self._send(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    req = json.loads(self.rfile.read(n).decode())
+                except (ValueError, UnicodeDecodeError):
+                    self._send(400, {"error": "bad announce body"})
+                    return
+                node_id = str(req.get("node_id") or "").strip()
+                uri = str(req.get("uri") or "").strip()
+                if not node_id or not uri:
+                    self._send(
+                        400, {"error": "node_id and uri required"}
+                    )
+                    return
+                self._send(200, coordinator.membership.announce(
+                    node_id,
+                    uri,
+                    state=str(req.get("state") or "ACTIVE"),
+                    active_tasks=int(req.get("active_tasks") or 0),
+                ))
 
             def do_POST(self):
                 path, _, query = self.path.partition("?")

@@ -48,6 +48,7 @@ from dataclasses import dataclass
 from trino_tpu import (
     diagnostics,
     fault,
+    membership as membership_mod,
     memory,
     profiler,
     telemetry,
@@ -219,6 +220,9 @@ class FleetRunner:
         serving=None,
         resource_group: str = "global",
         group_weight: int = 1,
+        membership=None,
+        min_workers: int = 0,
+        min_workers_wait_s: float = 8.0,
     ):
         #: serving mode: a shared trino_tpu.dispatcher.Dispatcher owns
         #: worker slots, fair-share grants and ALL status polling; this
@@ -354,6 +358,21 @@ class FleetRunner:
         self._planner.mesh = _FleetParallelism(
             max(n_partitions, 2) * per_worker
         )
+        #: live-membership registry (elastic fleet). In serving mode
+        #: the ServingRunner owns the wiring (attach_membership); a
+        #: legacy single-query runner wires itself: its scheduler pins
+        #: gate drain deregistration, leaves mark workers
+        #: unschedulable-but-alive, and _sync_membership folds joins
+        #: into the placement pool every dispatch iteration
+        self.membership = membership
+        #: ClusterSizeMonitor gate: execute() parks until this many
+        #: schedulable members exist, then fails typed
+        #: (INSUFFICIENT_RESOURCES) after min_workers_wait_s
+        self.min_workers = int(min_workers)
+        self.min_workers_wait_s = float(min_workers_wait_s)
+        if membership is not None and serving is None:
+            membership.residency_providers.append(self._membership_pins)
+            membership.on_leave.append(self._membership_leave)
 
     def request_kill(self, error: str) -> bool:
         """Cross-query memory kill (serving mode): mark this query as
@@ -374,6 +393,59 @@ class FleetRunner:
             return 1
 
     # ---- query entry -----------------------------------------------------
+
+    # ---- live membership (elastic fleet) ------------------------------
+
+    def _membership_registry(self):
+        """The registry governing this runner's fleet: its own in
+        legacy mode, the ServingRunner's in serving mode."""
+        if self.membership is not None:
+            return self.membership
+        return getattr(self._serving, "membership", None)
+
+    def _membership_pins(self):
+        """Residency provider for the drain gate: worker URIs whose
+        exchange buffers some not-yet-finished consumer of THIS query
+        may still fetch. Empty between statements — a drained worker
+        must not wait on a runner with nothing in flight."""
+        sched = self._scheduler
+        if sched is None or self._public_query_id is None:
+            return set()
+        return sched.pinned_workers()
+
+    def _membership_leave(self, member, reason: str) -> None:
+        """A member left the schedulable set (drain announce or damped
+        heartbeat loss): mark it unschedulable-but-alive. Liveness is
+        NOT touched — FTE poll eviction stays the only crash path."""
+        uri = member.uri.rstrip("/")
+        for w in self.workers:
+            if w.uri == uri:
+                w.draining = True
+
+    def _sync_membership(self) -> None:
+        """Fold the live membership into the placement pool (legacy
+        dispatch loop, once per iteration): a worker that announced
+        after this query was dispatched joins self.workers and is
+        eligible for every not-yet-posted task; a previously-evicted
+        member that re-announced becomes postable again."""
+        reg = self.membership
+        if reg is None:
+            return
+        known = {w.uri: w for w in self.workers}
+        for m in reg.schedulable():
+            w = known.get(m.uri)
+            if w is None:
+                w = FleetWorker(m.uri)
+                if m.uri not in self.worker_devices:
+                    self.worker_devices[m.uri] = self._probe_devices(
+                        m.uri
+                    )
+                self.workers.append(w)
+                self.stats["workers_joined"] = (
+                    self.stats.get("workers_joined", 0) + 1
+                )
+            elif w.alive and w.draining:
+                w.draining = False
 
     def execute(
         self, sql: str, cancel_event=None, query_id: str | None = None,
@@ -410,6 +482,15 @@ class FleetRunner:
         self._task_stats = []
         metrics_before = telemetry.REGISTRY.snapshot()
         try:
+            reg = self._membership_registry()
+            if reg is not None and self.min_workers > 0:
+                # ClusterSizeMonitor gate: park while the fleet forms
+                # (or re-forms mid-scale-down), reject typed when the
+                # wait is hopeless — never dispatch into a cluster
+                # that cannot place the DAG
+                membership_mod.ClusterSizeMonitor(
+                    reg, self.min_workers
+                ).wait_for_minimum(self.min_workers_wait_s)
             result = self._execute_stmt(stmt, cancel_event)
             if explain_analyze:
                 result = self._render_fleet_analyze(result)
@@ -442,6 +523,11 @@ class FleetRunner:
                     fault_records=list(self.failure_log),
                     metrics_before=metrics_before,
                     metrics_after=telemetry.REGISTRY.snapshot(),
+                    extra=(
+                        {"membership": mreg.snapshot()}
+                        if (mreg := self._membership_registry())
+                        is not None else None
+                    ),
                 ))
             tracker.QUERY_INFO.finish(
                 public_qid,
@@ -1908,6 +1994,8 @@ class FleetRunner:
                     spec_by_tid[spec.task_id] = (stage, spec)
                     push(stage, spec)
                 started.add(stage.stage_id)
+            if self.dispatcher is None:
+                self._sync_membership()
             live = [w for w in self.workers if w.alive]
             if not live:
                 raise RuntimeError("no live workers remain")

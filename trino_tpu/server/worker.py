@@ -23,7 +23,7 @@ import uuid
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from trino_tpu import fault, telemetry
+from trino_tpu import fault, membership as membership_mod, telemetry
 from trino_tpu.engine import QueryRunner
 from trino_tpu.plan.serde import plan_from_json
 
@@ -449,6 +449,8 @@ class WorkerServer:
             f"127.0.0.1:{self.port}"
         )
         self._thread: threading.Thread | None = None
+        self._announce_thread: threading.Thread | None = None
+        self._announce_stop = threading.Event()
 
     def start(self) -> "WorkerServer":
         self._thread = threading.Thread(
@@ -458,8 +460,59 @@ class WorkerServer:
         return self
 
     def stop(self):
+        self._announce_stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
+
+    def start_announcer(
+        self,
+        coordinator_uri: str,
+        node_id: str | None = None,
+        fallback_interval_s: float = 1.0,
+    ) -> threading.Thread:
+        """Join the live cluster: announce once, then heartbeat at a
+        third of the coordinator-advertised TTL, reporting this
+        worker's lifecycle state. The loop exits when the coordinator
+        answers ``deregister`` — the drain completed (running tasks
+        finished AND every dependent consumer committed its exchange
+        reads). A failed round — transport error or an armed
+        announce-drop/heartbeat-loss fault — is simply skipped; the
+        registry's TTL machine absorbs missed heartbeats."""
+        node = node_id or f"worker-{self.port}"
+        worker = self
+
+        def loop():
+            initial = True
+            rounds = 0
+            interval = fallback_interval_s
+            while not worker._announce_stop.is_set():
+                try:
+                    resp = membership_mod.announce_once(
+                        coordinator_uri,
+                        node,
+                        worker._self_uri,
+                        state=worker.lifecycle_state(),
+                        active_tasks=worker._active_tasks,
+                        initial=initial,
+                        attempt=rounds,
+                    )
+                    initial = False
+                    if resp.get("deregister"):
+                        return
+                    interval = max(
+                        float(resp.get("ttl_s", 3.0)) / 3.0, 0.05
+                    )
+                except Exception:
+                    pass  # missed round: the TTL state machine's job
+                rounds += 1
+                worker._announce_stop.wait(interval)
+
+        t = threading.Thread(
+            target=loop, name=f"announce-{self.port}", daemon=True
+        )
+        t.start()
+        self._announce_thread = t
+        return t
 
     # ---- lifecycle (graceful drain) --------------------------------------
 
@@ -1190,6 +1243,15 @@ def main():
         help="mount a parquet directory tree as the worker catalog "
              "(--catalog names the catalog, --schema the schema)",
     )
+    ap.add_argument(
+        "--coordinator", default=None,
+        help="coordinator base URI to announce/heartbeat against "
+             "(joins the live cluster; omit for fixed-list fleets)",
+    )
+    ap.add_argument(
+        "--node-id", default=None,
+        help="stable membership identity (default worker-<port>)",
+    )
     args = ap.parse_args()
     if os.environ.get("JAX_PLATFORMS"):
         # a site-installed accelerator plugin may overwrite
@@ -1242,6 +1304,8 @@ def main():
         print(f"prewarm: {info}", flush=True)
     server = WorkerServer(runner, port=args.port)
     server.start()
+    if args.coordinator:
+        server.start_announcer(args.coordinator, args.node_id)
     print(f"worker ready on port {server.port}", flush=True)
     try:
         threading.Event().wait()

@@ -648,6 +648,18 @@ MEMORY_ESTIMATE_RATIO = REGISTRY.gauge(
 KERNEL_PROFILES = REGISTRY.counter(
     "trino_kernel_profiles_total",
     "Device profile captures taken by the kernel observatory, by trigger")
+CLUSTER_WORKERS = REGISTRY.gauge(
+    "trino_cluster_workers",
+    "Workers currently registered with the membership layer, by "
+    "lifecycle state (active / draining / inactive)")
+DRAIN_DURATION = REGISTRY.histogram(
+    "trino_drain_duration_seconds",
+    "Graceful-drain wall time: POST /v1/drain to deregistration "
+    "(running tasks finished AND every dependent consumer committed)",
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
+MEMBERSHIP_TRANSITIONS = REGISTRY.counter(
+    "trino_membership_transitions_total",
+    "Membership state-machine transitions, labelled from/to")
 
 
 # ---------------------------------------------------------------------------

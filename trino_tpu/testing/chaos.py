@@ -47,7 +47,7 @@ from trino_tpu.testing.golden import (
 __all__ = [
     "CHAOS_BASE_PORT", "spawn_workers", "stop_workers",
     "make_fleet", "make_serving", "run_chaos_soak", "fired_sites",
-    "run_storage_chaos", "run_skew_chaos",
+    "run_storage_chaos", "run_skew_chaos", "run_elastic_chaos",
 ]
 
 CHAOS_BASE_PORT = 18960
@@ -507,6 +507,140 @@ def run_skew_chaos(
         "adaptive_repartitions": res.adaptive_repartitions,
         "tasks_retried": res.tasks_retried,
     })
+    return record
+
+
+def run_elastic_chaos(
+    seed: int = 0, base_port: int = 19360, spool_root: str | None = None,
+) -> dict:
+    """Elastic-fleet chaos (scale-down is not a crash): spawns its own
+    3-worker fleets at ``base_port``+ so it can drain and kill them.
+
+    Scenario ``drain-mid-query``: the zipfian-free join runs clean on
+    3 workers, then re-runs with one worker drained the moment its
+    first task lands (``post_hook`` — a deterministic mid-query point,
+    guaranteeing a task *spans* the drain). The drained worker must
+    finish that task, keep serving its exchange buffers/spool reads to
+    every consumer, and the run must come back byte-identical to the
+    clean run with ``tasks_retried == 0`` — a graceful drain is
+    invisible to the query, which is the whole contract.
+
+    Scenario ``kill-draining``: same drain point, but the DRAINING
+    worker is hard-killed immediately after — its in-flight task and
+    buffers are gone, and the existing FTE tier (poll eviction,
+    rerouted retry, first-commit-wins) must recover to oracle-exact
+    rows. Drain never replaces the crash path; it only adds a clean
+    one beside it."""
+    import tempfile
+
+    data = (
+        QueryRunner.tpch("tiny").metadata.connector("tpch")
+        .data("tiny")
+    )
+    oracle = load_tpch_sqlite(data)
+    expected = oracle.execute(to_sqlite(_JOIN_SQL)).fetchall()
+    record: dict = {"seed": seed, "runs": []}
+
+    def elastic_fleet(worker_uris, root):
+        fleet = make_fleet(worker_uris, root)
+        p = fleet.session.properties
+        p["speculation_enabled"] = False
+        p["retry_backoff_seed"] = seed
+        p["retry_initial_delay_ms"] = 5
+        p["retry_max_delay_ms"] = 20
+        return fleet
+
+    def drain(uri: str) -> None:
+        req = urllib.request.Request(
+            f"{uri}/v1/drain", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            json.loads(resp.read())
+
+    def worker_state(uri: str) -> str:
+        with urllib.request.urlopen(f"{uri}/v1/info", timeout=5) as r:
+            return json.loads(r.read()).get("state", "?")
+
+    # ---- scenario 1: graceful drain mid-query -----------------------
+    procs, uris = spawn_workers(3, base_port=base_port)
+    try:
+        root = spool_root or tempfile.mkdtemp(prefix="chaos-elastic")
+        fleet = elastic_fleet(uris, root)
+        clean = fleet.execute(_JOIN_SQL)
+        assert_rows_match(
+            clean.rows, expected, ordered=clean.ordered, abs_tol=1e-6
+        )
+
+        target = uris[-1]
+        drained: list = []
+
+        def drain_on_first_post(stage_id, task_id, worker):
+            if worker.uri == target and not drained:
+                drained.append(task_id)
+                drain(target)
+
+        fleet = elastic_fleet(uris, root)
+        fleet.post_hook = drain_on_first_post
+        res = fleet.execute(_JOIN_SQL)
+        assert drained, "no task ever landed on the drain target"
+        assert res.rows == clean.rows, (
+            "drained run is not byte-identical to the clean run"
+        )
+        assert_rows_match(
+            res.rows, expected, ordered=res.ordered, abs_tol=1e-6
+        )
+        assert res.tasks_retried == 0, (
+            f"graceful drain caused {res.tasks_retried} task retries "
+            "(drain is not a failure)"
+        )
+        final_state = worker_state(target)
+        assert final_state in ("DRAINING", "DRAINED"), final_state
+        record["runs"].append({
+            "scenario": "drain-mid-query",
+            "drained_task": drained[0],
+            "tasks_retried": res.tasks_retried,
+            "direct_bytes": sum(
+                int(st.get("direct_bytes", 0) or 0)
+                for st in res.stage_stats
+            ),
+            "drained_worker_state": final_state,
+        })
+    finally:
+        stop_workers(procs)
+
+    # ---- scenario 2: hard-kill a DRAINING worker --------------------
+    procs, uris = spawn_workers(3, base_port=base_port + 4)
+    try:
+        root = spool_root or tempfile.mkdtemp(prefix="chaos-elastic")
+        target = uris[-1]
+        target_proc = procs[-1]
+        killed: list = []
+
+        def drain_then_kill(stage_id, task_id, worker):
+            if worker.uri == target and not killed:
+                killed.append(task_id)
+                drain(target)
+                target_proc.kill()
+
+        fleet = elastic_fleet(uris, root)
+        fleet.post_hook = drain_then_kill
+        res = fleet.execute(_JOIN_SQL)
+        assert killed, "no task ever landed on the kill target"
+        assert_rows_match(
+            res.rows, expected, ordered=res.ordered, abs_tol=1e-6
+        )
+        assert res.tasks_retried >= 1, (
+            "killing a DRAINING worker mid-task must surface as an "
+            "FTE retry"
+        )
+        record["runs"].append({
+            "scenario": "kill-draining",
+            "killed_task": killed[0],
+            "tasks_retried": res.tasks_retried,
+            "workers_readmitted": res.workers_readmitted,
+        })
+    finally:
+        stop_workers(procs)
     return record
 
 
