@@ -596,6 +596,11 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         # execution) is auditable, not asserted. Ports 18970+ (bench
         # chaos owns 18980+, stage-admission 18990+).
         _serving_section(detail)
+        # cached-vs-uncached zipfian twin (BENCH_r10): the same
+        # zipf-weighted repeat-statement schedule with and without the
+        # cross-query cache tiers (trino_tpu.cache) — cached p50,
+        # hit ratio, cold-miss p99, and byte-identity. Ports 18975+.
+        _serving_cache_section(detail)
         # synthetic diurnal phase: the same closed-loop mix while the
         # fleet scales 2 -> 4 -> 2 live (membership add_worker, then
         # graceful drain), both transitions under in-flight load —
@@ -933,6 +938,106 @@ def _serving_section(detail) -> None:
         detail["serving_p95_ms"] = round(pct(0.95) * 1e3, 1)
         detail["serving_p99_ms"] = round(pct(0.99) * 1e3, 1)
         detail["serving_wall_s"] = round(wall_s, 1)
+    finally:
+        chaos_mod.stop_workers(procs)
+
+
+def _serving_cache_section(detail) -> None:
+    """Zipfian cached-vs-uncached serving A/B (the cache ROADMAP
+    item's success metric): the SAME zipf-weighted repeat-statement
+    schedule runs twice against one 2-worker fleet — first with both
+    cache tiers disabled (this round also pays every compile, so the
+    cached round's misses are true cold-cache, warm-compile numbers),
+    then with the semantic result cache + device tier on. Records
+    cached/uncached p50, the hit ratio, the cold-miss p99 (cache
+    bookkeeping must not tax misses), and row byte-identity between
+    the twins. Ports 18975+ (serving owns 18970+, chaos 18980+)."""
+    import random
+    import tempfile
+
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.testing import chaos as chaos_mod
+    from trino_tpu.testing.golden import assert_rows_match
+
+    n_stmts = int(os.environ.get("BENCH_CACHE_STATEMENTS", "24"))
+    mix = [QUERIES["q01"], QUERIES["q03"], QUERIES["q06"]]
+    # zipf-ish weights 1/rank over the mix, fixed seed: the same
+    # schedule drives both rounds so the twins are comparable
+    rng = random.Random(11)
+    weights = [1.0 / (i + 1) for i in range(len(mix))]
+    schedule = rng.choices(range(len(mix)), weights=weights, k=n_stmts)
+    # every statement appears at least once (the cold-miss sample)
+    for i in range(len(mix)):
+        if i not in schedule:
+            schedule[i] = i
+
+    def rows_match(a, b, ordered):
+        try:
+            assert_rows_match(a, b, ordered=ordered, abs_tol=0.0)
+            return True
+        except AssertionError:
+            return False
+
+    procs, uris = chaos_mod.spawn_workers(2, base_port=18975)
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench-cache-") as spool:
+
+            def run_round(cache_on: bool):
+                serving = chaos_mod.make_serving(uris, spool)
+                serving.session.properties["result_cache_enabled"] = (
+                    cache_on
+                )
+                serving.session.properties["device_cache_enabled"] = (
+                    cache_on
+                )
+                lats, hits, rows = [], [], {}
+                try:
+                    if not cache_on:
+                        for sql in mix:  # compile + scan residency
+                            serving.execute(sql)
+                    for idx in schedule:
+                        t0 = time.perf_counter()
+                        res = serving.execute(mix[idx])
+                        lats.append(time.perf_counter() - t0)
+                        cs = res.cache_stats or {}
+                        hits.append(
+                            bool((cs.get("result") or {}).get("hit"))
+                        )
+                        rows.setdefault(idx, (res.rows, res.ordered))
+                finally:
+                    serving.stop()
+                return lats, hits, rows
+
+            # uncached twin FIRST: it doubles as the compile warmup
+            base_lats, _, base_rows = run_round(False)
+            lats, hits, got_rows = run_round(True)
+
+        def pct(samples, p):
+            s = sorted(samples)
+            return s[min(int(round(p * (len(s) - 1))), len(s) - 1)]
+
+        miss_lats = [l for l, h in zip(lats, hits) if not h]
+        detail["serving_cache_statements"] = len(schedule)
+        detail["serving_uncached_p50_ms"] = round(
+            pct(base_lats, 0.50) * 1e3, 1
+        )
+        detail["serving_uncached_p99_ms"] = round(
+            pct(base_lats, 0.99) * 1e3, 1
+        )
+        detail["serving_cached_p50_ms"] = round(
+            pct(lats, 0.50) * 1e3, 1
+        )
+        detail["result_cache_hit_ratio"] = round(
+            sum(hits) / len(hits), 3
+        )
+        if miss_lats:  # cache bookkeeping overhead on true misses
+            detail["serving_cache_cold_p99_ms"] = round(
+                pct(miss_lats, 0.99) * 1e3, 1
+            )
+        detail["serving_cache_rows_identical"] = all(
+            rows_match(got_rows[i][0], base_rows[i][0], base_rows[i][1])
+            for i in base_rows
+        )
     finally:
         chaos_mod.stop_workers(procs)
 

@@ -505,3 +505,19 @@ def test_query_retries_exhausted_for_real(chaos_workers, spool_root):
     msg = str(ei.value)
     assert "2 executions" in msg
     assert "last failure" in msg
+
+
+@pytest.mark.slow
+def test_cache_chaos_kill_worker_with_pinned_entries(tmp_path):
+    """A worker holding pinned device-cache entries hard-killed
+    mid-round: the retried tasks cold-scan on the survivors, rows stay
+    oracle-exact, and the retry count matches the uncached twin —
+    cache residency neither rescues nor amplifies the failure path
+    (asserts live inside run_cache_chaos)."""
+    record = chaos.run_cache_chaos(seed=0, spool_root=str(tmp_path))
+    by_name = {r["scenario"]: r for r in record["runs"]}
+    assert by_name["kill-cached-worker"]["pinned_entries_lost"] > 0
+    assert (
+        by_name["kill-cached-worker"]["tasks_retried"]
+        == by_name["kill-uncached-worker"]["tasks_retried"]
+    )

@@ -82,6 +82,12 @@ _RATE_KEYS = [
     ("detail.serving_diurnal_low1_p99_ms", False),
     ("detail.serving_diurnal_high_p99_ms", False),
     ("detail.serving_diurnal_low2_p99_ms", False),
+    # cache keys (BENCH_r10+, ``bench.py --serving`` zipfian twin):
+    # SKIP against baselines that predate the cross-query cache tiers
+    ("detail.serving_cached_p50_ms", False),
+    ("detail.serving_uncached_p50_ms", False),
+    ("detail.result_cache_hit_ratio", True),
+    ("detail.serving_cache_cold_p99_ms", False),
 ]
 # NOT banded: the per-query ``detail.{q}_time_breakdown`` dicts
 # (BENCH_r08+, flight recorder) are informational — dict-valued and

@@ -1,0 +1,720 @@
+"""Memory-governed cross-query caching: HBM hot-data + semantic results.
+
+At serving scale the dominant traffic is repeated — the same dashboard
+statements, the same dimension tables, the same build-side hash tables
+re-materialized host->device on every query. The reference engine has
+no device memory to exploit; this build does. Two tiers share this
+module, both evictable under pressure through the MemoryContext /
+MemoryPool machinery (memory.py):
+
+* :class:`DeviceTableCache` — device-resident Pages pinned in HBM
+  across queries: pruned/split scan outputs and *built* join-side
+  pages, keyed by connector fingerprint + column set + pushed domains
+  (+ the canonical subtree hash for fragments). Residency is reserved
+  on a dedicated ``"cache"`` context of the worker's MemoryPool and the
+  cache registers itself as a *revoker*: a query reservation that would
+  breach ``query_max_memory_per_node`` evicts cache entries first and
+  only raises if eviction cannot free enough — cached bytes can never
+  turn into an ``ExceededMemoryLimitError`` for the query.
+
+* :class:`SemanticResultCache` — host-side byte-bounded LRU over final
+  result rows, keyed by a canonical plan hash (the blake2b trick the
+  StringDictionary uses for cross-query program identity, generalized
+  to whole optimized plans via ``plan_to_json``) plus session
+  properties. Byte-identical repeat statements are served without
+  planning a fragment or dispatching a task.
+
+Staleness is governed by a generation counter per (connector
+fingerprint, schema, table): every DML/invalidate path bumps it, and
+entries carry the generations (plus connector ``table_version``) they
+were built under — a probe revalidates and drops stale entries instead
+of serving rows observed before a write.
+
+Connector *fingerprints* fix the identity-keying defect of the original
+scan caches: a connector may implement ``cache_fingerprint()``
+returning ``(ident, content)`` — ``ident`` names the underlying data
+(e.g. a parquet root path) so two connector instances over the same
+files share entries, and ``content`` digests what is actually on disk
+(footer sizes + mtimes) so an out-of-band rewrite busts them. Without
+the hook, a per-instance token preserves the old isolation contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+__all__ = [
+    "connector_fingerprint",
+    "GENERATIONS",
+    "DeviceTableCache",
+    "SemanticResultCache",
+    "CacheStats",
+    "plan_digest",
+    "plan_scan_tables",
+    "table_tokens",
+    "copy_page",
+    "DEVICE",
+]
+
+
+# ---- connector fingerprints -------------------------------------------------
+
+_IDENT_LOCK = threading.Lock()
+_IDENT_SEQ = itertools.count(1)
+
+
+def _instance_ident(connector) -> str:
+    """Per-instance identity token (monotonic, never reused — unlike
+    ``id()`` after a GC) for connectors without a content hook."""
+    tok = getattr(connector, "_cache_ident", None)
+    if tok is None:
+        with _IDENT_LOCK:
+            tok = getattr(connector, "_cache_ident", None)
+            if tok is None:
+                tok = f"id:{next(_IDENT_SEQ)}"
+                try:
+                    connector._cache_ident = tok
+                except Exception:
+                    return f"id:{id(connector)}"
+    return tok
+
+
+def connector_fingerprint(connector) -> tuple[str, str]:
+    """``(ident, content)`` cache identity for one connector.
+
+    ``ident`` keys cache storage: equal idents share entries. ``content``
+    is a change-sensitive digest compared on every lookup — a mismatch
+    (files rewritten out-of-band) invalidates everything stored under
+    the ident. Connectors opt in by implementing ``cache_fingerprint()``
+    (returning ``(ident, content)`` or a bare ident string); the
+    fallback is a per-instance token, preserving the historical
+    isolation contract of identity keying."""
+    hook = getattr(connector, "cache_fingerprint", None)
+    if hook is not None:
+        try:
+            out = hook()
+        except Exception:
+            out = None
+        if out:
+            if isinstance(out, tuple) and len(out) == 2:
+                return str(out[0]), str(out[1])
+            return str(out), ""
+    return _instance_ident(connector), ""
+
+
+# ---- generation counters ----------------------------------------------------
+
+class GenerationCounter:
+    """Monotonic write generation per (ident, schema, table).
+
+    The explicit invalidation API of the cache subsystem: every DML /
+    invalidate_scan path bumps the generation, and both tiers store the
+    generations their entries were built under. A probe whose current
+    generation differs drops the entry — no scan-time coordination with
+    writers is needed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gen: dict[tuple, int] = {}
+
+    def get(self, ident: str, schema: str, table: str) -> int:
+        with self._lock:
+            return self._gen.get((ident, schema, table), 0)
+
+    def bump(self, ident: str, schema: str, table: str) -> int:
+        with self._lock:
+            g = self._gen.get((ident, schema, table), 0) + 1
+            self._gen[(ident, schema, table)] = g
+            return g
+
+
+GENERATIONS = GenerationCounter()
+
+
+# ---- per-query stats --------------------------------------------------------
+
+@dataclass
+class CacheStats:
+    """Per-query cache traffic, surfaced on ``QueryResult.cache_stats``
+    and as the EXPLAIN ANALYZE ``Cache:`` line. The device tier is
+    recorded by whichever executor ran the scans — in fleet mode those
+    live worker-side, so coordinator-visible device numbers cover only
+    the embedded/local path."""
+
+    result_hit: bool | None = None
+    result_bytes: int = 0
+    device_hits: int = 0
+    device_misses: int = 0
+    device_bytes: int = 0
+
+    def record_device(self, hit: bool, nbytes: int = 0) -> None:
+        if hit:
+            self.device_hits += 1
+            self.device_bytes += nbytes
+        else:
+            self.device_misses += 1
+
+    def as_dict(self) -> dict:
+        return {
+            "result": {
+                "hit": self.result_hit,
+                "bytes": self.result_bytes,
+            },
+            "device": {
+                "hits": self.device_hits,
+                "misses": self.device_misses,
+                "bytes": self.device_bytes,
+            },
+        }
+
+    def explain_line(self) -> str:
+        from trino_tpu.memory import format_bytes
+
+        if self.result_hit:
+            res = f"result hit ({format_bytes(self.result_bytes)})"
+        elif self.result_hit is None:
+            res = "result off"
+        else:
+            res = "result miss"
+        dev = (
+            f"device {self.device_hits} hit / "
+            f"{self.device_misses} miss"
+        )
+        if self.device_bytes:
+            dev += f" ({format_bytes(self.device_bytes)})"
+        return f"Cache: {res}; {dev}"
+
+
+# ---- plan fingerprinting ----------------------------------------------------
+
+#: calls whose output depends on more than their inputs; plans using
+#: them are never cached (none are registered today — future-proofing)
+_NONDETERMINISTIC_CALLS = frozenset(
+    {"random", "rand", "now", "uuid", "current_timestamp"}
+)
+
+
+def _json_calls(j) -> bool:
+    """True when the serialized plan references a nondeterministic
+    call anywhere (expressions serialize as {"k": "call", "n": name})."""
+    if isinstance(j, dict):
+        if j.get("k") == "call" and j.get("n") in _NONDETERMINISTIC_CALLS:
+            return True
+        return any(_json_calls(v) for v in j.values())
+    if isinstance(j, list):
+        return any(_json_calls(v) for v in j)
+    return False
+
+
+def plan_digest(plan, session) -> str | None:
+    """Canonical semantic hash of an optimized plan (sub)tree.
+
+    blake2b over the sorted-key JSON codec (plan/serde.py) — operators,
+    symbols, pushed domains, literals — plus every session property, so
+    two sessions that could execute differently never share an entry.
+    Returns None for plans the codec cannot serialize (those are not
+    cacheable)."""
+    from trino_tpu.plan.serde import plan_to_json
+
+    try:
+        j = plan_to_json(plan)
+    except (TypeError, ValueError):
+        return None
+    if _json_calls(j):
+        return None
+    props = {
+        str(k): repr(v)
+        for k, v in (getattr(session, "properties", None) or {}).items()
+    }
+    payload = json.dumps(
+        {"plan": j, "props": props}, sort_keys=True, default=str
+    )
+    return hashlib.blake2b(
+        payload.encode("utf-8"), digest_size=16
+    ).hexdigest()
+
+
+def plan_scan_tables(plan) -> list[tuple[str, str, str]] | None:
+    """Distinct (catalog, schema, table) scanned by a plan, or None
+    when the plan cannot be serialized (treat as uncacheable)."""
+    from trino_tpu.plan.serde import plan_to_json
+
+    try:
+        j = plan_to_json(plan)
+    except (TypeError, ValueError):
+        return None
+    out: list[tuple[str, str, str]] = []
+    opaque = []
+
+    def walk(d):
+        if isinstance(d, dict):
+            kind = d.get("kind")
+            if kind == "TableScan":
+                t = (d["catalog"], d["schema"], d["table"])
+                if t not in out:
+                    out.append(t)
+            elif kind == "RemoteSource":
+                # reads another stage's exchange output: the subtree
+                # hash does not address its DATA, so nothing under a
+                # RemoteSource is ever cacheable
+                opaque.append(kind)
+            for v in d.values():
+                walk(v)
+        elif isinstance(d, list):
+            for v in d:
+                walk(v)
+
+    walk(j)
+    if opaque:
+        return None
+    return out
+
+
+def table_tokens(plan, metadata) -> tuple | None:
+    """Staleness validators for every table a plan scans: one
+    ``(ident, schema, table, generation, table_version)`` tuple per
+    scan. None when any scanned connector is uncacheable (live system
+    views) or unresolvable — the caller must then skip caching."""
+    tables = plan_scan_tables(plan)
+    if tables is None:
+        return None
+    toks = []
+    for cat, sch, tab in tables:
+        try:
+            conn = metadata.connector(cat)
+        except Exception:
+            return None
+        if conn is None or not getattr(conn, "cacheable", True):
+            return None
+        ident, _content = connector_fingerprint(conn)
+        try:
+            version = conn.table_version(sch, tab)
+        except Exception:
+            version = 0
+        toks.append(
+            (ident, sch, tab, GENERATIONS.get(ident, sch, tab), version)
+        )
+    return tuple(toks)
+
+
+# ---- device tier ------------------------------------------------------------
+
+def page_device_bytes(page) -> int:
+    """Device bytes pinned by one cached Page (columns + validity)."""
+    total = getattr(page.mask, "nbytes", 0) or 0
+    for c in page.columns:
+        total += getattr(c.data, "nbytes", 0) or 0
+        if getattr(c, "valid", None) is not None:
+            total += getattr(c.valid, "nbytes", 0) or 0
+    return total
+
+
+def copy_page(page):
+    """Shallow copy safe to hand to an executor: operators replace
+    entries in ``page.columns`` in place (join dictionary unification),
+    so cached pages must never escape by reference."""
+    from trino_tpu.page import Page
+
+    return Page(
+        list(page.names), list(page.columns), page.mask,
+        known_rows=page.known_rows, packed=page.packed,
+    )
+
+
+@dataclass
+class _DeviceEntry:
+    page: object
+    nbytes: int
+    #: ((ident, schema, table, generation, version), ...) validators
+    tokens: tuple
+    #: MemoryContext holding this entry's reservation (None = untracked)
+    ctx: object = None
+
+
+class DeviceTableCache:
+    """HBM-resident cross-query Page cache with pool-governed eviction.
+
+    Keys are content-addressed: scans key on connector fingerprint +
+    columns + pushed domains (+ split range), fragments on the
+    canonical subtree hash — so sharing is safe across executors and
+    staleness reduces to generation/content checks. Residency is
+    reserved on the owning pool's ``"cache"`` context via
+    ``try_reserve`` (never raising into a query) and the cache is
+    registered as the pool's revoker: queries under pressure evict
+    entries LRU-first before their own reservation can fail."""
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _DeviceEntry]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        #: id(pool) -> (pool, cache MemoryContext); revoker registered once
+        self._pools: dict[int, tuple] = {}
+
+    # -- keys ----------------------------------------------------------------
+
+    @staticmethod
+    def scan_key(
+        connector, schema: str, table: str, columns,
+        domains=None, split=None,
+    ) -> tuple | None:
+        """Key for a scanned Page; None when the connector opted out of
+        caching. Pushed domains are part of the key (a pruned row set
+        is filter-specific); the connector content digest and
+        table_version fold in so external rewrites miss naturally."""
+        if not getattr(connector, "cacheable", True):
+            return None
+        ident, content = connector_fingerprint(connector)
+        try:
+            version = connector.table_version(schema, table)
+        except Exception:
+            version = 0
+        dkey = None
+        if domains:
+            dkey = tuple(sorted(
+                (c, repr(dom)) for c, dom in domains.items()
+            ))
+        skey = None
+        if split is not None:
+            skey = (split.start, split.count)
+        return (
+            "scan", ident, content, schema, table,
+            tuple(columns), dkey, skey, version,
+        )
+
+    @staticmethod
+    def frag_key(digest: str) -> tuple:
+        """Key for a built plan-fragment Page (join build side)."""
+        return ("frag", digest)
+
+    # -- pool attachment -----------------------------------------------------
+
+    def _ctx(self, pool):
+        if pool is None:
+            return None
+        with self._lock:
+            ent = self._pools.get(id(pool))
+            if ent is not None:
+                return ent[1]
+        # register outside our lock: query_context takes the pool lock
+        ctx = pool.query_context("cache")
+        add = getattr(pool, "add_revoker", None)
+        if add is not None:
+            add(self.revoke)
+        with self._lock:
+            self._pools.setdefault(id(pool), (pool, ctx))
+            return self._pools[id(pool)][1]
+
+    # -- traffic -------------------------------------------------------------
+
+    def get(self, key, stats: CacheStats | None = None):
+        """Resident Page for ``key`` (a shallow copy) or None. Stale
+        entries (bumped generation) are dropped on probe."""
+        from trino_tpu import telemetry
+
+        if key is None:
+            return None
+        freed = None
+        try:
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None and not self._tokens_current(e.tokens):
+                    freed = self._pop_locked(key)
+                    e = None
+                if e is None:
+                    self.misses += 1
+                    if stats is not None:
+                        stats.record_device(False)
+                    return None
+                self._entries.move_to_end(key)
+                self.hits += 1
+                if stats is not None:
+                    stats.record_device(True, e.nbytes)
+                return copy_page(e.page)
+        finally:
+            self._free_entries(freed)
+            telemetry.DEVICE_CACHE_ENTRIES.set(len(self._entries))
+            telemetry.DEVICE_CACHE_BYTES.set(self._bytes)
+
+    def put(self, key, page, tokens: tuple | None, pool=None) -> bool:
+        """Pin one Page. Reserves its device bytes on the pool's cache
+        context (``try_reserve`` — under pressure the pool's revokers,
+        including this cache, shed bytes first; if residency still
+        doesn't fit the page simply isn't cached). Returns True when
+        the page is resident after the call."""
+        from trino_tpu import telemetry
+
+        if key is None or tokens is None:
+            return False
+        nbytes = page_device_bytes(page)
+        if nbytes > self.max_bytes:
+            return False
+        ctx = self._ctx(pool)
+        if ctx is not None and not ctx.try_reserve(nbytes):
+            return False
+        evicted = []
+        with self._lock:
+            old = self._pop_locked(key)
+            if old:
+                evicted.extend(old)
+            self._entries[key] = _DeviceEntry(
+                copy_page(page), nbytes, tokens, ctx
+            )
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                k = next(iter(self._entries))
+                evicted.extend(self._pop_locked(k))
+                self.evictions += 1
+                telemetry.DEVICE_CACHE_EVICTIONS.inc()
+        self._free_entries(evicted)
+        telemetry.DEVICE_CACHE_ENTRIES.set(len(self._entries))
+        telemetry.DEVICE_CACHE_BYTES.set(self._bytes)
+        return True
+
+    # -- eviction / invalidation --------------------------------------------
+
+    def _tokens_current(self, tokens: tuple) -> bool:
+        return all(
+            GENERATIONS.get(ident, sch, tab) == gen
+            for ident, sch, tab, gen, _version in tokens
+        )
+
+    def _pop_locked(self, key) -> list:
+        """Remove one entry under the lock; returns it for the caller
+        to free OUTSIDE the lock (freeing takes the pool lock)."""
+        e = self._entries.pop(key, None)
+        if e is None:
+            return []
+        self._bytes -= e.nbytes
+        return [e]
+
+    def _free_entries(self, entries) -> None:
+        for e in entries or ():
+            if e.ctx is not None:
+                e.ctx.free(e.nbytes)
+
+    def revoke(self, nbytes: int) -> int:
+        """MemoryPool revoker: shed at least ``nbytes`` LRU-first so a
+        query reservation under pressure succeeds instead of raising.
+        Called outside the pool lock; returns bytes freed."""
+        from trino_tpu import telemetry
+
+        victims = []
+        freed = 0
+        with self._lock:
+            while self._entries and freed < nbytes:
+                k = next(iter(self._entries))
+                popped = self._pop_locked(k)
+                for e in popped:
+                    freed += e.nbytes
+                victims.extend(popped)
+                self.evictions += 1
+                telemetry.DEVICE_CACHE_EVICTIONS.inc()
+        self._free_entries(victims)
+        telemetry.DEVICE_CACHE_ENTRIES.set(len(self._entries))
+        telemetry.DEVICE_CACHE_BYTES.set(self._bytes)
+        return freed
+
+    def invalidate(self, ident: str, schema: str, table: str) -> None:
+        """Drop every entry built over one table (DML path; callers
+        bump GENERATIONS too so remote tiers revalidate)."""
+        victims = []
+        with self._lock:
+            dead = [
+                k for k, e in self._entries.items()
+                if any(
+                    t[0] == ident and t[1] == schema and t[2] == table
+                    for t in e.tokens
+                )
+            ]
+            for k in dead:
+                victims.extend(self._pop_locked(k))
+        self._free_entries(victims)
+
+    def clear(self) -> None:
+        victims = []
+        with self._lock:
+            for k in list(self._entries):
+                victims.extend(self._pop_locked(k))
+        self._free_entries(victims)
+
+    # -- observability -------------------------------------------------------
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+# ---- result tier ------------------------------------------------------------
+
+def _rows_nbytes(names, rows) -> int:
+    """Cheap result-size estimate (python tuples of scalars)."""
+    per_cell = 24
+    header = 64
+    return header + len(names) * 16 + len(rows) * (
+        56 + len(names) * per_cell
+    )
+
+
+@dataclass
+class _ResultEntry:
+    names: list
+    rows: list
+    ordered: bool
+    nbytes: int
+    tokens: tuple = field(default_factory=tuple)
+
+
+class SemanticResultCache:
+    """Byte-bounded LRU over final result rows, keyed by plan digest.
+
+    Instances are scoped to one runner (a ServingRunner shares one
+    across its per-query FleetRunners; a long-lived QueryRunner owns
+    its own) rather than process-global: cache visibility then matches
+    session lifetime, and concurrent unrelated runners — fault-injection
+    twins, A/B benches — never observe each other's entries."""
+
+    def __init__(self, max_bytes: int = 64 << 20):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _ResultEntry]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, digest: str, tokens: tuple | None) -> _ResultEntry | None:
+        """Validated entry for one plan digest. ``tokens`` are the
+        CURRENT staleness validators (table_tokens at probe time); a
+        mismatch with the stored ones drops the entry — the generation
+        counter made it stale."""
+        from trino_tpu import telemetry
+
+        with self._lock:
+            e = self._entries.get(digest)
+            if e is not None and (tokens is None or e.tokens != tokens):
+                self._entries.pop(digest)
+                self._bytes -= e.nbytes
+                e = None
+            if e is None:
+                self.misses += 1
+                telemetry.RESULT_CACHE_MISSES.inc()
+                telemetry.RESULT_CACHE_BYTES.set(self._bytes)
+                return None
+            self._entries.move_to_end(digest)
+            self.hits += 1
+            telemetry.RESULT_CACHE_HITS.inc()
+            return _ResultEntry(
+                list(e.names), list(e.rows), e.ordered, e.nbytes, e.tokens
+            )
+
+    def put(
+        self, digest: str, names, rows, ordered: bool,
+        tokens: tuple | None,
+    ) -> bool:
+        from trino_tpu import telemetry
+
+        if tokens is None:
+            return False
+        nbytes = _rows_nbytes(names, rows)
+        if nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(digest, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[digest] = _ResultEntry(
+                list(names), list(rows), bool(ordered), nbytes, tokens
+            )
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, ev = self._entries.popitem(last=False)
+                self._bytes -= ev.nbytes
+                self.evictions += 1
+            telemetry.RESULT_CACHE_BYTES.set(self._bytes)
+        return True
+
+    def invalidate_all(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+#: the process-wide device tier every LocalExecutor pins through (keys
+#: are content-addressed, so sharing across executors is always safe)
+DEVICE = DeviceTableCache()
+
+#: result-cache instances alive in this process (ServingRunner /
+#: QueryRunner registrants) — feeds system.runtime.caches
+_RESULT_INSTANCES: "weakref.WeakSet" = None  # set lazily below
+
+
+def _result_instances():
+    global _RESULT_INSTANCES
+    if _RESULT_INSTANCES is None:
+        import weakref
+
+        _RESULT_INSTANCES = weakref.WeakSet()
+    return _RESULT_INSTANCES
+
+
+def register_result_cache(cache: SemanticResultCache) -> SemanticResultCache:
+    _result_instances().add(cache)
+    return cache
+
+
+def result_tier_snapshot() -> dict:
+    """Aggregated stats across every live result-cache instance."""
+    agg = {
+        "entries": 0, "bytes": 0, "max_bytes": 0,
+        "hits": 0, "misses": 0, "evictions": 0,
+    }
+    for c in list(_result_instances()):
+        s = c.snapshot()
+        for k in agg:
+            agg[k] += s[k]
+    return agg

@@ -141,6 +141,32 @@ class ParquetConnector(Connector):
         #: SPI/metrics/Metrics.java)
         self.scan_metrics: dict = {}
 
+    def cache_fingerprint(self):
+        """``(ident, content)`` for the cross-query caches (cache.py):
+        the absolute root path names the data — two connector instances
+        over the same files share cache entries — and the content
+        digest (relative path + size + mtime_ns of every parquet file)
+        busts them when anything on disk is rewritten out-of-band."""
+        import hashlib
+
+        root = os.path.abspath(self.root)
+        h = hashlib.blake2b(digest_size=12)
+        try:
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames.sort()
+                for fn in sorted(filenames):
+                    if not fn.endswith(".parquet"):
+                        continue
+                    p = os.path.join(dirpath, fn)
+                    st = os.stat(p)
+                    rel = os.path.relpath(p, root)
+                    h.update(
+                        f"{rel}:{st.st_size}:{st.st_mtime_ns};".encode()
+                    )
+        except OSError:
+            return None  # unreadable root: per-instance isolation
+        return f"parquet:{root}", h.hexdigest()
+
     def _file_path(self, schema: str, table: str) -> str:
         return os.path.join(self.root, schema, f"{table}.parquet")
 

@@ -103,6 +103,20 @@ _PROGRAMS_SCHEMA = TableSchema("programs", [
 ])
 
 
+#: cross-query cache subsystem state (cache.py + exec/scan_cache.py):
+#: one row per tier — resident entries/bytes, configured bound, and
+#: lifetime hit/miss/eviction counters
+_CACHES_SCHEMA = TableSchema("caches", [
+    ("tier", T.VARCHAR),
+    ("entries", T.BIGINT),
+    ("bytes", T.BIGINT),
+    ("max_bytes", T.BIGINT),
+    ("hits", T.BIGINT),
+    ("misses", T.BIGINT),
+    ("evictions", T.BIGINT),
+])
+
+
 class SystemConnector(Connector):
     """Read-only views over live engine state. ``source`` is the
     owning Coordinator (queries) and/or runner (nodes); either may be
@@ -121,7 +135,7 @@ class SystemConnector(Connector):
         if schema == "runtime":
             return [
                 "queries", "nodes", "memory", "tasks",
-                "cluster_metrics", "programs",
+                "cluster_metrics", "programs", "caches",
             ]
         return []
 
@@ -140,6 +154,8 @@ class SystemConnector(Connector):
             return _CLUSTER_METRICS_SCHEMA
         if table == "programs":
             return _PROGRAMS_SCHEMA
+        if table == "caches":
+            return _CACHES_SCHEMA
         raise KeyError(f"{schema}.{table}")
 
     def _query_rows(self):
@@ -307,6 +323,33 @@ class SystemConnector(Connector):
             ))
         return out
 
+    def _cache_rows(self):
+        from trino_tpu import cache as cache_mod
+        from trino_tpu.exec import scan_cache
+
+        res = cache_mod.result_tier_snapshot()
+        dev = cache_mod.DEVICE.snapshot()
+        pages = scan_cache.SHARED.snapshot()
+        return [
+            (
+                "result", res["entries"], res["bytes"], res["max_bytes"],
+                res["hits"], res["misses"], res["evictions"],
+            ),
+            (
+                "device", dev["entries"], dev["bytes"], dev["max_bytes"],
+                dev["hits"], dev["misses"], dev["evictions"],
+            ),
+            (
+                "scan_pages", pages["entries"], pages["bytes"], 0,
+                0, 0, 0,
+            ),
+            (
+                "split_batches", len(scan_cache.SHARED_SPLITS),
+                scan_cache.SHARED_SPLITS.resident_bytes,
+                scan_cache.SHARED_SPLITS.max_bytes, 0, 0, 0,
+            ),
+        ]
+
     def _rows(self, table: str):
         if table == "queries":
             return self._query_rows()
@@ -318,6 +361,8 @@ class SystemConnector(Connector):
             return self._cluster_metric_rows()
         if table == "programs":
             return self._program_rows()
+        if table == "caches":
+            return self._cache_rows()
         return self._node_rows()
 
     def row_count(self, schema: str, table: str) -> int:

@@ -585,13 +585,30 @@ class ServingRunner:
         #: MembershipRegistry once attach_membership() wires one in
         self.membership = None
         self.mesh = None  # duck-typing parity with QueryRunner
+        # caching defaults ON for serving (the ISSUE's A/B switch:
+        # session-level off is one SET SESSION away; explicit False in
+        # the caller's session is respected) — repeat-dominated traffic
+        # is exactly what the serving layer exists for
+        from trino_tpu import cache as cache_mod
+
+        self.session.properties.setdefault("result_cache_enabled", True)
+        self.session.properties.setdefault("device_cache_enabled", True)
+        #: ONE semantic result cache shared by every per-query
+        #: FleetRunner this facade builds — the cross-query tier
+        from trino_tpu import session_properties as sp
+
+        self.result_cache = cache_mod.register_result_cache(
+            cache_mod.SemanticResultCache(
+                int(sp.get(self.session, "result_cache_max_bytes"))
+            )
+        )
 
     # -- per-query machinery ------------------------------------------------
 
     def _make_runner(self, group) -> object:
         from trino_tpu.server.fleet import FleetRunner
 
-        return FleetRunner(
+        fr = FleetRunner(
             [w.uri for w in self.workers],
             self.metadata,
             self.session,
@@ -606,6 +623,10 @@ class ServingRunner:
             group_weight=group.weight,
             **self._fleet_kwargs,
         )
+        # per-query runners come and go; results persist on the shared
+        # serving-scope cache so repeat statements across them hit
+        fr.result_cache = self.result_cache
+        return fr
 
     def execute(
         self,

@@ -90,6 +90,10 @@ class QueryResult:
     #: capture (kernel_profile.attribute summary); None unless the
     #: session property was ON/AUTO and the capture succeeded
     kernel_profile: dict | None = field(default=None, repr=False)
+    #: per-query cache traffic (cache.CacheStats.as_dict()): result-tier
+    #: hit/miss + bytes and device-tier hits/misses/bytes; None when
+    #: both tiers were disabled for the statement
+    cache_stats: dict | None = field(default=None, repr=False)
 
     @property
     def query_info(self) -> dict | None:
@@ -144,6 +148,19 @@ class QueryRunner:
             self.executor = MeshExecutor(self.metadata, self.session, mesh)
         else:
             self.executor = LocalExecutor(self.metadata, self.session)
+        # per-runner semantic result cache (cache.py): repeat statements
+        # on one long-lived runner hit; unrelated runners never share.
+        # The serving layer overrides this with its own shared instance
+        from trino_tpu import cache as _cache
+        from trino_tpu import session_properties
+
+        self.result_cache = _cache.register_result_cache(
+            _cache.SemanticResultCache(
+                int(session_properties.get(
+                    self.session, "result_cache_max_bytes"
+                ))
+            )
+        )
 
     @staticmethod
     def tpch(schema: str = "tiny", mesh=None) -> "QueryRunner":
@@ -241,6 +258,15 @@ class QueryRunner:
             from trino_tpu.plan.stats import annotate
 
             plan = annotate(plan, self.metadata, self.session)
+        if optimized and session_properties.get(
+            self.session, "result_cache_enabled"
+        ):
+            # semantic fingerprint of the OPTIMIZED tree (post-annotate,
+            # so the hash covers what will actually execute); pure
+            # read-side derivation, safe under plan_validation=FULL
+            from trino_tpu import cache as _cache
+
+            plan._semantic_hash = _cache.plan_digest(plan, self.session)
         max_plan_s = session_properties.parse_duration(
             session_properties.get(self.session, "query_max_planning_time")
         )
@@ -302,6 +328,13 @@ class QueryRunner:
             )
             prev_prof = self.executor.profiler
             self.executor.profiler = prof = OperatorProfiler()
+            from trino_tpu import cache as cache_mod
+
+            prev_cstats = getattr(self.executor, "cache_stats", None)
+            prev_self_cstats = getattr(self, "_cache_stats", None)
+            cstats = cache_mod.CacheStats()
+            self._cache_stats = cstats
+            self.executor.cache_stats = cstats
             kp_mode = str(
                 session_properties.get(self.session, "kernel_profile")
                 or "OFF"
@@ -337,6 +370,14 @@ class QueryRunner:
                 self.executor.deadline = None
                 self.executor.memory_ctx = prev_ctx
                 self.executor.profiler = prev_prof
+                self.executor.cache_stats = prev_cstats
+                self._cache_stats = prev_self_cstats
+                if result is not None and result.cache_stats is None and (
+                    cstats.result_hit is not None
+                    or cstats.device_hits
+                    or cstats.device_misses
+                ):
+                    result.cache_stats = cstats.as_dict()
                 plan_ms = self._plan_ms
                 self._tracer = prev_tracer
                 self._plan_ms = prev_plan_ms
@@ -626,6 +667,20 @@ class QueryRunner:
             self.executor.invalidate_scan(cat, sch, tab)
             return QueryResult(["result"], [("DROP TABLE",)])
         plan = self.plan_stmt(stmt)
+        rcache, digest, tokens = self._result_cache_probe(plan)
+        cstats = getattr(self, "_cache_stats", None)
+        if rcache is not None:
+            hit = rcache.get(digest, tokens)
+            if hit is not None:
+                if cstats is not None:
+                    cstats.result_hit = True
+                    cstats.result_bytes = hit.nbytes
+                return QueryResult(
+                    names=hit.names, rows=hit.rows,
+                    ordered=hit.ordered, plan=plan,
+                )
+            if cstats is not None:
+                cstats.result_hit = False
         tracer = getattr(self, "_tracer", None)
         exec_span = (
             tracer.span("execute", "execution") if tracer is not None
@@ -659,12 +714,31 @@ class QueryRunner:
         finally:
             self.executor._defer_ok = False
         ordered = _has_order(plan)
+        if rcache is not None:
+            rcache.put(digest, list(page.names), rows, ordered, tokens)
         return QueryResult(
             names=list(page.names),
             rows=rows,
             ordered=ordered,
             plan=plan,
         )
+
+    def _result_cache_probe(self, plan):
+        """``(cache, digest, tokens)`` when this plan is result-
+        cacheable under the current session; ``(None, None, None)``
+        otherwise (property off, unserializable plan, or a scan over an
+        uncacheable live connector)."""
+        from trino_tpu import cache as cache_mod, session_properties
+
+        if not session_properties.get(self.session, "result_cache_enabled"):
+            return None, None, None
+        digest = getattr(plan, "_semantic_hash", None)
+        if digest is None:
+            return None, None, None
+        tokens = cache_mod.table_tokens(plan, self.metadata)
+        if tokens is None:
+            return None, None, None
+        return self.result_cache, digest, tokens
 
     # ---- DDL / DML (DataDefinitionExecution + TableWriter analog,
     # MAIN/execution/CreateTableTask.java, MAIN/operator/TableWriterOperator.java)
@@ -945,6 +1019,15 @@ class QueryRunner:
                 f"({ex.memory_pool.node_id}: "
                 f"{_fmt_bytes(peak_bytes)})"
             )
+        _cs = getattr(self, "_cache_stats", None)
+        if _cs is not None and (
+            _cs.result_hit is not None
+            or _cs.device_hits or _cs.device_misses
+        ):
+            # per-query cache traffic (hit/miss + bytes per tier); the
+            # result tier never serves EXPLAIN ANALYZE itself (analyze
+            # must execute) but its probe state still renders here
+            lines.append(_cs.explain_line())
         if xstats is not None and xstats["exchanges"] > x0["exchanges"]:
             # distributed exchange telemetry (the reference surfaces
             # per-stage exchange bytes in EXPLAIN ANALYZE the same way)

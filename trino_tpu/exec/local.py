@@ -150,6 +150,9 @@ class LocalExecutor:
         #: lazy cost cache; None records an analysis that failed so it
         #: is never retried)
         self._chain_costs: dict = {}
+        #: per-query cache.CacheStats sink (set by the engine around
+        #: each statement; None = device-tier traffic not attributed)
+        self.cache_stats = None
 
     def hbm_budget(self) -> int:
         """Device-memory budget in bytes (session ``hbm_budget_bytes``;
@@ -198,11 +201,43 @@ class LocalExecutor:
             scan_cache.SHARED_SPLITS.invalidate(connector, schema, table)
             if hasattr(connector, "invalidate"):
                 connector.invalidate(schema, table)
+            # cross-query cache tiers: bump the generation counter so
+            # device pages and semantic results built over the old data
+            # revalidate stale on their next probe, and drop this
+            # process's pinned device entries eagerly
+            from trino_tpu import cache as xcache
+
+            ident, _content = xcache.connector_fingerprint(connector)
+            xcache.GENERATIONS.bump(ident, schema, table)
+            xcache.DEVICE.invalidate(ident, schema, table)
         for k in [
             k for k in self._jit_cache
             if isinstance(k, tuple) and k and k[0] in ("selectivity", "caps")
         ]:
             del self._jit_cache[k]
+
+    def _device_cache_on(self) -> bool:
+        """Session gate for the cross-query HBM tier (cache.DEVICE)."""
+        from trino_tpu import session_properties as SP
+
+        try:
+            return bool(SP.get(self.session, "device_cache_enabled"))
+        except Exception:
+            return False
+
+    def _cache_tokens(self, connector, schema: str, table: str) -> tuple:
+        """Single-table staleness validators for a device-cache entry."""
+        from trino_tpu import cache as xcache
+
+        ident, _content = xcache.connector_fingerprint(connector)
+        try:
+            version = connector.table_version(schema, table)
+        except Exception:
+            version = 0
+        return ((
+            ident, schema, table,
+            xcache.GENERATIONS.get(ident, schema, table), version,
+        ),)
 
     def _check_cancel(self):
         if self.cancel_event is not None and self.cancel_event.is_set():
@@ -925,9 +960,35 @@ class LocalExecutor:
         """Scan with TupleDomain pushdown: the connector prunes storage
         units (parquet rowgroups) by footer stats; the filter above
         re-applies, so results stay exact (PushPredicateIntoTableScan +
-        rowgroup pruning, lib/trino-parquet/.../reader/ParquetReader.java:85)."""
+        rowgroup pruning, lib/trino-parquet/.../reader/ParquetReader.java:85).
+
+        With ``device_cache_enabled``, the pruned device page is pinned
+        in the cross-query HBM tier keyed by connector fingerprint +
+        assignments + the pushed domains (a pruned row set is
+        filter-specific, so the domains ARE the key — this closes the
+        historical cache bypass for domain-pushdown scans)."""
         from trino_tpu.connectors.base import ColumnDomain
 
+        dkey = tokens = None
+        if self._device_cache_on():
+            from trino_tpu import cache as xcache
+
+            hashed = set(node.hash_varchar or [])
+            dkey = xcache.DEVICE.scan_key(
+                connector, node.schema, node.table,
+                tuple(
+                    (s, c, s in hashed)
+                    for s, c in node.assignments.items()
+                ),
+                domains=node.domains,
+            )
+            if dkey is not None:
+                hit = xcache.DEVICE.get(dkey, self.cache_stats)
+                if hit is not None:
+                    return hit
+                tokens = self._cache_tokens(
+                    connector, node.schema, node.table
+                )
         domains = {
             c: ColumnDomain(*dom) for c, dom in node.domains.items()
         }
@@ -966,19 +1027,49 @@ class LocalExecutor:
             ))
         mask = np.zeros(cap, dtype=np.bool_)
         mask[:n] = True
-        return Page(
+        page = Page(
             names, columns, jnp.asarray(mask), known_rows=n, packed=True,
         )
+        if dkey is not None and tokens is not None:
+            from trino_tpu import cache as xcache
+
+            xcache.DEVICE.put(dkey, page, tokens, pool=self.memory_pool)
+        return page
 
     def _scan_split(self, node: P.TableScan) -> Page:
         """Scan one row-range split of a table (fleet-mode source
-        parallelism). Split scans are not device-cached: a worker sees
-        a different split per task, and fleet tables are read once per
-        stage wave."""
+        parallelism). With ``device_cache_enabled`` the split page is
+        pinned in the cross-query HBM tier keyed by connector
+        fingerprint + split range + assignments + pushed domains, so a
+        serving worker re-assigned the same split on a repeat statement
+        pays no host->device transfer; otherwise split scans stay
+        uncached (a worker sees a different split per task, and fleet
+        tables are read once per stage wave)."""
         from trino_tpu.connectors.base import ColumnDomain, Split
 
         start, count = node.split
         connector = self.metadata.connector(node.catalog)
+        dkey = tokens = None
+        if self._device_cache_on():
+            from trino_tpu import cache as xcache
+
+            hashed0 = set(node.hash_varchar or [])
+            dkey = xcache.DEVICE.scan_key(
+                connector, node.schema, node.table,
+                tuple(
+                    (s, c, s in hashed0)
+                    for s, c in node.assignments.items()
+                ),
+                domains=node.domains,
+                split=Split(node.table, start, count),
+            )
+            if dkey is not None:
+                hit = xcache.DEVICE.get(dkey, self.cache_stats)
+                if hit is not None:
+                    return hit
+                tokens = self._cache_tokens(
+                    connector, node.schema, node.table
+                )
         split = Split(node.table, start, count)
         kw = {}
         if node.domains and getattr(connector, "supports_domains", False):
@@ -1008,10 +1099,15 @@ class LocalExecutor:
             ))
         mask = np.zeros(cap, dtype=np.bool_)
         mask[:n] = True
-        return Page(
+        page = Page(
             names, columns, jnp.asarray(mask),
             known_rows=n, packed=True,
         )
+        if dkey is not None and tokens is not None:
+            from trino_tpu import cache as xcache
+
+            xcache.DEVICE.put(dkey, page, tokens, pool=self.memory_pool)
+        return page
 
     def _Exchange(self, node: P.Exchange) -> Page:
         # single-device execution: every exchange is the identity (the
@@ -1177,13 +1273,26 @@ class LocalExecutor:
             plan = self._maybe_revoke_join(node)
             if plan is not None:
                 return plan
-        if not budget:
+        frag = self._build_cache_probe(node.right)
+        right_hit = frag[2] if frag is not None else None
+        if not budget and right_hit is None:
             # prefetch trades device memory for round trips — never
             # under an HBM budget, where spill paths may stream the
-            # same subtrees chunk-wise instead
+            # same subtrees chunk-wise instead (and never when the
+            # build side is already HBM-resident: prefetching its scan
+            # chains would pin pages the hit makes redundant)
             self._prefetch_join_chains(node)
         left = self._compact(self.execute(node.left))
-        right = self._compact(self.execute(node.right))
+        if right_hit is not None:
+            right = right_hit
+        else:
+            right = self._compact(self.execute(node.right))
+            if frag is not None:
+                from trino_tpu import cache as xcache
+
+                xcache.DEVICE.put(
+                    frag[0], right, frag[1], pool=self.memory_pool
+                )
         if node.kind == "cross":
             return self._cross_join(node, left, right)
         try:
@@ -1199,6 +1308,26 @@ class LocalExecutor:
             if plan is None:
                 raise
             return plan
+
+    def _build_cache_probe(self, sub: P.PlanNode):
+        """``(key, tokens, hit_page|None)`` when a join build-side
+        subtree is fragment-cacheable in the HBM tier (keyed by the
+        canonical subtree hash — the *built* pages, dictionaries
+        unified and compacted, are what gets pinned); None otherwise."""
+        if not self._device_cache_on():
+            return None
+        from trino_tpu import cache as xcache
+
+        digest = xcache.plan_digest(sub, self.session)
+        if digest is None:
+            return None
+        tokens = xcache.table_tokens(sub, self.metadata)
+        if tokens is None or not tokens:
+            # no table scans under the subtree (Values/RemoteSource):
+            # nothing content-addresses its data, so never cache it
+            return None
+        key = xcache.DEVICE.frag_key(digest)
+        return key, tokens, xcache.DEVICE.get(key, self.cache_stats)
 
     def _prefetch_join_chains(self, node: P.PlanNode) -> None:
         """Dispatch every aggregate-free Filter/Project chain over a

@@ -8,16 +8,18 @@ executor served every statement, wasteful the moment several engines
 coexist (a coordinator's embedded runner, per-group fleet planners,
 test fixtures). This module hoists that storage to a single
 process-wide cache, the analog of the reference's worker-shared memory
-connector pages: scanned device pages are keyed by the *connector
-instance* that produced them, so any executor scanning the same
-connector reuses the resident pages.
+connector pages.
 
-Identity keying is the isolation contract: two TpchConnector instances
-with independently mutated tables (different test fixtures, different
-catalogs) never share entries because the connector object itself is
-the key. A ``WeakKeyDictionary`` makes the connector's lifetime the
-cache's lifetime — dropping the last metadata reference frees its
-device pages without an explicit close hook.
+Keying is by *connector fingerprint* (cache.connector_fingerprint):
+connectors that implement ``cache_fingerprint()`` — parquet, whose
+ident is the root path and whose content digests footer sizes+mtimes —
+share entries across connector INSTANCES over the same files, and an
+out-of-band rewrite flips the content digest, dropping every stale
+page at the next probe. Connectors without the hook get a per-instance
+token, preserving the historical isolation contract (two TpchConnector
+fixtures with independently mutated tables never share), with a weak
+finalizer so dropping the last connector reference frees its device
+pages.
 
 DML invalidation routes here too: a write through ANY executor drops
 the shared entry, so a concurrent reader re-scans instead of serving
@@ -35,22 +37,29 @@ from collections import OrderedDict
 __all__ = ["ScanPageCache", "SplitBatchCache", "SHARED", "SHARED_SPLITS"]
 
 
+def _fingerprint(connector) -> tuple[str, str]:
+    from trino_tpu.cache import connector_fingerprint
+
+    return connector_fingerprint(connector)
+
+
 class ScanPageCache:
-    """connector instance -> (schema, table) -> per-table page dict.
+    """connector fingerprint -> (schema, table) -> per-table page dict.
 
     The per-table dict is the same shape executors always used:
     column-cache-key -> device Column, ``""`` -> validity mask,
     ``"#rows"`` -> row count. Callers mutate it in place under the
     engine's execution serialization; this class only guards the
     *map* structure with its own lock so concurrent executors can
-    resolve tables without racing the weak map.
+    resolve tables without racing the map.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._by_connector: "weakref.WeakKeyDictionary" = (
-            weakref.WeakKeyDictionary()
-        )
+        #: ident -> [content, {(schema, table): page dict}]
+        self._by_ident: dict[str, list] = {}
+        #: idents with a registered instance finalizer
+        self._watched: set[str] = set()
 
     def table(self, connector, schema: str, table: str) -> dict:
         """The live page dict for one table (created empty on first
@@ -59,11 +68,20 @@ class ScanPageCache:
         when this call created the entry."""
         from trino_tpu import telemetry
 
+        ident, content = _fingerprint(connector)
         with self._lock:
-            tables = self._by_connector.get(connector)
-            if tables is None:
-                tables = {}
-                self._by_connector[connector] = tables
+            ent = self._by_ident.get(ident)
+            if ent is not None and ent[0] != content:
+                # on-disk content changed out-of-band: every page
+                # under this ident was observed against old bytes
+                ent = None
+            if ent is None:
+                ent = self._by_ident[ident] = [content, {}]
+                if ident.startswith("id:") and ident not in self._watched:
+                    # instance-keyed entries die with the connector
+                    self._watched.add(ident)
+                    weakref.finalize(connector, self._drop_ident, ident)
+            tables = ent[1]
             cache = tables.get((schema, table))
             if cache is not None and "" in cache:
                 telemetry.SCAN_CACHE_HITS.inc(table=table)
@@ -73,23 +91,57 @@ class ScanPageCache:
                 cache = tables[(schema, table)] = {}
             return cache
 
+    def _drop_ident(self, ident: str) -> None:
+        with self._lock:
+            self._watched.discard(ident)
+            self._by_ident.pop(ident, None)
+
     def invalidate(self, connector, schema: str, table: str) -> None:
         """Drop one table's pages (after DML through any executor)."""
+        ident, _content = _fingerprint(connector)
         with self._lock:
-            tables = self._by_connector.get(connector)
-            if tables is not None:
-                tables.pop((schema, table), None)
+            ent = self._by_ident.get(ident)
+            if ent is not None:
+                ent[1].pop((schema, table), None)
 
     def resident_tables(self, connector) -> list[tuple[str, str]]:
         """(schema, table) pairs currently device-resident for one
         connector (observability/tests)."""
+        ident, content = _fingerprint(connector)
         with self._lock:
-            tables = self._by_connector.get(connector) or {}
-            return [k for k, v in tables.items() if "" in v]
+            ent = self._by_ident.get(ident)
+            if ent is None or ent[0] != content:
+                return []
+            return [k for k, v in ent[1].items() if "" in v]
+
+    def snapshot(self) -> dict:
+        """entries/bytes across every resident table page dict
+        (system.runtime.caches feed)."""
+        entries = 0
+        nbytes = 0
+        with self._lock:
+            for _content, tables in self._by_ident.values():
+                for cache in tables.values():
+                    if "" not in cache:
+                        continue
+                    entries += 1
+                    for k, v in cache.items():
+                        if k == "#rows":
+                            continue
+                        if k == "":
+                            nbytes += getattr(v, "nbytes", 0) or 0
+                        else:
+                            nbytes += getattr(
+                                getattr(v, "data", None), "nbytes", 0
+                            ) or 0
+                            valid = getattr(v, "valid", None)
+                            if valid is not None:
+                                nbytes += getattr(valid, "nbytes", 0) or 0
+        return {"entries": entries, "bytes": nbytes}
 
     def clear(self) -> None:
         with self._lock:
-            self._by_connector.clear()
+            self._by_ident.clear()
 
 
 class SplitBatchCache:
@@ -98,21 +150,24 @@ class SplitBatchCache:
     Whole-table identity caching (above) is exactly wrong for
     out-of-core scans: pinning every page of an SF100 table would
     recreate the memory problem streaming exists to avoid. Streamed
-    reads instead cache per-(connector, schema, table, row-range,
+    reads instead cache per-(fingerprint, schema, table, row-range,
     columns) host batches with an LRU bounded by total bytes, so a hot
     working set (dimension tables, re-scanned probe splits) stays warm
     while a single pass over a huge fact table churns through without
-    accumulating. Connector identity is part of the key via ``id()``
-    plus a weak finalizer that drops the connector's entries when it is
-    collected — same isolation contract as ScanPageCache without
-    pinning the connector alive."""
+    accumulating. Fingerprint keying shares batches across connector
+    instances over the same files; instance-keyed idents carry a weak
+    finalizer that drops the connector's entries when it is collected —
+    same isolation contract as ScanPageCache without pinning the
+    connector alive."""
 
     def __init__(self, max_bytes: int = 256 << 20):
         self.max_bytes = max_bytes
         self._lock = threading.Lock()
         self._entries: OrderedDict = OrderedDict()
         self._bytes = 0
-        self._watched: set[int] = set()
+        self._watched: set[str] = set()
+        #: ident -> content digest its entries were observed under
+        self._content: dict[str, str] = {}
 
     @staticmethod
     def _size(batch: dict) -> int:
@@ -125,14 +180,20 @@ class SplitBatchCache:
                 total += getattr(v, "nbytes", 0) or 0
         return total
 
-    def _key(self, connector, schema, table, start, count, columns):
-        return (id(connector), schema, table, start, count, tuple(columns))
+    def _sync_content_locked(self, ident: str, content: str) -> None:
+        """Drop an ident's entries when its on-disk content changed."""
+        if self._content.get(ident, content) != content:
+            for k in [k for k in self._entries if k[0] == ident]:
+                self._bytes -= self._size(self._entries.pop(k))
+        self._content[ident] = content
 
     def get(self, connector, schema, table, start, count, columns):
         from trino_tpu import telemetry
 
-        k = self._key(connector, schema, table, start, count, columns)
+        ident, content = _fingerprint(connector)
+        k = (ident, schema, table, start, count, tuple(columns))
         with self._lock:
+            self._sync_content_locked(ident, content)
             batch = self._entries.get(k)
             if batch is not None:
                 self._entries.move_to_end(k)
@@ -145,13 +206,13 @@ class SplitBatchCache:
         size = self._size(batch)
         if size > self.max_bytes:
             return  # a batch bigger than the cache would evict everything
-        k = self._key(connector, schema, table, start, count, columns)
+        ident, content = _fingerprint(connector)
+        k = (ident, schema, table, start, count, tuple(columns))
         with self._lock:
-            if id(connector) not in self._watched:
-                self._watched.add(id(connector))
-                weakref.finalize(
-                    connector, self._drop_connector, id(connector)
-                )
+            self._sync_content_locked(ident, content)
+            if ident.startswith("id:") and ident not in self._watched:
+                self._watched.add(ident)
+                weakref.finalize(connector, self._drop_ident, ident)
             old = self._entries.pop(k, None)
             if old is not None:
                 self._bytes -= self._size(old)
@@ -161,17 +222,19 @@ class SplitBatchCache:
                 _, evicted = self._entries.popitem(last=False)
                 self._bytes -= self._size(evicted)
 
-    def _drop_connector(self, cid: int) -> None:
+    def _drop_ident(self, ident: str) -> None:
         with self._lock:
-            self._watched.discard(cid)
-            for k in [k for k in self._entries if k[0] == cid]:
+            self._watched.discard(ident)
+            self._content.pop(ident, None)
+            for k in [k for k in self._entries if k[0] == ident]:
                 self._bytes -= self._size(self._entries.pop(k))
 
     def invalidate(self, connector, schema: str, table: str) -> None:
+        ident, _content = _fingerprint(connector)
         with self._lock:
             dead = [
                 k for k in self._entries
-                if k[0] == id(connector) and k[1:3] == (schema, table)
+                if k[0] == ident and k[1:3] == (schema, table)
             ]
             for k in dead:
                 self._bytes -= self._size(self._entries.pop(k))
@@ -189,6 +252,7 @@ class SplitBatchCache:
         with self._lock:
             self._entries.clear()
             self._bytes = 0
+            self._content.clear()
 
 
 #: the process-wide cache every LocalExecutor scans through

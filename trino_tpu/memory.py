@@ -178,6 +178,10 @@ class MemoryPool:
         #: the pre-governance ``tracked_bytes_hwm`` recorded
         self.peak_bytes = 0
         self._queries: dict[str, MemoryContext] = {}
+        #: revocable consumers (cache tiers): ``fn(nbytes) -> freed``
+        #: called OUTSIDE the pool lock when a reservation would breach
+        #: the limit, before the reservation is failed
+        self._revokers: list = []
 
     def limit_bytes(self) -> int:
         if self._limit_provider is None:
@@ -210,21 +214,19 @@ class MemoryPool:
             ctx = ctx.parent
         return ctx
 
-    def _reserve(self, ctx: MemoryContext, nbytes: int) -> None:
+    def add_revoker(self, fn) -> None:
+        """Register a revocable consumer. Revokers shed lowest-priority
+        bytes (cache residency) when a reservation would otherwise fail,
+        so cached data can never turn into a query's memory error."""
+        with self._lock:
+            if fn not in self._revokers:
+                self._revokers.append(fn)
+
+    def _try_commit(self, ctx: MemoryContext, nbytes: int) -> bool:
         limit = self.limit_bytes()
         with self._lock:
             if limit and self.reserved_bytes + nbytes > limit:
-                root = self._root(ctx)
-                raise ExceededMemoryLimitError(
-                    f"Query exceeded per-node memory limit of "
-                    f"{format_bytes(limit)} "
-                    f"[query_max_memory_per_node]: requested "
-                    f"{format_bytes(nbytes)} in {ctx.name!r}, "
-                    f"{format_bytes(self.reserved_bytes)} already "
-                    f"reserved on {self.node_id} "
-                    f"(query {root.name} peak "
-                    f"{format_bytes(root.peak_bytes)})"
-                )
+                return False
             cur = ctx
             while cur is not None:
                 cur.reserved_bytes += nbytes
@@ -236,6 +238,37 @@ class MemoryPool:
                 self.peak_bytes = self.reserved_bytes
             telemetry.MEMORY_RESERVED.set(self.reserved_bytes, pool=self.node_id)
             telemetry.MEMORY_PEAK.set(self.peak_bytes, pool=self.node_id)
+            return True
+
+    def _reserve(self, ctx: MemoryContext, nbytes: int) -> None:
+        if self._try_commit(ctx, nbytes):
+            return
+        # would breach: ask revokers (cache tiers) to shed bytes, then
+        # retry — outside the lock, since revokers free via _free
+        with self._lock:
+            revokers = list(self._revokers)
+        for fn in revokers:
+            try:
+                fn(nbytes)
+            except Exception:
+                continue
+            if self._try_commit(ctx, nbytes):
+                return
+        if revokers and self._try_commit(ctx, nbytes):
+            return
+        limit = self.limit_bytes()
+        with self._lock:
+            root = self._root(ctx)
+            raise ExceededMemoryLimitError(
+                f"Query exceeded per-node memory limit of "
+                f"{format_bytes(limit)} "
+                f"[query_max_memory_per_node]: requested "
+                f"{format_bytes(nbytes)} in {ctx.name!r}, "
+                f"{format_bytes(self.reserved_bytes)} already "
+                f"reserved on {self.node_id} "
+                f"(query {root.name} peak "
+                f"{format_bytes(root.peak_bytes)})"
+            )
 
     def _free(self, ctx: MemoryContext, nbytes: int) -> None:
         with self._lock:
@@ -327,6 +360,10 @@ class ClusterMemoryManager:
         if not cap_bytes:
             return None
         totals = self.query_totals()
+        # the device-cache tier reserves under a pseudo-query context
+        # named "cache" on every worker pool; it is revocable storage,
+        # never a killable query (pressure evicts it via pool revokers)
+        totals.pop("cache", None)
         if running is not None:
             totals = {q: t for q, t in totals.items() if q in running}
         if not totals:

@@ -344,6 +344,10 @@ class FleetRunner:
         self._cancel_event = None
         self._cluster_cap = 0
         self._planner = QueryRunner(metadata, session)
+        #: semantic result cache override (cache.SemanticResultCache):
+        #: the serving layer shares ONE instance across its per-query
+        #: runners; None = the embedded planner's per-runner cache
+        self.result_cache = None
         #: per-worker device counts from /v1/info (1 when unreachable
         #: or mesh-less); the planner's shard count is the fleet total.
         #: ServingRunner passes the probed map in so per-statement
@@ -666,6 +670,17 @@ class FleetRunner:
                 f"Peak memory: {_fmt_bytes(res.peak_memory_bytes)} "
                 f"({per_node})"
             )
+        if res.cache_stats is not None:
+            from trino_tpu import cache as cache_mod
+
+            cs = cache_mod.CacheStats(
+                result_hit=res.cache_stats["result"]["hit"],
+                result_bytes=res.cache_stats["result"]["bytes"],
+                device_hits=res.cache_stats["device"]["hits"],
+                device_misses=res.cache_stats["device"]["misses"],
+                device_bytes=res.cache_stats["device"]["bytes"],
+            )
+            lines.append(cs.explain_line())
         ops_by_stage: dict[str, dict] = {}
         for ts in res.task_stats:
             if ts.get("state") != "FINISHED":
@@ -747,6 +762,37 @@ class FleetRunner:
         out.adaptive_repartitions = res.adaptive_repartitions
         return out
 
+    def _result_cache_probe(self, plan):
+        """``(cache, digest, tokens)`` for a result-cacheable plan, or
+        None. Delegates the cacheability decision to the embedded
+        planner (same session + metadata); the cache instance is the
+        serving layer's shared one when set, else the planner's own."""
+        rcache, digest, tokens = self._planner._result_cache_probe(plan)
+        if rcache is None:
+            return None
+        # explicit None check: an EMPTY SemanticResultCache is falsy
+        # (__len__), and the serving layer's shared instance starts
+        # empty — `or` would silently strand every put on the
+        # per-query planner cache that dies with this runner
+        shared = self.result_cache
+        return (shared if shared is not None else rcache, digest, tokens)
+
+    def _cached_result(self, plan, hit) -> QueryResult:
+        """Synthesize the QueryResult for a semantic-cache hit: zero
+        tasks dispatched, zero retries — the rows are byte-identical to
+        the execution that populated the entry."""
+        from trino_tpu import cache as cache_mod
+
+        cs = cache_mod.CacheStats()
+        cs.result_hit = True
+        cs.result_bytes = hit.nbytes
+        res = QueryResult(
+            names=hit.names, rows=hit.rows, ordered=hit.ordered,
+            plan=plan, planning_ms=self._plan_ms,
+        )
+        res.cache_stats = cs.as_dict()
+        return res
+
     def _execute_stmt(self, stmt, cancel_event=None) -> QueryResult:
         raw = self.session.properties.get("retry_max_attempts")
         self.max_attempts = (
@@ -802,6 +848,7 @@ class FleetRunner:
         # query_retry_attempts and the remaining execution-time budget.
         plan = None
         stages = None
+        probe = None
         last_exc: BaseException | None = None
         query_retries = 0
         for qa in range(executions):
@@ -835,6 +882,17 @@ class FleetRunner:
                     # reused across attempts (it is deterministic)
                     t_plan = time.perf_counter()
                     plan = self._planner.plan_stmt(stmt)
+                    # semantic result-cache probe BEFORE fragmentation:
+                    # a hit serves byte-identical rows without building
+                    # stages or dispatching a single task
+                    probe = self._result_cache_probe(plan)
+                    if probe is not None:
+                        hit = probe[0].get(probe[1], probe[2])
+                        if hit is not None:
+                            self._plan_ms = (
+                                (time.perf_counter() - t_plan) * 1e3
+                            )
+                            return self._cached_result(plan, hit)
                     stages = fragment_plan(plan)
                     if validate.level(self.session) != "OFF":
                         validate.validate_stages(
@@ -854,7 +912,18 @@ class FleetRunner:
                         self._stage_estimates = (
                             self._estimate_stage_rows(stages)
                         )
-                return self._execute_attempt(plan, stages, query_retries)
+                result = self._execute_attempt(plan, stages, query_retries)
+                if probe is not None:
+                    from trino_tpu import cache as cache_mod
+
+                    probe[0].put(
+                        probe[1], result.names, result.rows,
+                        result.ordered, probe[2],
+                    )
+                    cs = cache_mod.CacheStats()
+                    cs.result_hit = False
+                    result.cache_stats = cs.as_dict()
+                return result
             except Exception as e:
                 if policy != "QUERY" or not _query_tier_retryable(e):
                     raise

@@ -200,6 +200,35 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "varchar", "AUTOMATIC",
             _one_of("join_reordering_strategy", {"AUTOMATIC", "NONE"}),
         ),
+        # ---- caching (cache.py) ---------------------------------------
+        _P(
+            "result_cache_enabled",
+            "Serve byte-identical repeat statements from the semantic "
+            "result cache (canonical plan-hash keyed, generation-"
+            "invalidated). Registry default off so single-statement "
+            "runs keep exact execution semantics; the serving layer "
+            "turns it on for its shared session (A/B-off per session). "
+            "Seedable via TRINO_TPU_RESULT_CACHE",
+            "boolean",
+            _os.environ.get("TRINO_TPU_RESULT_CACHE", "false").lower()
+            in ("true", "1"),
+        ),
+        _P(
+            "device_cache_enabled",
+            "Pin hot scanned columns and built join-side pages in HBM "
+            "across queries (device table cache; pool-governed, "
+            "evicted under memory pressure before any query "
+            "reservation can fail). Registry default off; the serving "
+            "layer turns it on. Seedable via TRINO_TPU_DEVICE_CACHE",
+            "boolean",
+            _os.environ.get("TRINO_TPU_DEVICE_CACHE", "false").lower()
+            in ("true", "1"),
+        ),
+        _P(
+            "result_cache_max_bytes",
+            "Byte bound for one runner's semantic result cache (LRU)",
+            "bigint", 64 << 20, _positive("result_cache_max_bytes"),
+        ),
         # ---- plan sanity checking (plan.validate) ---------------------
         _P(
             "plan_validation",

@@ -589,6 +589,24 @@ SCAN_CACHE_HITS = REGISTRY.counter(
 SCAN_CACHE_MISSES = REGISTRY.counter(
     "trino_scan_cache_misses_total",
     "Table-scan page materializations that had to hit the connector")
+RESULT_CACHE_HITS = REGISTRY.counter(
+    "trino_result_cache_hits_total",
+    "Statements served byte-identical from a semantic result cache")
+RESULT_CACHE_MISSES = REGISTRY.counter(
+    "trino_result_cache_misses_total",
+    "Result-cache probes that fell through to execution")
+RESULT_CACHE_BYTES = REGISTRY.gauge(
+    "trino_result_cache_bytes",
+    "Host bytes resident in semantic result caches")
+DEVICE_CACHE_ENTRIES = REGISTRY.gauge(
+    "trino_device_cache_entries",
+    "Pages pinned in the HBM-resident device table cache")
+DEVICE_CACHE_BYTES = REGISTRY.gauge(
+    "trino_device_cache_bytes",
+    "Device bytes pinned by the HBM-resident table cache")
+DEVICE_CACHE_EVICTIONS = REGISTRY.counter(
+    "trino_device_cache_evictions_total",
+    "Device-cache entries evicted (LRU pressure or pool revocation)")
 SCAN_ROWGROUPS_TOTAL = REGISTRY.counter(
     "trino_scan_rowgroups_total",
     "Storage row groups considered by split generation / pruned scans")

@@ -48,6 +48,7 @@ __all__ = [
     "CHAOS_BASE_PORT", "spawn_workers", "stop_workers",
     "make_fleet", "make_serving", "run_chaos_soak", "fired_sites",
     "run_storage_chaos", "run_skew_chaos", "run_elastic_chaos",
+    "run_cache_chaos",
 ]
 
 CHAOS_BASE_PORT = 18960
@@ -641,6 +642,111 @@ def run_elastic_chaos(
         })
     finally:
         stop_workers(procs)
+    return record
+
+
+def run_cache_chaos(
+    seed: int = 0, base_port: int = 19440, spool_root: str | None = None,
+) -> dict:
+    """Cache-tier chaos (a cache is never load-bearing): the same
+    kill-mid-query round runs as twins — device cache OFF, then ON
+    with the workers' HBM tiers warmed by a clean pass — and a worker
+    holding pinned device-cache entries is hard-killed the moment its
+    first task lands. The retried tasks fall back to cold scans on the
+    survivors; both twins must come back oracle-exact and absorb the
+    SAME number of task retries, proving cache residency neither
+    rescues nor amplifies the failure path. The result cache stays off
+    in both twins so the round actually dispatches tasks to kill.
+    Ports ``base_port``+ (elastic owns 19360+)."""
+    import tempfile
+
+    data = (
+        QueryRunner.tpch("tiny").metadata.connector("tpch")
+        .data("tiny")
+    )
+    oracle = load_tpch_sqlite(data)
+    expected = oracle.execute(to_sqlite(_JOIN_SQL)).fetchall()
+    record: dict = {"seed": seed, "runs": []}
+
+    def cache_fleet(worker_uris, root, cached: bool):
+        fleet = make_fleet(worker_uris, root)
+        p = fleet.session.properties
+        p["speculation_enabled"] = False
+        p["retry_backoff_seed"] = seed
+        p["retry_initial_delay_ms"] = 5
+        p["retry_max_delay_ms"] = 20
+        p["result_cache_enabled"] = False
+        p["device_cache_enabled"] = cached
+        return fleet
+
+    def device_entries(uri: str) -> int:
+        with urllib.request.urlopen(
+            f"{uri}/v1/metrics", timeout=5
+        ) as resp:
+            txt = resp.read().decode()
+        for line in txt.splitlines():
+            if line.startswith("trino_device_cache_entries"):
+                return int(float(line.rsplit(" ", 1)[1]))
+        return 0
+
+    for cached in (False, True):
+        procs, uris = spawn_workers(
+            3, base_port=base_port + (4 if cached else 0)
+        )
+        try:
+            root = spool_root or tempfile.mkdtemp(prefix="chaos-cache")
+            fleet = cache_fleet(uris, root, cached)
+            clean = fleet.execute(_JOIN_SQL)
+            assert_rows_match(
+                clean.rows, expected, ordered=clean.ordered,
+                abs_tol=1e-6,
+            )
+            target, target_proc = uris[-1], procs[-1]
+            pinned = device_entries(target)
+            if cached:
+                assert pinned > 0, (
+                    "warm pass pinned nothing on the kill target — "
+                    "the scenario would not exercise cache loss"
+                )
+            killed: list = []
+
+            def kill_on_first_post(stage_id, task_id, worker):
+                if worker.uri == target and not killed:
+                    killed.append(task_id)
+                    target_proc.kill()
+
+            fleet = cache_fleet(uris, root, cached)
+            fleet.post_hook = kill_on_first_post
+            res = fleet.execute(_JOIN_SQL)
+            assert killed, "no task ever landed on the kill target"
+            assert res.rows == clean.rows, (
+                "post-kill run is not byte-identical to the clean run"
+            )
+            assert_rows_match(
+                res.rows, expected, ordered=res.ordered, abs_tol=1e-6
+            )
+            assert res.tasks_retried >= 1, (
+                "hard-killing a worker mid-task must surface as an "
+                "FTE retry"
+            )
+            record["runs"].append({
+                "scenario": (
+                    "kill-cached-worker" if cached
+                    else "kill-uncached-worker"
+                ),
+                "killed_task": killed[0],
+                "tasks_retried": res.tasks_retried,
+                "pinned_entries_lost": pinned,
+            })
+        finally:
+            stop_workers(procs)
+
+    uncached, cached_run = record["runs"]
+    assert uncached["tasks_retried"] == cached_run["tasks_retried"], (
+        "cache residency changed the retry count: "
+        f"{uncached['tasks_retried']} uncached vs "
+        f"{cached_run['tasks_retried']} cached"
+    )
     return record
 
 
