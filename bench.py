@@ -158,6 +158,15 @@ def main(argv=None) -> int:
         "between the plans",
     )
     ap.add_argument(
+        "--recovery", action="store_true",
+        help="also run the coordinator crash-recovery benchmark: "
+        "kill -9 a live coordinator mid-FTE-query, restart it over "
+        "the same journal/spool, and record time-to-resume, the "
+        "fraction of spool-committed attempts that were re-executed "
+        "(contract: 0.0), and the orphan reaper's task/buffer GC "
+        "counts on an abandoned fleet",
+    )
+    ap.add_argument(
         "--trace-dir", default=os.environ.get("BENCH_TRACE_DIR"),
         help="export each warmup query's trace as Chrome trace-event "
         "JSON (<dir>/<qid>.trace.json — load in chrome://tracing or "
@@ -650,7 +659,60 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         )
         detail["chaos_wall_s"] = round(chaos_wall, 1)
 
+    if (
+        args.recovery or _section_enabled("BENCH_RECOVERY", False)
+    ) and fits("recovery", 120.0):
+        # robustness gauge: kill -9 the coordinator mid-FTE-query,
+        # restart it over the same journal + spool, and let the same
+        # StatementClient ride through via restart_wait_s. Ports
+        # 19680+ keep clear of the recovery test suite (19520+ chaos,
+        # 19600+ tests/test_recovery.py).
+        _recovery_section(detail)
+
     return 0
+
+
+def _recovery_section(detail) -> None:
+    import tempfile
+    import time
+
+    from trino_tpu.testing import chaos as chaos_mod
+
+    seed = int(os.environ.get("BENCH_RECOVERY_SEED", "0"))
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="bench-recovery-") as spool:
+        record = chaos_mod.run_recovery_chaos(
+            seed=seed, base_port=19680, spool_root=spool
+        )
+    wall = time.perf_counter() - t0
+    runs = {r["scenario"]: r for r in record["runs"]}
+    kill = runs["kill-mid-query"]
+    reap = runs["orphan-reap"]
+    resumed_total = (
+        kill["tasks_recovered_committed"] + kill["tasks_redispatched"]
+    )
+    detail["recovery_seed"] = seed
+    detail["recovery_time_to_resume_ms"] = round(
+        kill["time_to_resume_ms"], 1
+    )
+    detail["recovery_client_elapsed_ms"] = round(
+        kill["client_elapsed_ms"], 1
+    )
+    detail["recovery_tasks_recovered_committed"] = (
+        kill["tasks_recovered_committed"]
+    )
+    detail["recovery_tasks_redispatched"] = kill["tasks_redispatched"]
+    # the headline contract: of all the work the restarted coordinator
+    # resumed, how much was wastefully recomputed despite a committed
+    # spool attempt — must be 0.0
+    detail["recovery_reexecuted_fraction"] = round(
+        kill["recomputed_committed"] / max(1, resumed_total), 4
+    )
+    detail["recovery_tasks_reaped"] = reap["tasks_reaped"]
+    detail["recovery_buffer_reserved_after_gc"] = (
+        reap["reserved_after_gc"]
+    )
+    detail["recovery_wall_s"] = round(wall, 1)
 
 
 def _storage_section(detail) -> None:

@@ -29,6 +29,7 @@ _QUERIES_SCHEMA = TableSchema("queries", [
     ("peak_memory_bytes", T.BIGINT),
     ("resource_group", T.VARCHAR),
     ("queued_time_ms", T.DOUBLE),
+    ("recovered", T.BOOLEAN),
 ])
 
 _NODES_SCHEMA = TableSchema("nodes", [
@@ -184,6 +185,7 @@ class SystemConnector(Connector):
                     int(r.get("peak_memory_bytes", 0)),
                     q.resource_group,
                     (queued_end - q.created_at) * 1e3,
+                    bool(r.get("recovered")),
                 ))
             return out
         # runner-direct statements (no coordinator) come from the
@@ -198,6 +200,7 @@ class SystemConnector(Connector):
                 int(r.get("peak_memory_bytes", 0)),
                 r.get("resource_group") or "",
                 float(r.get("queued_time_ms", 0.0)),
+                bool(r.get("recovered")),
             ))
         return out
 

@@ -28,6 +28,12 @@ Sites (one string per architectural seam):
     ``heartbeat-loss`` periodic membership heartbeats after the
                     initial announce (same seam, separate site so a
                     schedule can let a worker join and then go quiet)
+    ``journal-write`` query-journal WAL appends (journal.py; a failed
+                    append fails the query — recovery must never trust
+                    a journal it could not write)
+    ``journal-read`` query-journal replay on coordinator restart
+                    (journal.py load/scan; a failed read makes the
+                    query non-resumable, never silently wrong)
 
 Schedules: ``arm`` (attempts 0..times-1 fail — the classic retry
 shape), ``arm_nth`` (exactly the n-th matching call fails), and
@@ -59,7 +65,7 @@ __all__ = [
 SITES = frozenset(
     ["rpc", "spool-write", "spool-read", "task-exec", "device-oom",
      "planner", "compile-deserialize", "scan-read", "exchange-fetch",
-     "heartbeat-loss", "announce-drop"]
+     "heartbeat-loss", "announce-drop", "journal-write", "journal-read"]
 )
 
 

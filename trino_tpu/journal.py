@@ -1,0 +1,392 @@
+"""Durable query journal — the coordinator's write-ahead log for FTE
+crash recovery.
+
+Every fault domain below the coordinator already recovers from durable
+state: task attempts commit first-wins to the CRC'd spool (`.done`
+markers), whole queries re-execute under fresh spool epochs, workers
+drain or die under the membership registry. The coordinator itself was
+the last single point of failure — its ``QueryTracker`` / dispatch
+books live in memory, so a ``kill -9`` stranded running worker tasks
+and lost committed spool work that was already durable on disk. This
+module closes that gap with the smallest durable record that makes the
+in-memory books reconstructible.
+
+Layout: ``{spool_root}/_journal/{query_id}.wal`` — one JSONL file per
+query, one JSON object per line, each append ``flush`` + ``os.fsync``'d
+before the action it describes is allowed to proceed (classic WAL
+discipline: journal the dispatch *before* the POST, so a crash can
+over-report dispatches but never under-report them — recovery treats
+journaled-but-never-posted tasks as adoptable-or-redispatch, which is
+safe, while the reverse would silently double-execute).
+
+Record types (``"t"`` field):
+
+    ``client``   slug, user, sql — written by the HTTP coordinator at
+                 submit time so a restart can re-serve the query at its
+                 old ``/v1/statement/{qid}/{slug}/{token}`` URI
+    ``begin``    sql, user, session-property snapshot, retry_policy
+    ``epoch``    the attempt-local spool epoch id + fragmented-plan
+                 digest + partition count (one per QUERY-tier attempt)
+    ``stage``    stage id, task ids and per-task spec *fingerprints*
+                 (task split enumeration depends on fleet liveness, so
+                 a committed attempt is only trusted on resume when the
+                 regenerated spec hashes to the same work)
+    ``dispatch`` task posted to a worker (tid, attempt, worker uri)
+    ``commit``   task attempt committed on the spool
+    ``resumed``  recovery stats stamped by a resuming run
+    ``done``     terminal state, rows / error, elapsed, and (on
+                 failure) the embedded post-mortem diagnostics bundle
+
+Torn tails are expected — a crash mid-append leaves a partial last
+line, which ``load`` silently drops (everything before it was fsync'd).
+
+Fault sites: ``journal-write`` fires on appends (a query that cannot
+journal must fail — recovery never trusts a journal it couldn't
+write), ``journal-read`` on replay (an unreadable journal makes the
+query unresumable, never silently wrong).
+
+This module also houses the recovery-adjacent error types and the
+cluster-wide :class:`RetryBudget` — both are shared by the fleet
+runner, the HTTP coordinator, and the protocol error-code table, and
+this is the one module all three already import.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+from trino_tpu import fault, telemetry
+
+__all__ = [
+    "QueryJournal", "plan_digest", "spec_fingerprint",
+    "CoordinatorRestartedError", "RetryBudgetExhaustedError",
+    "RetryBudget", "JOURNAL_DIRNAME",
+]
+
+JOURNAL_DIRNAME = "_journal"
+
+
+class CoordinatorRestartedError(RuntimeError):
+    """A restarted coordinator cannot resume this query (not journaled,
+    not fault-tolerant, or its journal was unreadable). Typed so the
+    protocol layer reports ``COORDINATOR_RESTARTED`` and clients know
+    the statement itself was fine — resubmission is the remedy."""
+
+
+class RetryBudgetExhaustedError(RuntimeError):
+    """The query spent its cluster-wide task-retry budget inside the
+    sliding window. Deliberately *non-retryable* at every tier: the
+    budget exists to stop recovery storms from melting a small fleet,
+    so escalating to a QUERY-tier re-execution would defeat it.
+
+    The message embeds the token "non-retryable" so tier classifiers
+    that key on generic RuntimeError text also refuse to retry it."""
+
+    def __init__(self, spent: int, limit: int, window_s: float):
+        self.spent = spent
+        self.limit = limit
+        self.window_s = window_s
+        super().__init__(
+            f"retry budget exhausted (non-retryable): {spent} task "
+            f"retries in the last {window_s:g}s exceeds the budget of "
+            f"{limit} (session property retry_budget)"
+        )
+
+
+class RetryBudget:
+    """Sliding-window cap on total task retries for one query.
+
+    ``spend()`` records one retry and raises
+    :class:`RetryBudgetExhaustedError` once more than ``limit`` retries
+    land inside ``window_s`` seconds. ``limit <= 0`` disables the
+    budget (the default — existing retry behaviour is unchanged)."""
+
+    def __init__(self, limit: int, window_s: float = 60.0):
+        self.limit = int(limit)
+        self.window_s = float(window_s)
+        self._spent: list[float] = []
+        self._lock = threading.Lock()
+
+    def spend(self, now: float | None = None) -> None:
+        if self.limit <= 0:
+            return
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            cutoff = t - self.window_s
+            self._spent = [s for s in self._spent if s > cutoff]
+            self._spent.append(t)
+            if len(self._spent) > self.limit:
+                raise RetryBudgetExhaustedError(
+                    len(self._spent), self.limit, self.window_s
+                )
+
+
+def plan_digest(plan) -> str:
+    """Stable digest of an optimized plan's wire form. Resume
+    re-plans the journaled SQL and only proceeds when the digests
+    match — catalog drift between crash and restart must force a
+    fresh epoch, never a half-trusted one."""
+    from trino_tpu.plan.serde import plan_to_json
+
+    j = json.dumps(plan_to_json(plan), sort_keys=True, default=str)
+    return hashlib.sha256(j.encode()).hexdigest()
+
+
+def spec_fingerprint(spec) -> str:
+    """Digest of one task spec's *work*: wire plan + partition +
+    salt. Task ids alone are not stable across restarts — scan-stage
+    split enumeration depends on how many workers are alive — so a
+    spool-committed attempt is only adopted when the regenerated
+    spec's fingerprint matches the journaled one."""
+    basis = json.dumps(
+        {
+            "plan": getattr(spec, "plan_json", None),
+            "partition": getattr(spec, "partition", None),
+            "salt": getattr(spec, "salt", None),
+        },
+        sort_keys=True, default=str,
+    )
+    return hashlib.sha256(basis.encode()).hexdigest()
+
+
+class QueryJournal:
+    """One journal directory under the spool root; thread-safe appends
+    keyed by query id. One instance is shared by the HTTP coordinator
+    and the fleet runner of a process."""
+
+    def __init__(self, spool_root: str):
+        self.root = os.path.join(spool_root, JOURNAL_DIRNAME)
+        os.makedirs(self.root, exist_ok=True)
+        self._locks: dict[str, threading.Lock] = {}
+        self._guard = threading.Lock()
+
+    # -- paths -----------------------------------------------------------
+    def path(self, query_id: str) -> str:
+        return os.path.join(self.root, f"{query_id}.wal")
+
+    def _lock_for(self, query_id: str) -> threading.Lock:
+        with self._guard:
+            return self._locks.setdefault(query_id, threading.Lock())
+
+    # -- writes ----------------------------------------------------------
+    def append(self, query_id: str, record: dict) -> None:
+        """fsync'd JSONL append; the WAL rule is append-before-act."""
+        fault.check("journal-write", tag=query_id)
+        line = json.dumps(record, sort_keys=True, default=str)
+        with self._lock_for(query_id):
+            with open(self.path(query_id), "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        telemetry.JOURNAL_APPENDS.inc(type=record.get("t", "?"))
+
+    def note_client(self, query_id: str, slug: str, user: str,
+                    sql: str) -> None:
+        self.append(query_id, {
+            "t": "client", "slug": slug, "user": user, "sql": sql,
+            "ts": time.time(),
+        })
+
+    def begin(self, query_id: str, sql: str, user: str,
+              session_properties: dict, retry_policy: str) -> None:
+        self.append(query_id, {
+            "t": "begin", "sql": sql, "user": user,
+            "session": dict(session_properties),
+            "retry_policy": retry_policy, "ts": time.time(),
+        })
+
+    def epoch(self, query_id: str, epoch_id: str, digest: str,
+              n_partitions: int) -> None:
+        self.append(query_id, {
+            "t": "epoch", "epoch": epoch_id, "plan_digest": digest,
+            "n_partitions": n_partitions, "ts": time.time(),
+        })
+
+    def stage(self, query_id: str, stage_id: str,
+              fingerprints: dict) -> None:
+        """``fingerprints``: task_id -> spec fingerprint."""
+        self.append(query_id, {
+            "t": "stage", "sid": stage_id, "specs": dict(fingerprints),
+        })
+
+    def dispatch(self, query_id: str, stage_id: str, task_id: str,
+                 attempt: int, worker: str) -> None:
+        self.append(query_id, {
+            "t": "dispatch", "sid": stage_id, "tid": task_id,
+            "a": attempt, "worker": worker,
+        })
+
+    def commit(self, query_id: str, stage_id: str, task_id: str,
+               attempt: int) -> None:
+        self.append(query_id, {
+            "t": "commit", "sid": stage_id, "tid": task_id, "a": attempt,
+        })
+
+    def resumed(self, query_id: str, stats: dict) -> None:
+        self.append(query_id, {
+            "t": "resumed", "ts": time.time(), **dict(stats),
+        })
+
+    def finish(self, query_id: str, state: str, rows: int = 0,
+               error: str | None = None, elapsed_ms: float = 0.0,
+               diagnostics: dict | None = None) -> None:
+        rec = {
+            "t": "done", "state": state, "rows": int(rows),
+            "error": error, "elapsed_ms": float(elapsed_ms),
+            "ts": time.time(),
+        }
+        if diagnostics is not None:
+            rec["diagnostics"] = diagnostics
+        self.append(query_id, rec)
+
+    # -- reads -----------------------------------------------------------
+    def load(self, query_id: str) -> list[dict]:
+        """All intact records, in append order. A torn final line
+        (crash mid-append) is dropped; a torn *interior* line cannot
+        occur because every append fsyncs its own newline."""
+        fault.check("journal-read", tag=query_id)
+        path = self.path(query_id)
+        if not os.path.exists(path):
+            return []
+        records = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    break  # torn tail — everything after is garbage
+        return records
+
+    def entry(self, query_id: str) -> "JournalEntry | None":
+        records = self.load(query_id)
+        if not records:
+            return None
+        return JournalEntry(query_id, records)
+
+    def scan(self) -> list["JournalEntry"]:
+        """Every journaled query, oldest file first (recovery replays
+        in submission order so rehydrated tracker rows keep their
+        relative history)."""
+        if not os.path.isdir(self.root):
+            return []
+        names = [n for n in os.listdir(self.root) if n.endswith(".wal")]
+        paths = sorted(
+            (os.path.join(self.root, n) for n in names),
+            key=lambda p: (os.path.getmtime(p), p),
+        )
+        out = []
+        for p in paths:
+            qid = os.path.basename(p)[:-len(".wal")]
+            try:
+                e = self.entry(qid)
+            except Exception:
+                # journal-read fault or corrupt file: surface as an
+                # entry with no records so recovery can fail it typed
+                e = JournalEntry(qid, [])
+            if e is not None:
+                out.append(e)
+        return out
+
+    def delete(self, query_id: str) -> None:
+        try:
+            os.remove(self.path(query_id))
+        except OSError:
+            pass
+
+    def gc(self, max_age_s: float = 7 * 24 * 3600.0) -> int:
+        """Drop journals for terminal queries older than the TTL (a
+        terminal journal is only history — the tracker rehydrates from
+        it, but it need not live forever). Returns files removed."""
+        removed = 0
+        now = time.time()
+        for e in self.scan():
+            if e.done is None:
+                continue
+            ts = e.done.get("ts", now)
+            if now - ts > max_age_s:
+                self.delete(e.query_id)
+                removed += 1
+        return removed
+
+
+class JournalEntry:
+    """A parsed per-query journal: indexed views over the record list
+    that recovery consumes directly."""
+
+    def __init__(self, query_id: str, records: list[dict]):
+        self.query_id = query_id
+        self.records = records
+        self.client = next(
+            (r for r in records if r.get("t") == "client"), None)
+        self.begin = next(
+            (r for r in records if r.get("t") == "begin"), None)
+        self.done = next(
+            (r for r in reversed(records) if r.get("t") == "done"), None)
+        #: last epoch wins — each QUERY-tier attempt journals its own
+        self.epoch = next(
+            (r for r in reversed(records) if r.get("t") == "epoch"), None)
+
+    @property
+    def sql(self) -> str | None:
+        if self.begin is not None:
+            return self.begin.get("sql")
+        return self.client.get("sql") if self.client else None
+
+    @property
+    def resumable(self) -> bool:
+        """RUNNING (no terminal record) + a begin with a fault-tolerant
+        retry policy + at least one epoch to anchor the spool state."""
+        return (
+            self.done is None
+            and self.begin is not None
+            and self.begin.get("retry_policy", "NONE") != "NONE"
+            and self.epoch is not None
+        )
+
+    def stage_fingerprints(self) -> dict:
+        """task_id -> journaled spec fingerprint, scoped to the records
+        appended *after* the last epoch (earlier epochs' stages carry
+        stale task enumerations)."""
+        out: dict = {}
+        seen_epoch = self.epoch is None
+        for r in self.records:
+            if r is self.epoch:
+                seen_epoch = True
+                out = {}
+                continue
+            if seen_epoch and r.get("t") == "stage":
+                out.update(r.get("specs", {}))
+        return out
+
+    def dispatches(self) -> dict:
+        """(tid, attempt) -> worker uri, post-last-epoch only."""
+        out: dict = {}
+        seen_epoch = self.epoch is None
+        for r in self.records:
+            if r is self.epoch:
+                seen_epoch = True
+                out = {}
+                continue
+            if seen_epoch and r.get("t") == "dispatch":
+                out[(r["tid"], int(r["a"]))] = r.get("worker")
+        return out
+
+    def commits(self) -> dict:
+        """tid -> committed attempt number, post-last-epoch only."""
+        out: dict = {}
+        seen_epoch = self.epoch is None
+        for r in self.records:
+            if r is self.epoch:
+                seen_epoch = True
+                out = {}
+                continue
+            if seen_epoch and r.get("t") == "commit":
+                out[r["tid"]] = int(r["a"])
+        return out

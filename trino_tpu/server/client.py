@@ -16,6 +16,7 @@ always fail fast.
 
 from __future__ import annotations
 
+import http.client
 import json
 import random
 import time
@@ -44,10 +45,21 @@ class StatementClient:
     get_retries = 3
     #: base backoff in seconds; attempt k sleeps uniform(0, base * 2^k)
     retry_backoff_s = 0.05
+    #: cap on any single backoff sleep — restart waits poll steadily
+    #: instead of backing off into multi-minute gaps
+    retry_sleep_cap_s = 2.0
 
-    def __init__(self, server: str, timeout: float = 300.0):
+    def __init__(self, server: str, timeout: float = 300.0,
+                 restart_wait_s: float = 0.0):
         self.server = server.rstrip("/")
         self.timeout = timeout
+        #: coordinator-restart tolerance: when > 0, pagination GETs
+        #: keep retrying transport faults (connection refused while
+        #: the coordinator is down, 404 while it replays the journal)
+        #: until this much wall time has passed — a restarted
+        #: coordinator re-serves journaled queries at their old
+        #: nextUri, so the same client rides through the crash
+        self.restart_wait_s = restart_wait_s
         self._rng = random.Random()
 
     def _request_once(
@@ -72,22 +84,54 @@ class StatementClient:
             err = QueryError(f"cannot reach {url}: {e.reason}")
             err.retryable = True
             raise err from e
+        except (OSError, http.client.HTTPException) as e:
+            # a server killed mid-response surfaces raw from read()
+            # (RemoteDisconnected, IncompleteRead, reset) — the same
+            # transport-fault class as a refused connection
+            err = QueryError(f"transport failure from {url}: {e}")
+            err.retryable = True
+            raise err from e
         return json.loads(payload) if payload else {}
 
     def _request(
         self, method: str, url: str, body: bytes | None = None
     ) -> dict:
         retries = self.get_retries if method == "GET" else 0
-        for attempt in range(retries + 1):
+        restart_deadline = (
+            time.monotonic() + self.restart_wait_s
+            if (self.restart_wait_s > 0 and method == "GET")
+            else None
+        )
+        attempt = 0
+        while True:
             try:
                 return self._request_once(method, url, body)
             except QueryError as e:
-                if attempt >= retries or not getattr(e, "retryable", False):
+                retryable = getattr(e, "retryable", False)
+                if restart_deadline is not None:
+                    # restart-wait mode: a brief 404 also rides — the
+                    # coordinator may be back up but still replaying
+                    # its journal when the GET lands
+                    retryable = retryable or (
+                        getattr(e, "http_status", 0) == 404
+                    )
+                    if retryable and time.monotonic() < restart_deadline:
+                        time.sleep(min(
+                            self.retry_sleep_cap_s,
+                            self._rng.uniform(
+                                0.0,
+                                self.retry_backoff_s * (2 ** attempt),
+                            ),
+                        ))
+                        attempt = min(attempt + 1, 16)
+                        continue
+                    raise
+                if attempt >= retries or not retryable:
                     raise
                 time.sleep(self._rng.uniform(
                     0.0, self.retry_backoff_s * (2 ** attempt)
                 ))
-        raise AssertionError("unreachable")
+                attempt += 1
 
     def execute(self, sql: str):
         """Run one statement; returns (columns, rows).

@@ -53,6 +53,11 @@ ERROR_CODES = {
     "QueryCancelled": (130, "USER_CANCELED"),
     "ExceededMemoryLimitError": (133, "EXCEEDED_MEMORY_LIMIT"),
     "InsufficientResourcesError": (134, "INSUFFICIENT_RESOURCES"),
+    # a restarted coordinator could not resume the query (not
+    # journaled as fault-tolerant): the statement was fine, resubmit
+    "CoordinatorRestartedError": (135, "COORDINATOR_RESTARTED"),
+    # cluster-wide sliding-window retry budget spent (retry_budget)
+    "RetryBudgetExhaustedError": (136, "RETRY_BUDGET_EXHAUSTED"),
 }
 
 
@@ -98,11 +103,14 @@ class Coordinator:
 
     def __init__(
         self, runner: QueryRunner | None = None, port: int = 0,
-        resource_groups=None,
+        resource_groups=None, journal=None,
     ):
         from trino_tpu.server.resource_groups import ResourceGroupManager
 
         self.runner = runner or QueryRunner.tpch("tiny")
+        #: durable query journal shared with a journal-wired fleet
+        #: runner; recover() replays it, submit() WALs client records
+        self.journal = journal or getattr(self.runner, "journal", None)
         self._queries: dict[str, QueryState] = {}
         self._lock = threading.Lock()
         #: query-state transitions notify this condition so protocol
@@ -407,6 +415,117 @@ class Coordinator:
         self._httpd.shutdown()
         self._httpd.server_close()
 
+    def recover(self) -> dict:
+        """Replay the durable query journal after a restart — call
+        between construction and :meth:`start` (connections arriving
+        in between queue in the listen backlog, so clients never see
+        a half-recovered coordinator).
+
+        Per journaled query:
+
+        - terminal (``done`` record): rehydrate its registry row —
+          ``system.runtime.queries`` / ``GET /v1/query/{id}`` and any
+          failure post-mortem bundle survive the restart, flagged
+          ``recovered=true``. Result pages are NOT journaled, so the
+          old protocol URI does not come back for finished queries.
+        - RUNNING + fault-tolerant (``retry_policy`` TASK/QUERY with a
+          spool epoch): re-registered at its OLD qid+slug protocol URI
+          and resumed on a background thread — committed spool
+          attempts are inherited, live worker attempts adopted, only
+          the in-flight tail re-dispatched.
+        - RUNNING but not resumable (retry_policy=NONE, or an
+          unreadable journal): failed typed COORDINATOR_RESTARTED at
+          its old URI; the statement was fine — resubmission is the
+          client's remedy.
+
+        Returns ``{"resumed": n, "rehydrated": n, "unresumable": n}``.
+        """
+        from trino_tpu import telemetry, tracker
+
+        counts = {"resumed": 0, "rehydrated": 0, "unresumable": 0}
+        if self.journal is None:
+            return counts
+        to_resume = []
+        for e in self.journal.scan():
+            if e.done is not None:
+                tracker.QUERY_INFO.rehydrate(
+                    e.query_id,
+                    state=e.done.get("state", "FINISHED"),
+                    sql=e.sql,
+                    user=(e.begin or e.client or {}).get("user"),
+                    rows=e.done.get("rows"),
+                    error=e.done.get("error"),
+                    elapsed_ms=e.done.get("elapsed_ms", 0.0),
+                    diagnostics=e.done.get("diagnostics"),
+                )
+                counts["rehydrated"] += 1
+                telemetry.QUERIES_RECOVERED.inc(outcome="rehydrated")
+                continue
+            q = QueryState(
+                query_id=e.query_id,
+                slug=(e.client or {}).get("slug") or secrets.token_hex(8),
+                sql=e.sql or "",
+                user=str((e.begin or e.client or {}).get("user") or "user"),
+            )
+            tracker.QUERY_INFO.mark_recovered(e.query_id)
+            if e.resumable and hasattr(self.runner, "resume"):
+                with self._lock:
+                    self._queries[e.query_id] = q
+                to_resume.append((q, e))
+                counts["resumed"] += 1
+                telemetry.QUERIES_RECOVERED.inc(outcome="resumed")
+            else:
+                q.state = "FAILED"
+                q.error = (
+                    "CoordinatorRestartedError: the coordinator "
+                    "restarted and cannot resume this query "
+                    f"(retry_policy="
+                    f"{(e.begin or {}).get('retry_policy', 'NONE')}); "
+                    "resubmit the statement"
+                )
+                q.finished_at = time.time()
+                with self._lock:
+                    self._queries[e.query_id] = q
+                tracker.QUERY_INFO.rehydrate(
+                    e.query_id, state="FAILED", sql=q.sql, user=q.user,
+                    error=q.error,
+                )
+                try:
+                    # terminal WAL record: the NEXT restart rehydrates
+                    # this as history instead of re-failing it
+                    self.journal.finish(
+                        e.query_id, state="FAILED", error=q.error,
+                    )
+                except Exception:
+                    pass
+                counts["unresumable"] += 1
+                telemetry.QUERIES_RECOVERED.inc(outcome="unresumable")
+
+        def run_resumes():
+            # sequential: the fleet runner executes one statement at a
+            # time; clients long-poll their old URIs meanwhile
+            for q, e in to_resume:
+                q.state = "RUNNING"
+                q.started_at = time.time()
+                self._signal_state()
+                try:
+                    result = self.runner.resume(e)
+                    q.result = result
+                    q.state = "FINISHED"
+                except Exception as exc:
+                    if q.error is None:
+                        q.error = f"{type(exc).__name__}: {exc}"
+                        q.error_detail = traceback.format_exc()
+                    q.state = "FAILED"
+                q.finished_at = time.time()
+                self._signal_state()
+
+        if to_resume:
+            threading.Thread(
+                target=run_resumes, name="journal-resume", daemon=True,
+            ).start()
+        return counts
+
     @property
     def uri(self) -> str:
         return f"http://127.0.0.1:{self.port}"
@@ -431,6 +550,17 @@ class Coordinator:
         q = QueryState(
             query_id=qid, slug=secrets.token_hex(8), sql=sql, user=user,
         )
+        if self.journal is not None:
+            # WAL the protocol identity (qid + slug) so a restarted
+            # coordinator can re-serve this query at its old
+            # /v1/statement/executing/{qid}/{slug}/{token} URI.
+            # Best-effort: an unjournalable query still runs — it just
+            # cannot survive a restart (the fleet's own begin/epoch
+            # appends are the hard chaos seam).
+            try:
+                self.journal.note_client(qid, q.slug, user, sql)
+            except Exception:
+                pass
         # capture deadline limits at submit time so the reaper enforces
         # the session the query was dispatched under, not whatever the
         # session mutates to later
@@ -751,3 +881,90 @@ def _json_value(v):
     if isinstance(v, Decimal):
         return str(v)
     return v
+
+
+def main():
+    """Standalone coordinator daemon (``python -m trino_tpu.server.
+    coordinator``): a fleet-backed coordinator with the durable query
+    journal wired in. On startup it replays the journal — so a
+    ``kill -9`` + restart with the SAME --spool resumes journaled
+    FTE queries at their old protocol URIs. The recovery chaos
+    harness and the recovery-smoke CI job drive exactly this entry
+    point."""
+    import argparse
+    import os
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8090)
+    ap.add_argument(
+        "--workers", default="",
+        help="comma-separated worker base URIs (fleet mode; omit for "
+             "a local embedded runner)",
+    )
+    ap.add_argument(
+        "--spool", default=None,
+        help="spool root directory; fleet mode stores the durable "
+             "query journal under it (_journal/)",
+    )
+    ap.add_argument("--catalog", default="tpch")
+    ap.add_argument("--schema", default="tiny")
+    ap.add_argument("--n-partitions", type=int, default=4)
+    ap.add_argument(
+        "--session", action="append", default=[], metavar="K=V",
+        help="session property override (repeatable)",
+    )
+    args = ap.parse_args()
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    journal = None
+    if args.workers:
+        from trino_tpu.connectors.tpch.connector import TpchConnector
+        from trino_tpu.journal import QueryJournal
+        from trino_tpu.metadata import Metadata, Session
+        from trino_tpu.server.fleet import FleetRunner
+
+        md = Metadata()
+        if args.catalog == "tpcds":
+            from trino_tpu.connectors.tpcds.connector import (
+                TpcdsConnector,
+            )
+
+            md.register_catalog("tpcds", TpcdsConnector())
+        else:
+            md.register_catalog("tpch", TpchConnector())
+        session = Session(catalog=args.catalog, schema=args.schema)
+        for kv in args.session:
+            k, _, v = kv.partition("=")
+            sp.set_property(session, k.strip(), v.strip())
+        spool_root = args.spool or os.path.join(
+            os.getcwd(), "trino_tpu_spool"
+        )
+        os.makedirs(spool_root, exist_ok=True)
+        journal = QueryJournal(spool_root)
+        runner = FleetRunner(
+            [u.strip() for u in args.workers.split(",") if u.strip()],
+            md, session, spool_root=spool_root,
+            n_partitions=args.n_partitions, journal=journal,
+        )
+    else:
+        runner = QueryRunner.tpch(args.schema)
+    coord = Coordinator(runner, port=args.port, journal=journal)
+    if journal is not None:
+        # replay BEFORE serving: clients connecting during recovery
+        # queue in the listen backlog and see a consistent view
+        counts = coord.recover()
+        print(f"recovery: {counts}", flush=True)
+    coord.start()
+    print(f"coordinator ready on port {coord.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        coord.stop()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

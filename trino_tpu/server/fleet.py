@@ -48,6 +48,7 @@ from dataclasses import dataclass
 from trino_tpu import (
     diagnostics,
     fault,
+    journal as journal_mod,
     membership as membership_mod,
     memory,
     profiler,
@@ -223,6 +224,7 @@ class FleetRunner:
         membership=None,
         min_workers: int = 0,
         min_workers_wait_s: float = 8.0,
+        journal=None,
     ):
         #: serving mode: a shared trino_tpu.dispatcher.Dispatcher owns
         #: worker slots, fair-share grants and ALL status polling; this
@@ -377,6 +379,27 @@ class FleetRunner:
         if membership is not None and serving is None:
             membership.residency_providers.append(self._membership_pins)
             membership.on_leave.append(self._membership_leave)
+        #: durable query journal (journal.QueryJournal): when set,
+        #: execute() WALs begin/epoch/stage/dispatch/commit/done
+        #: records so a restarted coordinator can resume this query
+        self.journal = journal
+        #: journal.JournalEntry being resumed by the current execute()
+        #: (set by resume(); None = normal fresh execution)
+        self._resume_entry = None
+        #: per-attempt resume books derived from the entry (spec
+        #: fingerprints, journaled dispatches, committed attempts);
+        #: None once the first resumed attempt has consumed them —
+        #: a QUERY-tier retry after a failed resume runs fresh
+        self._resume_state = None
+        #: recovery counters of the last execute() (kept out of
+        #: self.stats because QueryResult's fields are closed)
+        self.resume_stats: dict[str, int] = {}
+        #: sliding-window cluster-wide retry budget (retry_budget
+        #: session property); rebuilt per statement
+        self._retry_budget = journal_mod.RetryBudget(0)
+        #: sha256 of the current statement's fragmented plan wire form
+        #: (journaled per epoch; resume re-derives and must match)
+        self._plan_digest: str | None = None
 
     def request_kill(self, error: str) -> bool:
         """Cross-query memory kill (serving mode): mark this query as
@@ -475,6 +498,17 @@ class FleetRunner:
                 else None
             ),
         )
+        if self.journal is not None and self._resume_entry is None:
+            # WAL the statement before any work: a crash from here on
+            # leaves enough on disk for a restarted coordinator to
+            # replay (or to fail the query typed, for non-FTE policies)
+            self.journal.begin(
+                public_qid, sql=sql, user=self.session.user,
+                session_properties=self.session.properties,
+                retry_policy=str(
+                    sp.get(self.session, "retry_policy")
+                ).upper(),
+            )
         t0 = time.perf_counter()
         error = None
         result = None
@@ -504,11 +538,12 @@ class FleetRunner:
             raise
         finally:
             state = "FAILED" if error else "FINISHED"
+            bundle = None
             if error:
                 # post-mortem bundle: everything a "why did this die"
                 # needs, assembled while the attempt's state is still
                 # on the runner (best-effort — never masks the error)
-                diagnostics.record_bundle(diagnostics.build_bundle(
+                bundle = diagnostics.build_bundle(
                     public_qid,
                     error=error,
                     sql=sql,
@@ -532,7 +567,24 @@ class FleetRunner:
                         if (mreg := self._membership_registry())
                         is not None else None
                     ),
-                ))
+                )
+                diagnostics.record_bundle(bundle)
+            if self.journal is not None:
+                # terminal WAL record: the restarted coordinator
+                # rehydrates tracker rows (and, on failure, the
+                # post-mortem bundle) from this. Best-effort — a
+                # journal-write fault here must not mask the query's
+                # own outcome
+                try:
+                    self.journal.finish(
+                        public_qid, state=state,
+                        rows=len(result.rows) if result else 0,
+                        error=error,
+                        elapsed_ms=(time.perf_counter() - t0) * 1e3,
+                        diagnostics=bundle,
+                    )
+                except Exception:
+                    pass
             tracker.QUERY_INFO.finish(
                 public_qid,
                 state=state,
@@ -793,6 +845,34 @@ class FleetRunner:
         res.cache_stats = cs.as_dict()
         return res
 
+    def resume(self, entry) -> QueryResult:
+        """Re-execute a journaled RUNNING query under its old public
+        id and spool epoch, inheriting committed task attempts and
+        adopting still-running ones. The journaled session snapshot
+        is restored for the duration (the query runs under ITS
+        properties, not whatever the restarted coordinator defaults
+        to), with ``plan_validation=FULL`` forced — a replayed plan is
+        exactly the case full validation exists for."""
+        if not entry.resumable:
+            raise journal_mod.CoordinatorRestartedError(
+                f"query {entry.query_id} is not resumable after a "
+                f"coordinator restart (retry_policy="
+                f"{(entry.begin or {}).get('retry_policy', 'NONE')}, "
+                f"terminal={entry.done is not None}); resubmit the "
+                f"statement"
+            )
+        saved = dict(self.session.properties)
+        self.session.properties.clear()
+        self.session.properties.update(entry.begin.get("session") or {})
+        self.session.properties["plan_validation"] = "FULL"
+        self._resume_entry = entry
+        try:
+            return self.execute(entry.sql, query_id=entry.query_id)
+        finally:
+            self._resume_entry = None
+            self.session.properties.clear()
+            self.session.properties.update(saved)
+
     def _execute_stmt(self, stmt, cancel_event=None) -> QueryResult:
         raw = self.session.properties.get("retry_max_attempts")
         self.max_attempts = (
@@ -806,6 +886,20 @@ class FleetRunner:
             "tasks_retried": 0, "tasks_speculated": 0,
             "speculation_wins": 0, "workers_readmitted": 0,
         }
+        self.resume_stats = {
+            "tasks_recovered_committed": 0, "tasks_adopted": 0,
+            "tasks_redispatched": 0,
+        }
+        self._resume_state = None
+        # cluster-wide retry budget: total task retries per sliding
+        # window, across every stage — recovery storms after a
+        # coordinator restart burn it down and fail typed instead of
+        # melting a small fleet (0 = unlimited, the default)
+        self._retry_budget = journal_mod.RetryBudget(
+            int(sp.get(self.session, "retry_budget")),
+            float(sp.get(self.session, "retry_budget_window_ms"))
+            / 1000.0,
+        )
         self.retry_delays = []
         self.failure_log = []
         self.df_scan_log = []
@@ -903,6 +997,25 @@ class FleetRunner:
                     )
                     self._last_plan = plan
                     self._last_stages = stages
+                    if (
+                        self.journal is not None
+                        or self._resume_entry is not None
+                    ):
+                        self._plan_digest = journal_mod.plan_digest(plan)
+                    ent = self._resume_entry
+                    if ent is not None:
+                        jd = (ent.epoch or {}).get("plan_digest")
+                        if jd != self._plan_digest:
+                            # catalog/planner drift since the crash:
+                            # the journaled spool epoch describes
+                            # different work — never half-trust it.
+                            # Fall back to a fresh execution.
+                            self.failure_log.append(
+                                f"resume: plan digest mismatch "
+                                f"(journaled {jd}, replanned "
+                                f"{self._plan_digest}); running fresh"
+                            )
+                            self._resume_entry = None
                     if float(sp.get(
                         self.session,
                         "adaptive_partition_growth_factor",
@@ -939,8 +1052,29 @@ class FleetRunner:
         self, plan: P.PlanNode, stages: list[Stage], query_retries: int
     ) -> QueryResult:
         """One whole-statement execution under its own spool epoch."""
-        query_id = uuid.uuid4().hex[:12]
+        ent = self._resume_entry
+        if ent is not None and query_retries == 0:
+            # resume: re-enter the journaled spool epoch — its
+            # committed `.done` markers are the work we must not redo.
+            # A QUERY-tier retry after a failed resume (query_retries
+            # > 0) runs a fresh epoch like any other retry.
+            query_id = ent.epoch["epoch"]
+            self._resume_state = {
+                "fps": ent.stage_fingerprints(),
+                "dispatches": ent.dispatches(),
+                "commits": ent.commits(),
+            }
+        else:
+            query_id = uuid.uuid4().hex[:12]
+            self._resume_state = None
         self._query_id = query_id
+        if self.journal is not None and self._resume_state is None:
+            # WAL the epoch before any dispatch: the epoch record
+            # anchors which spool directory a resume may trust
+            self.journal.epoch(
+                self._public_query_id or query_id, query_id,
+                self._plan_digest or "", self.n_partitions,
+            )
         # one trace per execution attempt: stage/task/rpc spans hang
         # off this root; worker-side subtrees stitch in via the trace
         # context shipped on /v1/stagetask (self._stage_spans)
@@ -967,6 +1101,17 @@ class FleetRunner:
         t0 = time.perf_counter()
         try:
             self._run_dag(stages, qroot, tasks_by_stage)
+            if self._resume_state is not None and self.journal is not None:
+                # recovery accounting, durably: how much of the DAG
+                # was inherited vs re-dispatched (the chaos harness
+                # bounds re-execution off this record)
+                try:
+                    self.journal.resumed(
+                        self._public_query_id or query_id,
+                        dict(self.resume_stats),
+                    )
+                except Exception:
+                    pass
             if sp.get(self.session, "check_exchange_coverage"):
                 # debug assertion: every stage-to-stage exchange edge
                 # conserved rows (consumer reads sum to producer
@@ -1216,6 +1361,7 @@ class FleetRunner:
                 )
             except Exception:
                 continue
+            self._retry_budget.spend()
             self.stats["tasks_retried"] += 1
             telemetry.TASKS_RETRIED.inc()
             while time.monotonic() < deadline:
@@ -1865,6 +2011,10 @@ class FleetRunner:
                     f"task {tid} failed after {failures[tid]} "
                     f"attempts: {error}"
                 )
+            # cluster-wide budget: every retry decision spends one
+            # token; exhaustion fails the query typed instead of
+            # letting a recovery storm retry-flood the fleet
+            self._retry_budget.spend()
             telemetry.TASKS_RETRIED.inc()
             self._retries_by_stage[stage.stage_id] = (
                 self._retries_by_stage.get(stage.stage_id, 0) + 1
@@ -1939,6 +2089,7 @@ class FleetRunner:
                 next_attempt_no[ptid],
                 spool.next_attempt(qroot, psid, ptid),
             )
+            self._retry_budget.spend()
             self.stats["tasks_retried"] += 1
             telemetry.TASKS_RETRIED.inc()
             self._retries_by_stage[psid] = (
@@ -1962,6 +2113,68 @@ class FleetRunner:
                     r.read()
             except Exception:
                 pass
+
+        rs = self._resume_state
+
+        def seed_resumed(stage: Stage, spec: _TaskSpec) -> bool:
+            """Resume pre-seeding for one spec: inherit a spool-
+            committed attempt (only when the regenerated spec's
+            fingerprint matches the journaled one — task ids alone are
+            not stable across restarts), adopt a still-RUNNING attempt
+            on a live worker, or fall through to a normal dispatch
+            with the attempt counter advanced past every on-disk and
+            journaled attempt. True = spec fully handled, do not
+            queue."""
+            sid, tid = stage.stage_id, spec.task_id
+            ca = spool.committed_attempt(qroot, sid, tid)
+            if (
+                ca is not None
+                and rs["fps"].get(tid) == journal_mod.spec_fingerprint(spec)
+            ):
+                # committed before the crash AND provably the same
+                # work: inherit the attempt, never re-execute it
+                wuri = rs["dispatches"].get((tid, ca))
+                for p in spool.committed_partitions(qroot, sid, tid, ca):
+                    sched.on_partition_commit(sid, tid, ca, p, worker=wuri)
+                sched.on_task_commit(sid, tid, ca, worker=wuri)
+                done_of[sid].add(tid)
+                next_attempt_no[tid] = spool.next_attempt(qroot, sid, tid)
+                self.resume_stats["tasks_recovered_committed"] += 1
+                return True
+            # never reuse an attempt number the dead coordinator may
+            # have left running on a worker (tasks key by tid.attempt)
+            journaled = [a for (t, a) in rs["dispatches"] if t == tid]
+            next_attempt_no[tid] = max(
+                next_attempt_no[tid],
+                spool.next_attempt(qroot, sid, tid),
+                (max(journaled) + 1) if journaled else 0,
+            )
+            if journaled and self.dispatcher is None:
+                a = max(journaled)
+                wuri = rs["dispatches"].get((tid, a))
+                w = next(
+                    (x for x in self.workers
+                     if x.uri == wuri and x.alive and not x.draining),
+                    None,
+                )
+                if w is not None:
+                    # adopt only after a status pre-probe: blindly
+                    # inheriting a vanished attempt would count its
+                    # 404s toward evicting a healthy worker
+                    try:
+                        st = self._poll_task(w, tid, a)
+                    except Exception:
+                        st = None
+                    if st is not None and st.get("state") in (
+                        "RUNNING", "FINISHED"
+                    ):
+                        inflight[(tid, a)] = (
+                            w, stage, spec, time.monotonic()
+                        )
+                        self.resume_stats["tasks_adopted"] += 1
+                        return True
+            self.resume_stats["tasks_redispatched"] += 1
+            return False
 
         while len(complete) < len(stages):
             if time.monotonic() > deadline:
@@ -2045,6 +2258,18 @@ class FleetRunner:
                         spec.report_ranges = list(rep)
                 specs_of[stage.stage_id] = specs
                 sched.register_stage(stage, specs)
+                if self.journal is not None:
+                    # WAL the stage's task enumeration + per-spec work
+                    # fingerprints before any dispatch — what a future
+                    # resume checks committed attempts against
+                    self.journal.stage(
+                        self._public_query_id or self._query_id,
+                        stage.stage_id,
+                        {
+                            s.task_id: journal_mod.spec_fingerprint(s)
+                            for s in specs
+                        },
+                    )
                 if (
                     self._tracer is not None
                     and stage.stage_id not in self._stage_spans
@@ -2061,8 +2286,25 @@ class FleetRunner:
                     next_attempt_no[spec.task_id] = 0
                     failures[spec.task_id] = 0
                     spec_by_tid[spec.task_id] = (stage, spec)
+                    if rs is not None and seed_resumed(stage, spec):
+                        continue
                     push(stage, spec)
                 started.add(stage.stage_id)
+                if rs is not None and len(done_of[stage.stage_id]) == len(
+                    specs
+                ):
+                    # every task inherited a committed attempt: no poll
+                    # event will ever fire for this stage, so complete
+                    # it here (mirrors the FINISHED-branch completion)
+                    sid0 = stage.stage_id
+                    tasks_by_stage[sid0] = [s.task_id for s in specs]
+                    complete.add(sid0)
+                    sched.on_stage_complete(sid0)
+                    ssp = self._stage_spans.get(sid0)
+                    if ssp is not None:
+                        ssp.finish()
+                    if self.stage_hook is not None:
+                        self.stage_hook(sid0)
             if self.dispatcher is None:
                 self._sync_membership()
             live = [w for w in self.workers if w.alive]
@@ -2263,6 +2505,17 @@ class FleetRunner:
                         continue  # duplicate commit of a raced attempt
                     done_of[sid].add(tid)
                     sched.on_task_commit(sid, tid, a, worker=wuri)
+                    if self.journal is not None:
+                        # advisory (the spool's .done markers are the
+                        # durable truth) — lets recovery bound the
+                        # in-flight tail without listing the spool
+                        try:
+                            self.journal.commit(
+                                self._public_query_id or self._query_id,
+                                sid, tid, a,
+                            )
+                        except Exception:
+                            pass
                     # per-task stats + worker-side span subtree ride on
                     # the FINISHED status response
                     tstats = state.get("stats") or {}
@@ -2530,6 +2783,15 @@ class FleetRunner:
         # probes restore it), exactly the failure a dropped connection
         # produces
         fault.check("rpc", tag=f"post:{spec.task_id}", attempt=attempt)
+        if self.journal is not None:
+            # WAL discipline: journal the dispatch BEFORE the POST — a
+            # crash may over-report dispatches (recovery probes, then
+            # re-dispatches), but an unjournaled running attempt could
+            # collide with a resumed one
+            self.journal.dispatch(
+                self._public_query_id or self._query_id or "",
+                stage.stage_id, spec.task_id, attempt, w.uri,
+            )
         inj = fault.active()
         req = {
             "task_id": spec.task_id,

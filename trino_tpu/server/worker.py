@@ -124,16 +124,19 @@ class _ExchangeBuffer:
                 self._release_locked(key)
             telemetry.EXCHANGE_BUFFER_RESERVED.set(self._bytes)
 
-    def drop_query(self, query_id: str):
+    def drop_query(self, query_id: str) -> int:
         """Release every buffer of a finished query — the 'all pinned
         consumers have fetched' eviction point (a query's exchange has
-        no readers once the query is done)."""
+        no readers once the query is done). Returns the number of
+        entries released so the orphan reaper can account evictions."""
         with self._lock:
-            for key in [
+            keys = [
                 k for k in self._entries if k[0] == query_id
-            ]:
+            ]
+            for key in keys:
                 self._release_locked(key)
             telemetry.EXCHANGE_BUFFER_RESERVED.set(self._bytes)
+            return len(keys)
 
     def _evict_locked(self):
         key = next(iter(self._entries))
@@ -175,6 +178,19 @@ class WorkerServer:
         #: MAIN/server/GracefulShutdownHandler.java:42)
         self.state = "ACTIVE"
         self._active_tasks = 0
+        #: coordinator-liveness per query: monotonic time of the last
+        #: status poll that touched one of the query's tasks. A
+        #: coordinator that dies stops polling; the orphan reaper
+        #: quarantines then cancels queries silent past the TTL.
+        self._coord_seen: dict[str, float] = {}
+        #: per-query spool root (from submit_stage) so the reaper can
+        #: GC scratch temp files the dead coordinator's tasks left
+        self._query_spools: dict[str, str] = {}
+        #: queries the reaper has flagged (quarantine start time) but
+        #: not yet cancelled — the grace period before the kill
+        self._quarantined: dict[str, float] = {}
+        self._reaper_thread: threading.Thread | None = None
+        self._reaper_stop = threading.Event()
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -245,6 +261,11 @@ class WorkerServer:
                 if t is None:
                     self._send(404, {"error": "no such task"})
                     return
+                # every status poll is a coordinator-liveness proof
+                # for the task's query: the orphan reaper only reaps
+                # queries whose coordinator has stopped polling
+                worker._coord_seen[t.query_id] = time.monotonic()
+                worker._quarantined.pop(t.query_id, None)
                 payload = {"state": t.state}
                 if t.state == "FINISHED" and token is not None:
                     payload.update(_encode_batch(
@@ -461,6 +482,7 @@ class WorkerServer:
 
     def stop(self):
         self._announce_stop.set()
+        self._reaper_stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -647,6 +669,106 @@ class WorkerServer:
             self.exchange_buffer.drop_task(t.query_id, tid, int(a))
         return True
 
+    # ---- orphan reaping --------------------------------------------------
+
+    def reap_orphans_once(
+        self, ttl_s: float, grace_s: float | None = None
+    ) -> dict:
+        """One reaper sweep: queries whose coordinator has gone silent
+        (no status poll or dispatch) past ``ttl_s`` are quarantined on
+        the first sweep, then — one grace period later — their RUNNING
+        tasks are cancelled, their direct-exchange buffers released,
+        and any ``*.tmp`` scratch the dead coordinator's tasks left in
+        the spool is deleted. The quarantine step means a coordinator
+        that was merely paused (GC, restart-in-progress) gets a full
+        extra window to resume polling before anything is killed.
+        Returns counts for tests/telemetry."""
+        if grace_s is None:
+            grace_s = ttl_s
+        now = time.monotonic()
+        out = {"quarantined": 0, "reaped": 0, "buffers": 0,
+               "scratch": 0}
+        for qid, seen in list(self._coord_seen.items()):
+            if now - seen < ttl_s:
+                continue
+            if qid not in self._quarantined:
+                # first sweep past the TTL: quarantine only. The
+                # cancel fires a full grace period later if the
+                # coordinator stays silent.
+                self._quarantined[qid] = now
+                out["quarantined"] += 1
+                continue
+            if now - self._quarantined[qid] < grace_s:
+                continue
+            # past quarantine: the coordinator is gone for real
+            reaped = 0
+            for tkey, t in list(self._tasks.items()):
+                if t.query_id == qid and t.state in (
+                    "PENDING", "RUNNING"
+                ):
+                    self.cancel_task(tkey)
+                    reaped += 1
+            if reaped:
+                telemetry.ORPHAN_TASKS_REAPED.inc(reaped)
+            released = self.exchange_buffer.drop_query(qid)
+            if released:
+                telemetry.EXCHANGE_BUFFER_ORPHAN_EVICTIONS.inc(
+                    released
+                )
+            out["reaped"] += reaped
+            out["buffers"] += released
+            out["scratch"] += self._gc_spool_scratch(
+                self._query_spools.pop(qid, None)
+            )
+            self._coord_seen.pop(qid, None)
+            self._quarantined.pop(qid, None)
+        return out
+
+    @staticmethod
+    def _gc_spool_scratch(qroot: str | None) -> int:
+        """Delete orphaned ``*.tmp`` spool scratch (writes that never
+        reached their atomic rename because the writer died). Committed
+        files — the renamed targets — are never touched: a restarted
+        coordinator resumes from them."""
+        if not qroot or not os.path.isdir(qroot):
+            return 0
+        n = 0
+        for dirpath, _dirs, files in os.walk(qroot):
+            for name in files:
+                if name.endswith(".tmp"):
+                    try:
+                        os.unlink(os.path.join(dirpath, name))
+                        n += 1
+                    except OSError:
+                        pass
+        return n
+
+    def start_orphan_reaper(
+        self,
+        ttl_s: float,
+        grace_s: float | None = None,
+        interval_s: float | None = None,
+    ) -> threading.Thread:
+        """Background reaper loop (daemon). ``interval_s`` defaults to
+        a quarter of the TTL so a silent coordinator is noticed well
+        inside one extra TTL."""
+        if interval_s is None:
+            interval_s = max(0.05, ttl_s / 4.0)
+
+        def loop():
+            while not self._reaper_stop.wait(interval_s):
+                try:
+                    self.reap_orphans_once(ttl_s, grace_s)
+                except Exception:
+                    pass
+
+        t = threading.Thread(
+            target=loop, name="orphan-reaper", daemon=True
+        )
+        self._reaper_thread = t
+        t.start()
+        return t
+
     # ---- direct exchange (consumer side) ---------------------------------
 
     #: sentinel: the producer attempt committed WITHOUT this partition
@@ -777,6 +899,11 @@ class WorkerServer:
         task.query_id = str(req.get("query_id") or req["task_id"])
         with self._lock:
             self._tasks[tkey] = task
+        # admission counts as liveness (the dispatching coordinator is
+        # clearly alive); remember the spool root for orphan scratch GC
+        self._coord_seen[task.query_id] = time.monotonic()
+        if req.get("spool"):
+            self._query_spools[task.query_id] = str(req["spool"])
 
         def run():
             self._task_started()
@@ -1306,6 +1433,13 @@ def main():
     server.start()
     if args.coordinator:
         server.start_announcer(args.coordinator, args.node_id)
+    ttl_env = os.environ.get("TRINO_TPU_ORPHAN_TTL_S", "")
+    if ttl_env:
+        # orphan reaper: cancel tasks + GC exchange buffers and spool
+        # scratch of queries whose coordinator stops polling for more
+        # than TTL (quarantine) + TTL (grace)
+        server.start_orphan_reaper(float(ttl_env))
+        print(f"orphan reaper on (ttl {ttl_env}s)", flush=True)
     print(f"worker ready on port {server.port}", flush=True)
     try:
         threading.Event().wait()

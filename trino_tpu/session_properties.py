@@ -420,6 +420,21 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "bigint", 3, _positive("retry_max_attempts"),
         ),
         _P(
+            "retry_budget",
+            "Cluster-wide cap on total task retries per query inside "
+            "a sliding window; 0 disables. Exhaustion raises the "
+            "non-retryable RETRY_BUDGET_EXHAUSTED error so recovery "
+            "storms after a coordinator restart cannot melt a small "
+            "fleet",
+            "bigint", 0, _non_negative("retry_budget"),
+        ),
+        _P(
+            "retry_budget_window_ms",
+            "Sliding-window width for retry_budget accounting",
+            "bigint", 60_000, _positive("retry_budget_window_ms"),
+            hidden=True,
+        ),
+        _P(
             "retry_initial_delay_ms",
             "Base delay before a failed fleet task's first retry; "
             "doubles per failure up to retry_max_delay_ms, with full "

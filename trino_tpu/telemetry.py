@@ -678,6 +678,22 @@ DRAIN_DURATION = REGISTRY.histogram(
 MEMBERSHIP_TRANSITIONS = REGISTRY.counter(
     "trino_membership_transitions_total",
     "Membership state-machine transitions, labelled from/to")
+ORPHAN_TASKS_REAPED = REGISTRY.counter(
+    "trino_orphan_tasks_reaped_total",
+    "Worker tasks cancelled by the orphan reaper after their "
+    "coordinator went silent past the liveness TTL")
+EXCHANGE_BUFFER_ORPHAN_EVICTIONS = REGISTRY.counter(
+    "trino_exchange_buffer_orphan_evictions_total",
+    "Exchange-buffer entries released by the orphan reaper for "
+    "queries whose coordinator stopped polling (memory that a dead "
+    "coordinator would otherwise pin forever)")
+JOURNAL_APPENDS = REGISTRY.counter(
+    "trino_journal_appends_total",
+    "Query-journal WAL records fsync'd, by record type")
+QUERIES_RECOVERED = REGISTRY.counter(
+    "trino_queries_recovered_total",
+    "Journaled queries adopted by a restarted coordinator, by outcome "
+    "(resumed / rehydrated / unresumable)")
 
 
 # ---------------------------------------------------------------------------

@@ -48,7 +48,7 @@ __all__ = [
     "CHAOS_BASE_PORT", "spawn_workers", "stop_workers",
     "make_fleet", "make_serving", "run_chaos_soak", "fired_sites",
     "run_storage_chaos", "run_skew_chaos", "run_elastic_chaos",
-    "run_cache_chaos",
+    "run_cache_chaos", "run_recovery_chaos",
 ]
 
 CHAOS_BASE_PORT = 18960
@@ -71,12 +71,16 @@ _JOIN_SQL = (
 
 
 def spawn_workers(
-    n: int = 2, base_port: int = CHAOS_BASE_PORT, timeout_s: float = 120
+    n: int = 2, base_port: int = CHAOS_BASE_PORT,
+    timeout_s: float = 120, extra_env: dict | None = None,
 ):
-    """Start ``n`` worker processes; returns (procs, uris)."""
+    """Start ``n`` worker processes; returns (procs, uris).
+    ``extra_env`` overlays the inherited environment (e.g.
+    ``TRINO_TPU_ORPHAN_TTL_S`` to arm the orphan reaper)."""
     env = os.environ.copy()
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    env.update(extra_env or {})
     procs, uris = [], []
     for i in range(n):
         port = base_port + i
@@ -748,6 +752,322 @@ def run_cache_chaos(
         f"{cached_run['tasks_retried']} cached"
     )
     return record
+
+
+def run_recovery_chaos(
+    seed: int = 0, base_port: int = 19520, spool_root: str | None = None,
+) -> dict:
+    """Coordinator crash-recovery chaos: a real coordinator *process*
+    is ``kill -9``'d mid-FTE-query and restarted against the same
+    spool; the same client must ride through and get oracle-exact
+    rows, with every spool-committed attempt inherited rather than
+    re-executed.
+
+    Scenario ``kill-mid-query``: submit the join through a
+    ``StatementClient`` with ``restart_wait_s`` armed, wait for the
+    journal to show the first task commit, SIGKILL the coordinator,
+    restart it with the same ``--spool``. The restarted coordinator
+    replays the journal, re-serves the query at its old protocol URI,
+    adopts/re-dispatches only uncommitted work, and the client's
+    pagination GETs — retrying through the connection-refused window —
+    deliver the finished result. Asserts: rows oracle-exact; at least
+    one attempt was inherited from the spool (``resumed`` journal
+    record); no post-kill dispatch re-ran a pre-kill-committed
+    attempt.
+
+    Scenario ``orphan-reap``: kill the coordinator and do NOT restart
+    it. Workers armed with a short ``TRINO_TPU_ORPHAN_TTL_S`` must
+    quarantine then cancel the abandoned query's tasks, release its
+    exchange buffers, and GC its spool scratch — asserted off the
+    workers' own /v1/metrics (reaped >= 1, reserved bytes back to 0).
+
+    Port discipline: recovery claims 19520+ (cache chaos owns 19440+).
+    """
+    import signal
+    import tempfile
+
+    from trino_tpu.server.client import StatementClient
+
+    data = (
+        QueryRunner.tpch("tiny").metadata.connector("tpch")
+        .data("tiny")
+    )
+    oracle = load_tpch_sqlite(data)
+    expected = oracle.execute(to_sqlite(_JOIN_SQL)).fetchall()
+    record: dict = {"seed": seed, "runs": []}
+
+    def spawn_coordinator(port, worker_uris, root, delay_ms):
+        env = os.environ.copy()
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "trino_tpu.server.coordinator",
+             "--port", str(port),
+             "--workers", ",".join(worker_uris),
+             "--spool", root,
+             "--session", "retry_policy=TASK",
+             "--session", "speculation_enabled=false",
+             "--session", f"retry_backoff_seed={seed}",
+             "--session", "retry_initial_delay_ms=5",
+             "--session", "retry_max_delay_ms=20",
+             "--session", f"fleet_task_delay_ms={delay_ms}"],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        uri = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    f"{uri}/v1/info", timeout=1
+                ) as resp:
+                    json.loads(resp.read())
+                    return proc, uri
+            except Exception:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        "coordinator died: "
+                        f"{proc.stdout.read()[:4000]}"
+                    )
+                if time.monotonic() > deadline:
+                    proc.kill()
+                    raise TimeoutError("coordinator did not come up")
+                time.sleep(0.2)
+
+    def journal_records(root):
+        jdir = os.path.join(root, "_journal")
+        recs = []
+        if not os.path.isdir(jdir):
+            return recs
+        for name in sorted(os.listdir(jdir)):
+            if not name.endswith(".wal"):
+                continue
+            with open(os.path.join(jdir, name)) as f:
+                for line in f:
+                    try:
+                        recs.append(json.loads(line))
+                    except ValueError:
+                        pass
+        return recs
+
+    def wait_for_commit(root, timeout_s=90.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            recs = journal_records(root)
+            if any(r.get("t") == "commit" for r in recs):
+                return recs
+            time.sleep(0.05)
+        raise TimeoutError("no journaled task commit before deadline")
+
+    def scrape(uri, name):
+        with urllib.request.urlopen(f"{uri}/v1/metrics", timeout=5) as r:
+            text = r.read().decode()
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith(name) and not line.startswith("#"):
+                try:
+                    total += float(line.rsplit(None, 1)[-1])
+                except ValueError:
+                    pass
+        return total
+
+    # ---- scenario 1: kill -9 mid-query, restart, same client --------
+    procs, uris = spawn_workers(2, base_port=base_port)
+    coord_proc = None
+    try:
+        # per-scenario subdirectory: the journal is part of the spool
+        # root, and scenario 2's wait-for-dispatch must never match
+        # this scenario's records
+        root = os.path.join(
+            spool_root or tempfile.mkdtemp(prefix="chaos-recovery"),
+            "kill9",
+        )
+        os.makedirs(root, exist_ok=True)
+        port = base_port + 8
+        coord_proc, coord_uri = spawn_coordinator(
+            port, uris, root, delay_ms=250
+        )
+        client = StatementClient(coord_uri, restart_wait_s=120.0)
+        result: dict = {}
+
+        def run_client():
+            try:
+                cols, rows = client.execute(_JOIN_SQL)
+                result["rows"] = rows
+            except Exception as e:  # surfaced in the main thread
+                result["error"] = e
+
+        import threading
+
+        ct = threading.Thread(target=run_client, daemon=True)
+        t0 = time.perf_counter()
+        ct.start()
+        wait_for_commit(root)
+        pre = journal_records(root)
+        pre_commits = {
+            (r["tid"], r["a"]) for r in pre if r.get("t") == "commit"
+        }
+        n_pre = len(pre)
+        coord_proc.send_signal(signal.SIGKILL)
+        coord_proc.wait(timeout=30)
+        t_kill = time.perf_counter()
+        # restart against the same spool + port: journal replay
+        # re-serves the in-flight query at its old URI
+        coord_proc, coord_uri = spawn_coordinator(
+            port, uris, root, delay_ms=250
+        )
+        ct.join(timeout=180)
+        assert not ct.is_alive(), "client never finished after restart"
+        if "error" in result:
+            raise AssertionError(
+                f"client failed through restart: {result['error']}"
+            )
+        # protocol JSON carries decimals as strings; the oracle
+        # returns floats — coerce before the row comparison
+        got = [
+            [float(v) if isinstance(v, str)
+             and re.fullmatch(r"-?\d+(\.\d+)?", v) else v
+             for v in row]
+            for row in result["rows"]
+        ]
+        assert_rows_match(got, expected, ordered=True, abs_tol=1e-6)
+        post = journal_records(root)
+        resumed = [r for r in post if r.get("t") == "resumed"]
+        assert resumed, "restarted coordinator never journaled a resume"
+        assert resumed[-1].get("tasks_recovered_committed", 0) >= 1, (
+            "resume inherited no spool-committed attempt (the kill "
+            "landed after a commit, so at least one must carry over)"
+        )
+        # the no-recompute contract: nothing dispatched after the kill
+        # may target an attempt that had already committed
+        post_dispatches = {
+            (r["tid"], r["a"])
+            for r in post[n_pre:] if r.get("t") == "dispatch"
+        }
+        recomputed = post_dispatches & pre_commits
+        assert not recomputed, (
+            f"committed attempts re-executed after restart: {recomputed}"
+        )
+        done = [r for r in post if r.get("t") == "done"]
+        assert done and done[-1]["state"] == "FINISHED", (
+            "journal never reached a FINISHED done record"
+        )
+        record["runs"].append({
+            "scenario": "kill-mid-query",
+            "rows": len(result["rows"]),
+            "pre_kill_commits": len(pre_commits),
+            "tasks_recovered_committed": int(
+                resumed[-1].get("tasks_recovered_committed", 0)
+            ),
+            "tasks_redispatched": int(
+                resumed[-1].get("tasks_redispatched", 0)
+            ),
+            "recomputed_committed": len(recomputed),
+            "time_to_resume_ms": (time.perf_counter() - t_kill) * 1e3,
+            "client_elapsed_ms": (time.perf_counter() - t0) * 1e3,
+        })
+    finally:
+        if coord_proc is not None and coord_proc.poll() is None:
+            coord_proc.kill()
+        stop_workers(procs)
+
+    # ---- scenario 2: kill the coordinator, let the reaper clean up --
+    procs, uris = spawn_workers(
+        2, base_port=base_port + 16,
+        extra_env={"TRINO_TPU_ORPHAN_TTL_S": "0.5"},
+    )
+    coord_proc = None
+    try:
+        root = os.path.join(
+            spool_root or tempfile.mkdtemp(prefix="chaos-orphan"),
+            "orphan",
+        )
+        os.makedirs(root, exist_ok=True)
+        port = base_port + 24
+        coord_proc, coord_uri = spawn_coordinator(
+            port, uris, root, delay_ms=4000
+        )
+        client = StatementClient(coord_uri, timeout=30.0)
+        import threading
+
+        threading.Thread(
+            target=lambda: _swallow(client.execute, _JOIN_SQL),
+            daemon=True,
+        ).start()
+        # a task must be RUNNING on a worker before the kill — the
+        # journal's dispatch record alone races the actual POST (WAL
+        # appends land first), and killing inside that gap leaves the
+        # workers nothing to reap
+        def active_tasks(uri):
+            try:
+                with urllib.request.urlopen(
+                    f"{uri}/v1/info", timeout=2
+                ) as r:
+                    return int(json.loads(r.read())["activeTasks"])
+            except Exception:
+                return 0
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(active_tasks(u) >= 1 for u in uris):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError("no worker task before deadline")
+        coord_proc.send_signal(signal.SIGKILL)
+        coord_proc.wait(timeout=30)
+        coord_proc = None
+        # reaper timeline: quarantine at ttl (0.5s), cancel one grace
+        # period later; poll past it
+        reaped = buffers = 0.0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            reaped = sum(
+                scrape(u, "trino_orphan_tasks_reaped_total")
+                for u in uris
+            )
+            if reaped >= 1:
+                break
+            time.sleep(0.25)
+        assert reaped >= 1, (
+            "orphan reaper never cancelled the abandoned query's tasks"
+        )
+        # buffers drain to zero once the reaper drops the query
+        reserved = None
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            reserved = sum(
+                scrape(u, "trino_exchange_buffer_reserved_bytes")
+                for u in uris
+            )
+            if reserved == 0:
+                break
+            time.sleep(0.25)
+        assert reserved == 0, (
+            f"exchange buffers leaked after orphan GC: {reserved} bytes"
+        )
+        buffers = sum(
+            scrape(u, "trino_exchange_buffer_orphan_evictions_total")
+            for u in uris
+        )
+        record["runs"].append({
+            "scenario": "orphan-reap",
+            "tasks_reaped": int(reaped),
+            "buffer_evictions": int(buffers),
+            "reserved_after_gc": int(reserved),
+        })
+    finally:
+        if coord_proc is not None and coord_proc.poll() is None:
+            coord_proc.kill()
+        stop_workers(procs)
+    return record
+
+
+def _swallow(fn, *a):
+    try:
+        fn(*a)
+    except Exception:
+        pass
 
 
 def fired_sites(record: dict) -> set[str]:

@@ -149,6 +149,11 @@ class QueryInfoRegistry:
         self.max_finished = max_finished
         self._lock = threading.Lock()
         self._entries: dict[str, dict] = {}
+        #: query ids inherited from a pre-restart coordinator (via the
+        #: durable journal); their rows report recovered=True whether
+        #: rehydrated terminal or resumed live — checked by _entry so
+        #: the flag survives a begin() racing the recovery thread
+        self._recovered_ids: set[str] = set()
 
     def _entry(self, query_id: str) -> dict:
         e = self._entries.get(query_id)
@@ -170,6 +175,9 @@ class QueryInfoRegistry:
                 "tasks": {},
                 #: post-mortem diagnostic bundle (failed queries only)
                 "diagnostics": None,
+                #: True when this row crossed a coordinator restart
+                #: (journal-rehydrated or journal-resumed)
+                "recovered": query_id in self._recovered_ids,
             }
         return e
 
@@ -232,6 +240,48 @@ class QueryInfoRegistry:
                 }
             self._sweep_locked()
 
+    def mark_recovered(self, query_id: str) -> None:
+        """Flag a query as crossing a coordinator restart. Safe to
+        call before its begin(): the id is remembered and the flag
+        applied when the entry materializes."""
+        if not query_id:
+            return
+        with self._lock:
+            self._recovered_ids.add(query_id)
+            e = self._entries.get(query_id)
+            if e is not None:
+                e["recovered"] = True
+
+    def rehydrate(self, query_id: str, *, state: str,
+                  sql: str | None = None, user: str | None = None,
+                  rows: int | None = None, error: str | None = None,
+                  elapsed_ms: float = 0.0,
+                  diagnostics: dict | None = None) -> None:
+        """Restore a terminal query's registry row from its journal
+        `done` record after a coordinator restart. The row reports
+        recovered=True; task trees are not journaled, so the stage
+        list comes back empty (the post-mortem bundle, when present,
+        preserves the failure's full context)."""
+        if not query_id:
+            return
+        with self._lock:
+            self._recovered_ids.add(query_id)
+            e = self._entry(query_id)
+            e["recovered"] = True
+            e["state"] = state
+            e["sql"] = sql if sql is not None else e["sql"]
+            e["user"] = user if user is not None else e["user"]
+            e["rows"] = int(rows) if rows is not None else e["rows"]
+            e["error"] = error
+            # reconstruct the timeline the elapsed math expects
+            e["finished_at"] = time.time()
+            e["created_at"] = e["finished_at"] - (
+                float(elapsed_ms or 0.0) / 1e3
+            )
+            if diagnostics is not None:
+                e["diagnostics"] = diagnostics
+            self._sweep_locked()
+
     def set_diagnostics(self, query_id: str, bundle: dict) -> None:
         """Retain a post-mortem bundle; served by
         ``GET /v1/query/{id}/diagnostics`` until the entry sweeps."""
@@ -265,6 +315,7 @@ class QueryInfoRegistry:
                     "peak_memory_bytes": e["peak_memory_bytes"],
                     "rows": e["rows"],
                     "error": e["error"],
+                    "recovered": bool(e.get("recovered")),
                 }
                 for e in self._entries.values()
             ]
@@ -297,6 +348,7 @@ class QueryInfoRegistry:
                 "peak_memory_bytes": e["peak_memory_bytes"],
                 "rows": e["rows"],
                 "error": e["error"],
+                "recovered": bool(e.get("recovered")),
                 "stages": list(stages.values()),
             }
 
