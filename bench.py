@@ -167,6 +167,15 @@ def main(argv=None) -> int:
         "counts on an abandoned fleet",
     )
     ap.add_argument(
+        "--sentry", action="store_true",
+        help="also run the performance-sentry detection benchmark: "
+        "warmed TPC-H q01/q03/q06 twin runs where the second q03 run "
+        "carries a seeded compile-delay fault; asserts the sentry "
+        "flags exactly that query with driver=xla_compile (zero false "
+        "positives on the healthy twin) and records detection latency "
+        "and per-statement observation overhead",
+    )
+    ap.add_argument(
         "--trace-dir", default=os.environ.get("BENCH_TRACE_DIR"),
         help="export each warmup query's trace as Chrome trace-event "
         "JSON (<dir>/<qid>.trace.json — load in chrome://tracing or "
@@ -669,7 +678,103 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         # 19600+ tests/test_recovery.py).
         _recovery_section(detail)
 
+    if (
+        args.sentry or _section_enabled("BENCH_SENTRY", False)
+    ) and fits("sentry", 120.0):
+        _sentry_section(detail)
+
     return 0
+
+
+def _sentry_section(detail) -> None:
+    """Performance-sentry detection benchmark: warm per-plan baselines
+    on TPC-H q01/q03/q06, prove a healthy twin run emits ZERO
+    anomalies, then inject a seeded compile-delay into a second q03
+    run and measure how fast the sentry turns it into a typed
+    xla_compile verdict. Runs against its own throwaway history store
+    so the numbers never leak into (or read from) the serving one."""
+    import tempfile
+    import time
+
+    from trino_tpu import fault, history, sentry
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.engine import QueryRunner
+
+    prev_history = history.active()
+    prev_sentry = sentry.active()
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="bench-sentry-") as root:
+        store = history.QueryHistory(root=root)
+        history.set_active(store)
+        sen = sentry.Sentry(store)
+        sentry.set_active(sen)
+        try:
+            runner = QueryRunner.tpch("tiny")
+            qids = ("q01", "q03", "q06")
+            # warm: enough clean samples per plan shape for verdicts
+            for _ in range(sen.min_samples + 1):
+                for q in qids:
+                    runner.execute(QUERIES[q])
+            # healthy twin: the zero-false-positive contract
+            for q in qids:
+                runner.execute(QUERIES[q])
+            healthy_anomalies = len(sen.anomalies())
+            assert healthy_anomalies == 0, (
+                f"sentry flagged {healthy_anomalies} anomalies on "
+                f"healthy warmed twin runs"
+            )
+            # faulted twin: seeded compile-delay on q03 only
+            inj = fault.FaultInjector(
+                seed=int(os.environ.get("BENCH_SENTRY_SEED", "0"))
+            )
+            inj.arm_nth("compile-delay", 1)
+            fault.activate(inj)
+            try:
+                runner.execute(QUERIES["q03"])
+            finally:
+                fault.deactivate()
+            verdicts = sen.anomalies()
+            assert len(verdicts) == 1, (
+                f"expected exactly one verdict, got {len(verdicts)}"
+            )
+            v = verdicts[0]
+            assert v.driver == "xla_compile", (
+                f"wrong driver attribution: {v.driver}"
+            )
+            flagged = store.entries()[-1]
+            assert flagged["query_id"] == v.query_id, (
+                "verdict names a different query than the faulted run"
+            )
+            # detection latency: statement completion stamp -> verdict
+            # stamp (both taken on the completion path; the sentry is
+            # inline, so this is the true time-to-verdict)
+            detail["sentry_detection_latency_ms"] = round(
+                max(v.ts - flagged["ts"], 0.0) * 1e3, 3
+            )
+            detail["sentry_anomaly_ratio"] = v.ratio
+            detail["sentry_baselines"] = sen.baseline_count()
+            detail["sentry_healthy_anomalies"] = healthy_anomalies
+            # per-statement observation overhead: the real listener
+            # work (durable history append + baseline judge/observe)
+            # replayed with a clean at-baseline sample
+            model = sen.model_for(
+                v.plan_digest, v.fingerprint
+            )
+            probe = dict(flagged)
+            probe["query_id"] = "overhead-probe"
+            probe["wall_ms"] = model.p50() if model else 1.0
+            t_ov = time.perf_counter()
+            reps = 200
+            for _ in range(reps):
+                store.append(dict(probe))
+                sen.observe(dict(probe))
+            detail["sentry_overhead_ms"] = round(
+                (time.perf_counter() - t_ov) / reps * 1e3, 4
+            )
+        finally:
+            history.set_active(prev_history)
+            sentry.set_active(prev_sentry)
+    detail["sentry_wall_s"] = round(time.perf_counter() - t0, 1)
 
 
 def _recovery_section(detail) -> None:

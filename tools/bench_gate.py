@@ -88,6 +88,11 @@ _RATE_KEYS = [
     ("detail.serving_uncached_p50_ms", False),
     ("detail.result_cache_hit_ratio", True),
     ("detail.serving_cache_cold_p99_ms", False),
+    # sentry keys (BENCH_r11+, ``bench.py --sentry``): how fast the
+    # performance sentry turned an injected regression into a typed
+    # verdict; SKIP against baselines that predate the sentry
+    ("detail.sentry_detection_latency_ms", False),
+    ("detail.sentry_overhead_ms", False),
 ]
 # NOT banded: the per-query ``detail.{q}_time_breakdown`` dicts
 # (BENCH_r08+, flight recorder) are informational — dict-valued and

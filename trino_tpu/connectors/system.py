@@ -118,6 +118,44 @@ _CACHES_SCHEMA = TableSchema("caches", [
 ])
 
 
+#: durable query history (the performance sentry's record): one row
+#: per completed statement the process retained, keyed by the journal
+#: plan digest + session-property fingerprint the baselines use
+_QUERY_HISTORY_SCHEMA = TableSchema("query_history", [
+    ("query_id", T.VARCHAR),
+    ("ts", T.DOUBLE),
+    ("user", T.VARCHAR),
+    ("state", T.VARCHAR),
+    ("plan_digest", T.VARCHAR),
+    ("fingerprint", T.VARCHAR),
+    ("wall_ms", T.DOUBLE),
+    ("rows", T.BIGINT),
+    ("peak_memory_bytes", T.BIGINT),
+    ("compiles", T.BIGINT),
+    ("cache_hit_tier", T.VARCHAR),
+    ("exchange_skew", T.DOUBLE),
+    ("top_bucket", T.VARCHAR),
+    ("top_bucket_ms", T.DOUBLE),
+    ("critical_path_tail", T.VARCHAR),
+])
+
+
+#: typed AnomalyVerdicts the sentry emitted this process lifetime
+_ANOMALIES_SCHEMA = TableSchema("anomalies", [
+    ("query_id", T.VARCHAR),
+    ("ts", T.DOUBLE),
+    ("plan_digest", T.VARCHAR),
+    ("fingerprint", T.VARCHAR),
+    ("wall_ms", T.DOUBLE),
+    ("baseline_p50_ms", T.DOUBLE),
+    ("ratio", T.DOUBLE),
+    ("driver", T.VARCHAR),
+    ("driver_delta_ms", T.DOUBLE),
+    ("samples", T.BIGINT),
+    ("message", T.VARCHAR),
+])
+
+
 class SystemConnector(Connector):
     """Read-only views over live engine state. ``source`` is the
     owning Coordinator (queries) and/or runner (nodes); either may be
@@ -137,6 +175,7 @@ class SystemConnector(Connector):
             return [
                 "queries", "nodes", "memory", "tasks",
                 "cluster_metrics", "programs", "caches",
+                "query_history", "anomalies",
             ]
         return []
 
@@ -157,6 +196,10 @@ class SystemConnector(Connector):
             return _PROGRAMS_SCHEMA
         if table == "caches":
             return _CACHES_SCHEMA
+        if table == "query_history":
+            return _QUERY_HISTORY_SCHEMA
+        if table == "anomalies":
+            return _ANOMALIES_SCHEMA
         raise KeyError(f"{schema}.{table}")
 
     def _query_rows(self):
@@ -353,6 +396,51 @@ class SystemConnector(Connector):
             ),
         ]
 
+    def _query_history_rows(self):
+        from trino_tpu import history as history_mod
+
+        out = []
+        for e in history_mod.active().entries():
+            buckets = e.get("buckets") or {}
+            top, top_ms = "", 0.0
+            for name, ms in buckets.items():
+                if float(ms or 0.0) > top_ms:
+                    top, top_ms = str(name), float(ms)
+            tail = e.get("critical_path_tail") or {}
+            tail_str = (
+                f"{tail.get('name')}@{tail.get('node')} "
+                f"({float(tail.get('duration_ms') or 0.0):.1f} ms)"
+                if tail else ""
+            )
+            out.append((
+                str(e.get("query_id") or ""),
+                float(e.get("ts") or 0.0),
+                str(e.get("user") or ""),
+                str(e.get("state") or ""),
+                str(e.get("plan_digest") or ""),
+                str(e.get("fingerprint") or ""),
+                float(e.get("wall_ms") or 0.0),
+                int(e.get("rows") or 0),
+                int(e.get("peak_memory_bytes") or 0),
+                int(e.get("compiles") or 0),
+                str(e.get("cache_hit_tier") or ""),
+                float(e.get("exchange_skew") or 0.0),
+                top, top_ms, tail_str,
+            ))
+        return out
+
+    def _anomaly_rows(self):
+        from trino_tpu import sentry as sentry_mod
+
+        return [
+            (
+                v.query_id, v.ts, v.plan_digest, v.fingerprint,
+                v.wall_ms, v.baseline_p50_ms, v.ratio, v.driver,
+                v.driver_delta_ms, int(v.samples), v.message,
+            )
+            for v in sentry_mod.active().anomalies()
+        ]
+
     def _rows(self, table: str):
         if table == "queries":
             return self._query_rows()
@@ -366,6 +454,10 @@ class SystemConnector(Connector):
             return self._program_rows()
         if table == "caches":
             return self._cache_rows()
+        if table == "query_history":
+            return self._query_history_rows()
+        if table == "anomalies":
+            return self._anomaly_rows()
         return self._node_rows()
 
     def row_count(self, schema: str, table: str) -> int:

@@ -161,6 +161,11 @@ class QueryRunner:
                 ))
             )
         )
+        # performance sentry observes every statement this runner
+        # completes (no-op when TRINO_TPU_SENTRY=0)
+        from trino_tpu import sentry as _sentry
+
+        _sentry.ensure_installed(self.metadata)
 
     @staticmethod
     def tpch(schema: str = "tiny", mesh=None) -> "QueryRunner":
@@ -340,6 +345,9 @@ class QueryRunner:
                 or "OFF"
             ).upper()
             t0 = time.perf_counter()
+            # compile-counter baseline: the delta attributes THIS
+            # statement's backend compiles (hook is process-wide)
+            comp0 = telemetry.compile_snapshot()
             error = None
             result = None
             try:
@@ -406,6 +414,30 @@ class QueryRunner:
                     result._query_info_resolver = (
                         lambda: _local_query_info(_ex, _prof, _qid)
                     )
+                comp1 = telemetry.compile_snapshot()
+                compiles_delta = int(
+                    comp1.get("compiles", 0) - comp0.get("compiles", 0)
+                )
+                compile_ms_delta = max(
+                    (
+                        comp1.get("compile_seconds", 0.0)
+                        - comp0.get("compile_seconds", 0.0)
+                    ) * 1e3,
+                    0.0,
+                )
+                plan_digest = None
+                fingerprint = None
+                if result is not None and result.plan is not None:
+                    from trino_tpu import history as history_mod
+                    from trino_tpu import journal as journal_mod
+
+                    try:
+                        plan_digest = journal_mod.plan_digest(result.plan)
+                    except Exception:
+                        plan_digest = None
+                    fingerprint = history_mod.session_fingerprint(
+                        self.session
+                    )
                 if result is not None:
                     result.trace = tracer.finish()
                     result.planning_ms = plan_ms
@@ -415,6 +447,7 @@ class QueryRunner:
                     result.time_breakdown = (
                         telemetry_analysis.compute_time_breakdown(
                             result.trace, elapsed_ms, op_stats=op_stats,
+                            compile_ms=compile_ms_delta,
                         )
                     )
                     if (
@@ -430,6 +463,17 @@ class QueryRunner:
                             for line in telemetry_analysis
                             .format_breakdown(result.time_breakdown)
                         )
+                        # sentry baseline footer — judged against
+                        # history that does NOT yet include this run
+                        # (completion fires below)
+                        from trino_tpu import sentry as sentry_mod
+
+                        _bf = sentry_mod.baseline_footer(
+                            plan_digest, fingerprint or "",
+                            elapsed_ms, result.time_breakdown,
+                        )
+                        if _bf:
+                            result.rows.append((_bf,))
                     if not result.stage_stats:
                         # local execution is one pseudo-stage; the fleet
                         # runner fills real per-stage aggregates instead
@@ -500,6 +544,25 @@ class QueryRunner:
                         ),
                         workers_readmitted=(
                             result.workers_readmitted if result else 0
+                        ),
+                        plan_digest=plan_digest,
+                        session_fingerprint=fingerprint,
+                        cache_hit_tier=(
+                            "result"
+                            if result is not None
+                            and result.cache_stats
+                            and (
+                                result.cache_stats.get("result") or {}
+                            ).get("hit")
+                            else None
+                        ),
+                        compiles=compiles_delta,
+                        time_breakdown=(
+                            result.time_breakdown if result else None
+                        ),
+                        trace=result.trace if result else None,
+                        task_stats=tuple(
+                            result.task_stats if result else ()
                         ),
                     ))
                 from trino_tpu.events import maybe_log_slow_query
@@ -689,7 +752,14 @@ class QueryRunner:
         self.executor._defer_ok = True
         try:
             done = False
-            with exec_span:
+            with exec_span as _sp:
+                # anchor compile-kind work (persistent-cache reads,
+                # injected compile delays) under the local exec span —
+                # the worker task loop does the same for fleet tasks
+                from trino_tpu import jit_cache
+
+                if _sp is not None:
+                    jit_cache.set_active_span(_sp)
                 for _attempt in range(8):
                     page = self.executor.execute(plan)
                     pend = getattr(page, "pending_flags", None)
@@ -713,6 +783,9 @@ class QueryRunner:
                 )
         finally:
             self.executor._defer_ok = False
+            from trino_tpu import jit_cache
+
+            jit_cache.set_active_span(None)
         ordered = _has_order(plan)
         if rcache is not None:
             rcache.put(digest, list(page.names), rows, ordered, tokens)
@@ -1150,6 +1223,12 @@ class QueryRunner:
                 )
         out = QueryResult(["Query Plan"], [(line,) for line in lines])
         out.stage_stats = stage_stats
+        # EXPLAIN ANALYZE executed the inner statement for real, so it
+        # carries the inner plan: the sentry digests it and the footer
+        # compares against the plain statement's own baseline (plain
+        # EXPLAIN stays plan-less — a planning-only wall clock must
+        # never feed an execution baseline)
+        out.plan = plan
         if kp_cap is not None:
             out.kernel_profile = kp_cap.summary()
         return out

@@ -68,6 +68,26 @@ class QueryCompletedEvent:
     tasks_speculated: int = 0
     speculation_wins: int = 0
     workers_readmitted: int = 0
+    #: performance-sentry identity: the journal plan digest + session
+    #: property fingerprint keying this statement's baseline (None for
+    #: unplannable/errored statements)
+    plan_digest: str | None = None
+    session_fingerprint: str | None = None
+    #: which cache tier served the result ("result" / "hbm" / None)
+    cache_hit_tier: str | None = None
+    #: real backend compiles attributed to this statement
+    compiles: int = 0
+    #: worst exchange partition max/mean ratio across stages (1.0 =
+    #: perfectly balanced; 0.0 = no exchanges)
+    exchange_skew: float = 0.0
+    #: heavy diagnostic context — excluded from eq/hash (the frozen
+    #: event stays hashable) and dropped by StructuredLogListener;
+    #: carried so the sentry can bundle an anomalous SUCCESS with the
+    #: same evidence a failure gets
+    time_breakdown: dict | None = field(default=None, compare=False)
+    plan_text: str | None = field(default=None, compare=False)
+    trace: object = field(default=None, compare=False)
+    task_stats: tuple = field(default=(), compare=False)
 
 
 class EventListener:
@@ -94,7 +114,16 @@ class StructuredLogListener(EventListener):
         self._stream = stream
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
-        rec = dataclasses.asdict(event)
+        # drop the heavy diagnostic payloads BEFORE asdict: the trace
+        # is a live span tree (deep-copying it is wrong and expensive)
+        # and the query log is a summary stream, not a bundle store
+        slim = dataclasses.replace(
+            event, trace=None, task_stats=(), plan_text=None,
+        )
+        rec = dataclasses.asdict(slim)
+        rec.pop("trace", None)
+        rec.pop("task_stats", None)
+        rec.pop("plan_text", None)
         rec["peak_memory_per_node"] = [
             list(kv) for kv in event.peak_memory_per_node
         ]

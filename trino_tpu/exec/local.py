@@ -12,6 +12,7 @@ reference's build-side barriers.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import replace as dc_replace
 
@@ -19,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from trino_tpu import memory, program_catalog, telemetry
+from trino_tpu import fault, jit_cache, memory, program_catalog, telemetry
 from trino_tpu import types as T
 from trino_tpu.exec import kernels as K
 from trino_tpu.exec import shapes, stage
@@ -69,6 +70,40 @@ def _scan_column(t, raw, capacity, hashed: bool = False) -> Column:
             t, np.asarray(raw, dtype=object), valid, capacity
         )
     return Column.from_numpy(t, raw, valid=valid, capacity=capacity)
+
+
+#: seconds a fired ``compile-delay`` fault stalls one dispatch
+COMPILE_DELAY_ENV = "TRINO_TPU_COMPILE_DELAY_S"
+DEFAULT_COMPILE_DELAY_S = 0.25
+
+
+def _maybe_compile_delay() -> None:
+    """``compile-delay`` fault hook on the chain-dispatch path.
+
+    Unlike every other site, a fired fault here fails NOTHING: it
+    sleeps inside a compile-kind child of the thread's trace anchor,
+    so the stall lands in the flight recorder's xla_compile bucket —
+    a deterministic stand-in for an XLA recompile storm that the
+    performance sentry must detect and attribute on a WARMED statement
+    (whose real programs are cached and never recompile)."""
+    try:
+        fault.check("compile-delay")
+        return
+    except fault.InjectedFault:
+        pass
+    delay = float(
+        os.environ.get(COMPILE_DELAY_ENV, "") or DEFAULT_COMPILE_DELAY_S
+    )
+    parent = jit_cache.active_span()
+    sp = (
+        parent.child("injected-compile-delay", "compile")
+        if parent is not None else None
+    )
+    try:
+        time.sleep(delay)
+    finally:
+        if sp is not None:
+            sp.finish()
 
 
 class QueryCancelled(RuntimeError):
@@ -732,6 +767,7 @@ class LocalExecutor:
             program_catalog.CATALOG.note_hit(key)
         if self.profiler is not None:
             self.profiler.note_dispatch(key)
+        _maybe_compile_delay()
         if was_miss:
             # the first call pays jit trace + backend compile (or a
             # persistent-cache deserialize) before the async dispatch

@@ -34,6 +34,11 @@ Sites (one string per architectural seam):
     ``journal-read`` query-journal replay on coordinator restart
                     (journal.py load/scan; a failed read makes the
                     query non-resumable, never silently wrong)
+    ``compile-delay`` executor dispatch (exec/local.py); a fired
+                    fault does NOT fail anything — it sleeps inside a
+                    compile-kind span, simulating an XLA compile storm
+                    so the performance sentry's attribution can be
+                    exercised end-to-end on warmed statements
 
 Schedules: ``arm`` (attempts 0..times-1 fail — the classic retry
 shape), ``arm_nth`` (exactly the n-th matching call fails), and
@@ -65,7 +70,8 @@ __all__ = [
 SITES = frozenset(
     ["rpc", "spool-write", "spool-read", "task-exec", "device-oom",
      "planner", "compile-deserialize", "scan-read", "exchange-fetch",
-     "heartbeat-loss", "announce-drop", "journal-write", "journal-read"]
+     "heartbeat-loss", "announce-drop", "journal-write", "journal-read",
+     "compile-delay"]
 )
 
 

@@ -1,0 +1,233 @@
+"""Durable per-query history: the record the performance sentry reads.
+
+The flight recorder (telemetry_analysis) can decompose any single
+query's wall clock, but every measurement dies with the process — a
+query that silently goes 3× slower than its own history looks healthy.
+This module is the memory: one bounded JSONL file of compact per-query
+records (wall clock, bucketed time breakdown, rows, peak memory,
+compile count, cache hit tier, exchange skew, critical-path tail),
+keyed by the journal plan digest + a session-property fingerprint so
+"the same statement shape under the same knobs" compares against
+itself and nothing else.
+
+Storage contract:
+
+* in-memory ring always (``system.runtime.query_history`` and
+  ``GET /v1/history`` work with no configuration);
+* when ``TRINO_TPU_HISTORY_DIR`` is set, every append lands in
+  ``<dir>/history.jsonl`` and the file is compacted back to the
+  retention bound once it grows past 2× — the store survives a
+  coordinator restart and :mod:`trino_tpu.sentry` rebuilds its
+  baselines from it on startup;
+* records are plain dicts (no schema class): forward compatibility
+  across PRs matters more than attribute access, and the sentry reads
+  them with ``.get``.
+
+Appends come from the EventListener completion path on BOTH node
+shapes — coordinator/fleet statements and runner-direct statements —
+so the history is the union of everything this process finished.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from collections import deque
+
+from trino_tpu import telemetry
+
+__all__ = [
+    "QueryHistory", "session_fingerprint", "entry_from_event",
+    "active", "set_active", "history_dir",
+]
+
+#: retention bound (records, both in-memory and on disk)
+MAX_ENTRIES_ENV = "TRINO_TPU_HISTORY_MAX"
+DEFAULT_MAX_ENTRIES = 4096
+
+
+def history_dir() -> str | None:
+    """Durable history directory, or None (= in-memory ring only)."""
+    return os.environ.get("TRINO_TPU_HISTORY_DIR") or None
+
+
+def session_fingerprint(session) -> str:
+    """Stable digest of every session property — the baseline key's
+    second half. Two sessions with any differing knob (partition
+    count, exchange mode, cache toggles...) never share a baseline:
+    the knobs change the plan's runtime shape even when the plan tree
+    digests identically."""
+    props = getattr(session, "properties", None) or {}
+    payload = "|".join(
+        f"{k}={props[k]!r}" for k in sorted(props)
+    )
+    return hashlib.blake2b(
+        payload.encode(), digest_size=8
+    ).hexdigest()
+
+
+class QueryHistory:
+    """Bounded, optionally durable, append-only query history.
+
+    Thread-safe: completion events fire from whatever thread finished
+    the statement (serving runners complete concurrently).
+    """
+
+    def __init__(self, root: str | None = None,
+                 max_entries: int | None = None):
+        if max_entries is None:
+            max_entries = int(
+                os.environ.get(MAX_ENTRIES_ENV, "")
+                or DEFAULT_MAX_ENTRIES
+            )
+        self.max_entries = max(1, int(max_entries))
+        self.root = root if root is not None else history_dir()
+        self._lock = threading.Lock()
+        self._entries: deque[dict] = deque(maxlen=self.max_entries)
+        #: lines currently in the JSONL file (compaction trigger)
+        self._file_lines = 0
+        if self.root:
+            self._load()
+
+    # ---- durability ------------------------------------------------
+    @property
+    def path(self) -> str | None:
+        if not self.root:
+            return None
+        return os.path.join(self.root, "history.jsonl")
+
+    def _load(self) -> None:
+        """Rehydrate the ring from the JSONL file (restart path). A
+        torn tail line — a crash mid-append — is skipped, never fatal:
+        history informs, it must not wedge startup."""
+        path = self.path
+        if path is None or not os.path.exists(path):
+            return
+        loaded = 0
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        entry = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(entry, dict):
+                        self._entries.append(entry)
+                        loaded += 1
+        except OSError:
+            return
+        self._file_lines = loaded
+        telemetry.HISTORY_ENTRIES.set(len(self._entries))
+
+    def _compact(self) -> None:
+        """Rewrite the file to exactly the retained ring (called under
+        the lock once the file doubles past the bound)."""
+        path = self.path
+        if path is None:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for e in self._entries:
+                f.write(json.dumps(e, default=str) + "\n")
+        os.replace(tmp, path)
+        self._file_lines = len(self._entries)
+
+    # ---- recording -------------------------------------------------
+    def append(self, entry: dict) -> None:
+        """Retain one completed-query record (and persist it when a
+        history directory is configured). Never raises — history rides
+        the completion path of every statement."""
+        try:
+            with self._lock:
+                self._entries.append(entry)
+                path = self.path
+                if path is not None:
+                    os.makedirs(self.root, exist_ok=True)
+                    with open(path, "a") as f:
+                        f.write(json.dumps(entry, default=str) + "\n")
+                    self._file_lines += 1
+                    if self._file_lines > 2 * self.max_entries:
+                        self._compact()
+            telemetry.HISTORY_ENTRIES.set(len(self._entries))
+        except Exception:
+            pass
+
+    # ---- reading ---------------------------------------------------
+    def entries(self, limit: int | None = None) -> list[dict]:
+        """Most-recent-last snapshot of the ring (bounded by
+        ``limit`` from the tail when given)."""
+        with self._lock:
+            out = list(self._entries)
+        if limit is not None and limit >= 0:
+            out = out[-limit:]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+def entry_from_event(event) -> dict:
+    """Compact history record for one QueryCompletedEvent (the
+    sentry-enriched shape: plan digest, breakdown, cache tier...)."""
+    breakdown = getattr(event, "time_breakdown", None) or {}
+    cp = breakdown.get("critical_path") or []
+    tail = cp[-1] if cp else None
+    return {
+        "query_id": event.query_id,
+        "ts": float(event.end_time),
+        "user": event.user,
+        "state": event.state,
+        "error": event.error,
+        "plan_digest": getattr(event, "plan_digest", None),
+        "fingerprint": getattr(event, "session_fingerprint", None),
+        "wall_ms": round(float(event.elapsed_ms), 3),
+        "rows": int(event.rows),
+        "peak_memory_bytes": int(event.peak_memory_bytes),
+        "compiles": int(getattr(event, "compiles", 0) or 0),
+        "cache_hit_tier": getattr(event, "cache_hit_tier", None),
+        "exchange_skew": round(
+            float(getattr(event, "exchange_skew", 0.0) or 0.0), 4
+        ),
+        "buckets": dict(breakdown.get("buckets") or {}),
+        "critical_path_tail": (
+            {
+                "name": tail.get("name"),
+                "node": tail.get("node"),
+                "duration_ms": tail.get("duration_ms"),
+            }
+            if isinstance(tail, dict) else None
+        ),
+    }
+
+
+# ---- process-global store -----------------------------------------
+#
+# Lazy singleton (not import-time): tests and embedded runners point
+# TRINO_TPU_HISTORY_DIR somewhere and reset; eager construction would
+# freeze the env var's import-time value.
+
+_active: QueryHistory | None = None
+_active_lock = threading.Lock()
+
+
+def active() -> QueryHistory:
+    """The process history store (created on first use)."""
+    global _active
+    with _active_lock:
+        if _active is None:
+            _active = QueryHistory()
+        return _active
+
+
+def set_active(h: QueryHistory | None) -> None:
+    """Install (or, with None, drop for lazy re-creation) the process
+    store — the test/bench seam for pointing history at a tmpdir."""
+    global _active
+    with _active_lock:
+        _active = h

@@ -250,6 +250,7 @@ class Coordinator:
                     # latency histograms
                     from trino_tpu import telemetry
 
+                    telemetry.refresh_process_gauges(node="coordinator")
                     body = telemetry.REGISTRY.render().encode()
                     self.send_response(200)
                     self.send_header(
@@ -274,6 +275,40 @@ class Coordinator:
                     # live QueryInfo list (QueryResource analog): one
                     # light row per known query
                     self._send(200, coordinator.query_info_list())
+                    return
+                if self.path.split("?")[0] == "/v1/history":
+                    # the performance sentry's durable query history
+                    # (most-recent-last; ?limit=N bounds the tail)
+                    from trino_tpu import history as history_mod
+
+                    limit = None
+                    if "?" in self.path:
+                        from urllib.parse import parse_qs
+
+                        qs = parse_qs(self.path.split("?", 1)[1])
+                        if qs.get("limit"):
+                            try:
+                                limit = int(qs["limit"][0])
+                            except ValueError:
+                                limit = None
+                    store = history_mod.active()
+                    self._send(200, {
+                        "entries": store.entries(limit=limit),
+                        "total": len(store),
+                        "durable": store.path is not None,
+                    })
+                    return
+                if self.path == "/v1/anomalies":
+                    # typed AnomalyVerdicts the sentry has emitted
+                    from trino_tpu import sentry as sentry_mod
+
+                    sen = sentry_mod.active()
+                    self._send(200, {
+                        "anomalies": [
+                            v.to_dict() for v in sen.anomalies()
+                        ],
+                        "baselines": sen.baseline_count(),
+                    })
                     return
                 if self.path == "/v1/cluster/timeseries":
                     # the bounded metric ring the background recorder

@@ -400,6 +400,11 @@ class FleetRunner:
         #: sha256 of the current statement's fragmented plan wire form
         #: (journaled per epoch; resume re-derives and must match)
         self._plan_digest: str | None = None
+        # performance sentry observes every statement this runner
+        # completes (no-op when TRINO_TPU_SENTRY=0)
+        from trino_tpu import sentry as _sentry
+
+        _sentry.ensure_installed(self.metadata)
 
     def request_kill(self, error: str) -> bool:
         """Cross-query memory kill (serving mode): mark this query as
@@ -517,6 +522,7 @@ class FleetRunner:
         self._last_trace = None
         self._last_stages = None
         self._last_plan = None
+        self._plan_digest = None
         self._task_stats = []
         metrics_before = telemetry.REGISTRY.snapshot()
         try:
@@ -610,8 +616,33 @@ class FleetRunner:
                 )
 
                 elapsed_ms = (time.perf_counter() - t0) * 1e3
+                from trino_tpu import history as history_mod
+
+                _skew = 0.0
+                _compiles = 0
+                _tier = None
+                if result is not None:
+                    for _st in result.stage_stats or []:
+                        _ps = _st.get("partition_skew") or {}
+                        _skew = max(
+                            _skew,
+                            float(_ps.get("max_mean_ratio", 0.0) or 0.0),
+                        )
+                    if result.trace is not None:
+                        _compiles = sum(
+                            1 for _s in result.trace.spans()
+                            if _s.kind == "compile"
+                        )
+                    if result.cache_stats and (
+                        result.cache_stats.get("result") or {}
+                    ).get("hit"):
+                        _tier = "result"
+                # the PUBLIC id: it is what the tracker, journal, and
+                # GET /v1/query/{id}/... speak — an anomaly bundle
+                # keyed by the internal attempt id would be
+                # unreachable from the client's side
                 fire_query_completed(listeners, QueryCompletedEvent(
-                    query_id=self._query_id or "",
+                    query_id=public_qid,
                     user=self.session.user,
                     sql=sql,
                     state=state,
@@ -643,6 +674,25 @@ class FleetRunner:
                     ),
                     workers_readmitted=self.stats.get(
                         "workers_readmitted", 0
+                    ),
+                    plan_digest=self._plan_digest,
+                    session_fingerprint=(
+                        history_mod.session_fingerprint(self.session)
+                    ),
+                    cache_hit_tier=_tier,
+                    compiles=_compiles,
+                    exchange_skew=_skew,
+                    time_breakdown=(
+                        result.time_breakdown if result else None
+                    ),
+                    plan_text=(
+                        P.plan_tree_str(self._last_plan)
+                        if getattr(self, "_last_plan", None) is not None
+                        else None
+                    ),
+                    trace=result.trace if result else self._last_trace,
+                    task_stats=tuple(
+                        dict(ts) for ts in (self._task_stats or [])
                     ),
                 ))
 
@@ -797,6 +847,20 @@ class FleetRunner:
         lines.extend(
             telemetry_analysis.format_breakdown(res.time_breakdown)
         )
+        # sentry baseline footer — judged against history that does
+        # NOT yet include this run (completion fires in execute()'s
+        # finally, after this render)
+        from trino_tpu import history as history_mod
+        from trino_tpu import sentry as sentry_mod
+
+        _bf = sentry_mod.baseline_footer(
+            self._plan_digest,
+            history_mod.session_fingerprint(self.session),
+            (res.execution_ms or 0.0) + (res.planning_ms or 0.0),
+            res.time_breakdown,
+        )
+        if _bf:
+            lines.append(_bf)
         plan = getattr(self, "_last_plan", None)
         if plan is not None:
             lines.extend(P.plan_tree_str(plan).splitlines())
@@ -976,6 +1040,15 @@ class FleetRunner:
                     # reused across attempts (it is deterministic)
                     t_plan = time.perf_counter()
                     plan = self._planner.plan_stmt(stmt)
+                    # identity for journal resume AND the sentry
+                    # baseline key — computed for every planned
+                    # statement (cache hits included: a plan that
+                    # usually hits needs a baseline to miss against)
+                    self._last_plan = plan
+                    try:
+                        self._plan_digest = journal_mod.plan_digest(plan)
+                    except Exception:
+                        self._plan_digest = None
                     # semantic result-cache probe BEFORE fragmentation:
                     # a hit serves byte-identical rows without building
                     # stages or dispatching a single task
@@ -995,13 +1068,7 @@ class FleetRunner:
                     self._plan_ms = (
                         (time.perf_counter() - t_plan) * 1e3
                     )
-                    self._last_plan = plan
                     self._last_stages = stages
-                    if (
-                        self.journal is not None
-                        or self._resume_entry is not None
-                    ):
-                        self._plan_digest = journal_mod.plan_digest(plan)
                     ent = self._resume_entry
                     if ent is not None:
                         jd = (ent.epoch or {}).get("plan_digest")

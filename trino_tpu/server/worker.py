@@ -355,6 +355,7 @@ class WorkerServer:
                     # Prometheus text exposition of the process-wide
                     # registry (worker-side counters: task states,
                     # spool bytes, chaos injections, XLA compiles)
+                    telemetry.refresh_process_gauges(node="worker")
                     body = telemetry.REGISTRY.render().encode()
                     self.send_response(200)
                     self.send_header(

@@ -19,6 +19,7 @@ OpenTelemetry tracing split):
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import uuid
@@ -694,6 +695,74 @@ QUERIES_RECOVERED = REGISTRY.counter(
     "trino_queries_recovered_total",
     "Journaled queries adopted by a restarted coordinator, by outcome "
     "(resumed / rehydrated / unresumable)")
+JOURNAL_GC_REMOVED = REGISTRY.counter(
+    "trino_journal_gc_removed_total",
+    "Terminal query-journal entries removed by the tracker's periodic "
+    "GC sweep (keeps _journal/ bounded across restarts)")
+HISTORY_ENTRIES = REGISTRY.gauge(
+    "trino_history_entries",
+    "Completed-query records currently retained by the performance "
+    "sentry's history store")
+ANOMALIES = REGISTRY.counter(
+    "trino_anomalies_total",
+    "Completion-time anomaly verdicts emitted by the performance "
+    "sentry, by driver bucket (xla_compile / scan / exchange / "
+    "straggler_slack / cache_miss_expected_hit / ...)")
+PROCESS_RSS = REGISTRY.gauge(
+    "trino_process_rss_bytes",
+    "Resident set size of this node process")
+PROCESS_OPEN_FDS = REGISTRY.gauge(
+    "trino_process_open_fds",
+    "Open file descriptors held by this node process")
+PROCESS_THREADS = REGISTRY.gauge(
+    "trino_process_threads",
+    "Live Python threads in this node process")
+PROCESS_UPTIME = REGISTRY.gauge(
+    "trino_process_uptime_seconds",
+    "Seconds since this node process imported the engine")
+BUILD_INFO = REGISTRY.gauge(
+    "trino_build_info",
+    "Constant 1, labelled with the engine version and node role "
+    "(info-style gauge)")
+
+#: module-import timestamp — the uptime gauge's epoch
+_PROCESS_START = time.time()
+
+
+def _read_rss_bytes() -> int:
+    """RSS from /proc (Linux); getrusage fallback elsewhere."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(rss_kb) * 1024
+    except Exception:
+        return 0
+
+
+def refresh_process_gauges(node: str = "unknown") -> None:
+    """Refresh the process-health gauge family (called by both node
+    types' ``/v1/metrics`` handlers just before rendering, so scrapes
+    always see current values without any background thread)."""
+    PROCESS_RSS.set(_read_rss_bytes())
+    try:
+        PROCESS_OPEN_FDS.set(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    PROCESS_THREADS.set(threading.active_count())
+    PROCESS_UPTIME.set(time.time() - _PROCESS_START)
+    try:
+        from trino_tpu import __version__ as _version
+    except Exception:
+        _version = "unknown"
+    BUILD_INFO.set(1, version=_version, node=node)
 
 
 # ---------------------------------------------------------------------------

@@ -61,6 +61,20 @@ class QueryTracker:
         self._thread: threading.Thread | None = None
         #: (query_id, reason) log of reaped queries
         self.reaped: list[tuple[str, str]] = []
+        import os
+
+        #: journal GC cadence/TTL: entries terminal longer than the
+        #: TTL are removed on the next due sweep (PR 18 shipped gc();
+        #: this is the caller that keeps _journal/ bounded)
+        self.journal_gc_period_s = float(
+            os.environ.get("TRINO_TPU_JOURNAL_GC_PERIOD_S", "")
+            or 60.0
+        )
+        self.journal_ttl_s = float(
+            os.environ.get("TRINO_TPU_JOURNAL_TTL_S", "")
+            or 7 * 24 * 3600.0
+        )
+        self._journal_gc_due = time.time() + self.journal_gc_period_s
 
     def start(self) -> "QueryTracker":
         self._thread = threading.Thread(
@@ -85,6 +99,7 @@ class QueryTracker:
     def sweep(self):
         """One enforcement pass (callable directly from tests)."""
         now = time.time()
+        self._maybe_gc_journal(now)
         with self.coordinator._lock:
             queries = list(self.coordinator._queries.values())
         for q in queries:
@@ -107,6 +122,23 @@ class QueryTracker:
                         f"of {limit:g}s",
                         "execution",
                     )
+
+    def _maybe_gc_journal(self, now: float, force: bool = False):
+        """Rate-limited durable-journal GC riding the reaper sweep
+        (its thread already exists and already swallows per-sweep
+        errors). Terminal entries older than the TTL are dropped and
+        counted in ``trino_journal_gc_removed_total``."""
+        if not force and now < self._journal_gc_due:
+            return
+        self._journal_gc_due = now + self.journal_gc_period_s
+        journal = getattr(self.coordinator, "journal", None)
+        if journal is None:
+            return
+        removed = journal.gc(self.journal_ttl_s)
+        if removed:
+            from trino_tpu import telemetry
+
+            telemetry.JOURNAL_GC_REMOVED.inc(removed)
 
     def _reap(self, q, message: str, reason: str):
         if q.state in ("FINISHED", "FAILED"):
