@@ -167,6 +167,16 @@ def main(argv=None) -> int:
         "counts on an abandoned fleet",
     )
     ap.add_argument(
+        "--write", action="store_true",
+        help="also run the write-path benchmark: CTAS and INSERT "
+        "SELECT throughput through the TableWriter subsystem "
+        "(unpartitioned and partitioned parquet, BENCH_WRITE_ROWS "
+        "rows), plus a distributed scaled-writer CTAS on a live "
+        "2-worker fleet; every committed table is re-read and checked "
+        "row-identical against its source and the sqlite oracle "
+        "(skips cleanly when pyarrow is absent)",
+    )
+    ap.add_argument(
         "--sentry", action="store_true",
         help="also run the performance-sentry detection benchmark: "
         "warmed TPC-H q01/q03/q06 twin runs where the second q03 run "
@@ -679,6 +689,18 @@ def _run_sections(args, sf, reps, schema, detail, out, fits, remaining) -> int:
         _recovery_section(detail)
 
     if (
+        args.write or _section_enabled("BENCH_WRITE", False)
+    ) and fits("write", 180.0):
+        # write path (BENCH_r11): CTAS/INSERT rates through the
+        # TableWriter sink + the fleet's scaled-writer shape, with
+        # committed bytes re-read and oracle-checked. Ports 19800+
+        # (write tests own 19760+, write chaos 19720+).
+        try:
+            _write_section(detail)
+        except ImportError:
+            detail["write_skipped"] = "pyarrow not installed"
+
+    if (
         args.sentry or _section_enabled("BENCH_SENTRY", False)
     ) and fits("sentry", 120.0):
         _sentry_section(detail)
@@ -818,6 +840,145 @@ def _recovery_section(detail) -> None:
         reap["reserved_after_gc"]
     )
     detail["recovery_wall_s"] = round(wall, 1)
+
+
+def _write_section(detail) -> None:
+    """Write-path benchmark: rates are rows through the committed
+    manifest per second of statement wall-clock (plan + execute +
+    commit — a write is not done until finish_write returns). The
+    re-read checks make the rates trustworthy: a committed table that
+    differs from its source in any row would make them meaningless."""
+    import sqlite3
+    import tempfile
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.connectors.base import TableSchema
+    from trino_tpu.connectors.parquet import write_parquet_table
+    from trino_tpu.engine import QueryRunner
+
+    n = int(os.environ.get("BENCH_WRITE_ROWS", str(400_000)))
+    with tempfile.TemporaryDirectory(prefix="bench-write-") as root:
+        rng = np.random.default_rng(11)
+        k = np.arange(n, dtype=np.int64)
+        v = rng.integers(0, 10_000, n, dtype=np.int64)
+        p = k % 8
+        write_parquet_table(
+            root, "default", "src",
+            TableSchema(
+                "src",
+                [("k", T.BIGINT), ("v", T.BIGINT), ("p", T.BIGINT)],
+            ),
+            {"k": k, "v": v, "p": p}, row_group_size=100_000,
+        )
+        runner = QueryRunner.parquet(root)
+        runner.execute("select count(*) from src")  # warm the scan
+        detail["write_rows"] = n
+        t0 = time.perf_counter()
+        runner.execute("create table flat as select k, v, p from src")
+        detail["write_ctas_rows_per_s"] = round(
+            n / (time.perf_counter() - t0), 1
+        )
+        t0 = time.perf_counter()
+        runner.execute(
+            "create table part with (partitioned_by = array['p']) as "
+            "select k, v, p from src"
+        )
+        detail["write_partitioned_rows_per_s"] = round(
+            n / (time.perf_counter() - t0), 1
+        )
+        cw = runner.executor.last_commit_stats
+        detail["write_partitioned_files"] = int(cw["files"])
+        detail["write_commit_ms"] = round(
+            cw["commit_seconds"] * 1e3, 1
+        )
+        t0 = time.perf_counter()
+        runner.execute(
+            f"insert into flat select k + {n}, v, p from src"
+        )
+        detail["write_insert_rows_per_s"] = round(
+            n / (time.perf_counter() - t0), 1
+        )
+        # the committed partitioned table, re-read through the engine,
+        # must match the sqlite oracle row-for-row
+        db = sqlite3.connect(":memory:")
+        db.execute(
+            "create table src (k integer, v integer, p integer)"
+        )
+        db.executemany(
+            "insert into src values (?,?,?)",
+            zip(k.tolist(), v.tolist(), p.tolist()),
+        )
+        expected = db.execute(
+            "select k, v, p from src order by k"
+        ).fetchall()
+        got = runner.execute(
+            "select k, v, p from part order by k"
+        ).rows
+        assert [tuple(r) for r in got] == expected, (
+            "committed partitioned CTAS differs from the sqlite oracle"
+        )
+        detail["write_oracle_identical"] = True
+
+    # distributed shape: partitioned CTAS off TPC-H tiny on a real
+    # 2-process fleet, writers scaled to task_writer_count
+    from trino_tpu.connectors.parquet import ParquetConnector
+    from trino_tpu.connectors.tpch.connector import TpchConnector
+    from trino_tpu.metadata import Metadata, Session
+    from trino_tpu.server.fleet import FleetRunner
+    from trino_tpu.testing import chaos as chaos_mod
+
+    hive_root = tempfile.mkdtemp(prefix="bench-write-hive-")
+    procs, uris = chaos_mod.spawn_workers(
+        2, base_port=19800,
+        extra_env={
+            "TRINO_TPU_WORKER_EXTRA_PARQUET": f"hive={hive_root}",
+        },
+    )
+    try:
+        with tempfile.TemporaryDirectory(
+            prefix="bench-write-spool-"
+        ) as spool:
+            md = Metadata()
+            md.register_catalog("tpch", TpchConnector())
+            md.register_catalog("hive", ParquetConnector(hive_root))
+            fleet = FleetRunner(
+                list(uris), md,
+                Session(catalog="tpch", schema="tiny"),
+                spool_root=spool, n_partitions=4,
+            )
+            fleet.session.properties["task_writer_count"] = 4
+            src = fleet.execute(
+                "select o_orderkey, o_totalprice, o_orderpriority "
+                "from orders order by o_orderkey"
+            ).rows
+            t0 = time.perf_counter()
+            res = fleet.execute(
+                "create table hive.w.orders_p with "
+                "(partitioned_by = array['o_orderpriority']) as "
+                "select o_orderkey, o_totalprice, o_orderpriority "
+                "from orders"
+            )
+            fleet_s = time.perf_counter() - t0
+            rows = int(res.rows[0][0])
+            detail["write_fleet_rows"] = rows
+            detail["write_fleet_ctas_ms"] = round(fleet_s * 1e3, 1)
+            detail["write_fleet_rows_per_s"] = round(rows / fleet_s, 1)
+            detail["write_fleet_writer_tasks"] = len({
+                ts["task_id"] for ts in res.task_stats
+                if ts.get("rows_written") is not None
+            })
+            committed = fleet.execute(
+                "select o_orderkey, o_totalprice, o_orderpriority "
+                "from hive.w.orders_p order by o_orderkey"
+            ).rows
+            assert committed == src, (
+                "fleet CTAS re-read differs from its source rows"
+            )
+            detail["write_fleet_identical"] = True
+    finally:
+        chaos_mod.stop_workers(procs)
 
 
 def _storage_section(detail) -> None:

@@ -137,7 +137,184 @@ class Analyzer:
                 names=names,
                 symbols=symbols,
             )
+        if isinstance(stmt, ast.CreateTableAs):
+            return self._plan_create_table_as(stmt)
+        if isinstance(stmt, ast.InsertInto) and stmt.query is not None:
+            return self._plan_insert_query(stmt)
         raise AnalysisError(f"unsupported statement {type(stmt).__name__}")
+
+    # ---- writes (TableWriterNode planning, the StatementAnalyzer half
+    # of MAIN/sql/planner/LogicalPlanner.java createTableCreate/
+    # createInsert) ---------------------------------------------------------
+
+    def _qualify_target(self, parts) -> tuple[str, str, str]:
+        parts = list(parts)
+        if len(parts) == 3:
+            return parts[0], parts[1], parts[2]
+        if len(parts) == 2:
+            return self.session.catalog, parts[0], parts[1]
+        return self.session.catalog, self.session.schema, parts[0]
+
+    def _write_properties(self, props) -> tuple[list[str], dict]:
+        """Evaluate CTAS WITH (...) literal properties host-side:
+        partitioned_by = ARRAY['k', ...] and row_group_size = n."""
+        partition_by: list[str] = []
+        out: dict = {}
+        for k, e in props or []:
+            key = k.lower()
+            if key in ("partitioned_by", "partition_by"):
+                if not (
+                    isinstance(e, ast.ArrayLit)
+                    and all(isinstance(x, ast.StrLit) for x in e.items)
+                ):
+                    raise AnalysisError(
+                        "partitioned_by must be ARRAY['col', ...]"
+                    )
+                partition_by = [x.value for x in e.items]
+            elif key == "row_group_size":
+                if not isinstance(e, ast.IntLit):
+                    raise AnalysisError(
+                        "row_group_size must be an integer literal"
+                    )
+                out["row_group_size"] = e.value
+            else:
+                raise AnalysisError(f"unknown table property {k!r}")
+        return partition_by, out
+
+    def _plan_create_table_as(self, stmt: ast.CreateTableAs) -> P.PlanNode:
+        from trino_tpu.connectors.base import TableSchema
+
+        cat, sch, tab = self._qualify_target(stmt.name)
+        self.metadata.access_control.check_can_ddl(
+            self.session.user, cat, sch, tab
+        )
+        try:
+            conn = self.metadata.connector(cat)
+        except KeyError:
+            raise AnalysisError(f"catalog {cat!r} does not exist")
+        if tab in conn.list_tables(sch):
+            if stmt.if_not_exists:
+                return self._noop_write_plan()
+            raise AnalysisError(f"table {cat}.{sch}.{tab} already exists")
+        partition_by, props = self._write_properties(stmt.properties)
+        rp, names = self.plan_query(stmt.query, outer=None, ctes={})
+        symbols = [f.symbol for f in rp.scope.fields]
+        lowered = [n.lower() for n in names]
+        if len(set(lowered)) != len(lowered):
+            raise AnalysisError(
+                "CREATE TABLE AS query produces duplicate column names"
+            )
+        if any(not n or n.startswith("_col") for n in names):
+            raise AnalysisError(
+                "CREATE TABLE AS requires a name for every column "
+                "(alias unnamed expressions)"
+            )
+        ts = TableSchema(tab, [
+            (n.lower(), rp.node.outputs[s])
+            for n, s in zip(names, symbols)
+        ])
+        for k in partition_by:
+            if k.lower() not in ts.column_names:
+                raise AnalysisError(
+                    f"partition column {k!r} is not produced by the query"
+                )
+        try:
+            handle = conn.begin_create(
+                sch, tab, ts,
+                partition_by=[k.lower() for k in partition_by],
+                properties=props or None,
+            )
+        except NotImplementedError:
+            raise AnalysisError(
+                f"catalog {cat!r} does not support CREATE TABLE AS"
+            )
+        except (ValueError, KeyError) as e:
+            raise AnalysisError(str(e))
+        handle["catalog"] = cat
+        return self._wrap_write(rp.node, symbols, handle)
+
+    def _plan_insert_query(self, stmt: ast.InsertInto) -> P.PlanNode:
+        cat, sch, tab = self._qualify_target(stmt.name)
+        self.metadata.access_control.check_can_insert(
+            self.session.user, cat, sch, tab
+        )
+        try:
+            conn = self.metadata.connector(cat)
+        except KeyError:
+            raise AnalysisError(f"catalog {cat!r} does not exist")
+        try:
+            ts = conn.table_schema(sch, tab)
+        except (KeyError, FileNotFoundError):
+            raise AnalysisError(f"table {cat}.{sch}.{tab} does not exist")
+        target_cols = [c.lower() for c in (stmt.columns or ts.column_names)]
+        for c in target_cols:
+            if c not in ts.column_names:
+                raise AnalysisError(
+                    f"column {c!r} does not exist in {sch}.{tab}"
+                )
+        if len(set(target_cols)) != len(target_cols):
+            raise AnalysisError("duplicate INSERT target column")
+        rp, _names = self.plan_query(stmt.query, outer=None, ctes={})
+        symbols = [f.symbol for f in rp.scope.fields]
+        if len(symbols) != len(target_cols):
+            raise AnalysisError(
+                f"INSERT has {len(target_cols)} target columns but the "
+                f"query produces {len(symbols)}"
+            )
+        try:
+            handle = conn.begin_insert(sch, tab)
+        except NotImplementedError:
+            raise AnalysisError(f"catalog {cat!r} does not support INSERT")
+        handle["catalog"] = cat
+        # align the query outputs to full table column order: absent
+        # columns take NULL, mismatched types get a cast
+        by_target = dict(zip(target_cols, symbols))
+        assigns: dict[str, RowExpression] = {}
+        outputs: dict[str, T.DataType] = {}
+        writer_cols: list[str] = []
+        for c, t in ts.columns:
+            sym = by_target.get(c)
+            if sym is None:
+                expr: RowExpression = Literal(t, None)
+            else:
+                st = rp.node.outputs[sym]
+                ref = InputRef(st, sym)
+                expr = ref if st == t else Cast(t, ref)
+            out_sym = self.symbols.new(f"ins_{c}", t)
+            assigns[out_sym] = expr
+            outputs[out_sym] = t
+            writer_cols.append(out_sym)
+        aligned = P.Project(outputs, source=rp.node, assignments=assigns)
+        return self._wrap_write(aligned, writer_cols, handle)
+
+    def _noop_write_plan(self) -> P.PlanNode:
+        """CREATE TABLE IF NOT EXISTS ... AS with the table present:
+        a constant 0-rows result, no write."""
+        sym = self.symbols.new("rows", T.BIGINT)
+        vals = P.Values({sym: T.BIGINT}, rows=[(0,)])
+        return P.Output(
+            outputs={sym: T.BIGINT}, source=vals,
+            names=["rows"], symbols=[sym],
+        )
+
+    def _wrap_write(
+        self, child: P.PlanNode, columns: list[str], handle: dict
+    ) -> P.PlanNode:
+        writer = P.TableWriter(
+            {
+                "$rows": T.BIGINT,
+                "$bytes": T.BIGINT,
+                "$fragment": T.VARCHAR,
+            },
+            source=child, handle=handle, columns=list(columns),
+        )
+        finish = P.TableFinish(
+            {"$written": T.BIGINT}, source=writer, handle=handle,
+        )
+        return P.Output(
+            outputs={"$written": T.BIGINT}, source=finish,
+            names=["rows"], symbols=["$written"],
+        )
 
     # ---- queries ---------------------------------------------------------
     def plan_query(

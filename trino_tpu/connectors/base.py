@@ -19,6 +19,7 @@ from trino_tpu import types as T
 __all__ = [
     "TableSchema", "Connector", "Catalog", "Split", "ColumnDomain",
     "ColumnStats", "TableStats", "compute_column_stats",
+    "WriteSink", "handle_table_schema", "rows_to_columns", "to_unscaled",
 ]
 
 
@@ -254,6 +255,54 @@ class Connector:
         (values, valid|None) host arrays. Returns the row count."""
         raise NotImplementedError(f"{type(self).__name__} is read-only")
 
+    # ---- distributed write (TableWriter subsystem) -------------------
+
+    def begin_insert(self, schema: str, table: str) -> dict:
+        """Validate an INSERT target and return a JSON-safe write
+        handle (ConnectorMetadata.beginInsert analog). MUST be free of
+        side effects: the plan holding the handle may be replanned,
+        speculated, or retried; all mutation happens in finish_write.
+
+        Handle shape (shared across connectors; individual connectors
+        may add keys): ``{"schema", "table", "mode": "insert",
+        "columns": [[name, type_str], ...], "partition_by": [...]}``.
+        The analyzer adds ``"catalog"`` after the call."""
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
+    def begin_create(
+        self, schema: str, table: str, table_schema: TableSchema,
+        partition_by: list[str] | None = None,
+        properties: dict | None = None,
+    ) -> dict:
+        """Validate a CTAS target and return a write handle with
+        ``"mode": "create"`` (ConnectorMetadata.beginCreateTable
+        analog). Side-effect free like begin_insert — the table only
+        comes into existence at finish_write."""
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
+    def write_sink(self, handle: dict, ctx: dict | None = None) -> "WriteSink":
+        """Open a per-task sink for the handle (ConnectorPageSink
+        analog). ``ctx`` carries the writing task's identity
+        ``{"epoch", "task", "attempt"}`` so staged artifacts of
+        distinct (speculated) attempts never collide."""
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
+    def finish_write(
+        self, handle: dict, fragments: list[str], token: str = "",
+    ) -> int:
+        """Commit the fragments of the winning writer attempts in one
+        atomic step (ConnectorMetadata.finishInsert/finishCreateTable
+        analog). ``token`` identifies the committing query epoch;
+        implementations MUST be idempotent in it — a coordinator that
+        crashed between commit and acknowledgment replays the same
+        token and must observe the already-committed result, not a
+        double apply. Returns total rows written."""
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
+    def abort_write(self, handle: dict, token: str = ""):
+        """Discard staged artifacts of a dead epoch (QUERY-tier retry
+        or terminal failure). Best-effort; never raises."""
+
     def table_version(self, schema: str, table: str) -> int:
         """Monotonic write version (0 = versioning unsupported): DML
         reads it before evaluating its row mask and passes it back as
@@ -282,8 +331,154 @@ class Connector:
         )
 
 
+class WriteSink:
+    """Per-task write sink (SPI/connector/ConnectorPageSink.java
+    analog): the TableWriter operator appends host storage columns,
+    then calls finish() exactly once to seal the task's output into
+    *fragments* — opaque JSON strings that ride the exchange fabric to
+    TableFinish, which hands the winning attempts' fragment set to
+    ``Connector.finish_write``. Nothing a sink does is visible to
+    readers until that commit.
+
+    ``buffered_bytes`` is the sink's current host-memory footprint;
+    the operator accounts its deltas against the task MemoryContext so
+    buffered writes obey query_max_memory_per_node."""
+
+    def __init__(self, handle: dict):
+        self.handle = handle
+        self.rows_written = 0
+        self.bytes_written = 0
+        self.files_written = 0
+        self.buffered_bytes = 0
+
+    def append(self, columns: dict, n_rows: int):
+        """Buffer one page; ``columns`` maps column name ->
+        (values, valid|None) host storage arrays, in handle order."""
+        raise NotImplementedError
+
+    def finish(self) -> list[str]:
+        """Seal the sink: flush + fsync buffered data, return the
+        fragment manifest (list of JSON strings)."""
+        raise NotImplementedError
+
+    def abort(self):
+        """Drop buffered state (task failed mid-write). Best-effort."""
+        self.buffered_bytes = 0
+
+
+def handle_table_schema(handle: dict) -> TableSchema:
+    """Reconstruct the target TableSchema from a write handle's
+    JSON-safe ``columns`` list."""
+    return TableSchema(
+        handle["table"],
+        [(c, T.type_from_name(t)) for c, t in handle["columns"]],
+    )
+
+
 @dataclass
 class Catalog:
     name: str
     connector: Connector
     properties: dict = field(default_factory=dict)
+
+
+# ---- host storage codec ----------------------------------------------------
+# Python result rows -> the storage-form (values, valid) columns every
+# write surface shares: the memory connector's insert, both WriteSink
+# implementations, and the engine's host-side VALUES path. Lives here
+# (not engine.py) so exec/write.py can use it without a circular import.
+
+def rows_to_columns(ts: TableSchema, names: list[str], rows: list) -> dict:
+    """Python result rows -> host storage columns (values, valid)."""
+    out = {}
+    for i, (c, t) in enumerate(zip(names, [ts.column_type(n) for n in names])):
+        raw = [r[i] for r in rows]
+        valid = np.array([v is not None for v in raw], dtype=bool)
+        if isinstance(t, T.ArrayType):
+            vals = np.empty(len(raw), dtype=object)
+            for j, v in enumerate(raw):
+                vals[j] = None if v is None else [
+                    _elem_storage(x, t.element) for x in v
+                ]
+        elif isinstance(t, T.MapType):
+            vals = np.empty(len(raw), dtype=object)
+            for j, v in enumerate(raw):
+                vals[j] = None if v is None else [
+                    (_elem_storage(k, t.key),
+                     None if x is None else _elem_storage(x, t.value))
+                    for k, x in (
+                        v.items() if isinstance(v, dict) else v
+                    )
+                ]
+        elif isinstance(t, T.RowType):
+            vals = np.empty(len(raw), dtype=object)
+            for j, v in enumerate(raw):
+                vals[j] = None if v is None else tuple(
+                    None if x is None else _elem_storage(x, ft)
+                    for x, (_fn, ft) in zip(v, t.fields)
+                )
+        elif isinstance(t, T.VarcharType):
+            vals = np.array(
+                ["" if v is None else str(v) for v in raw], dtype=object
+            )
+        elif isinstance(t, T.DecimalType):
+            vals = np.array(
+                [
+                    0 if v is None else to_unscaled(v, t.scale)
+                    for v in raw
+                ],
+                dtype=np.int64,
+            )
+        elif isinstance(t, T.DateType):
+            vals = np.array(
+                [
+                    0 if v is None else (
+                        T.parse_date(v) if isinstance(v, str) else int(v)
+                    )
+                    for v in raw
+                ],
+                dtype=t.np_dtype,
+            )
+        elif isinstance(t, T.TimestampType):
+            vals = np.array(
+                [
+                    0 if v is None else (
+                        T.parse_timestamp(v) if isinstance(v, str) else int(v)
+                    )
+                    for v in raw
+                ],
+                dtype=t.np_dtype,
+            )
+        else:
+            vals = np.array(
+                [0 if v is None else v for v in raw], dtype=t.np_dtype
+            )
+        out[c] = (vals, None if valid.all() else valid)
+    return out
+
+
+def _elem_storage(v, t):
+    """One array ELEMENT -> the element type's storage form (mirrors
+    the scalar branches of rows_to_columns: days for dates, unscaled
+    ints for decimals, micros for timestamps)."""
+    if isinstance(t, T.DecimalType):
+        return to_unscaled(v, t.scale)
+    if isinstance(t, T.DateType):
+        return T.parse_date(v) if isinstance(v, str) else int(v)
+    if isinstance(t, T.TimestampType):
+        return T.parse_timestamp(v) if isinstance(v, str) else int(v)
+    if isinstance(t, T.VarcharType):
+        return str(v)
+    return v
+
+
+def to_unscaled(v, scale: int) -> int:
+    from decimal import Decimal
+
+    if isinstance(v, Decimal):
+        return int(v.scaleb(scale))
+    if isinstance(v, int):
+        return v * 10**scale
+    if isinstance(v, str):
+        return int(Decimal(v).scaleb(scale))
+    return round(float(v) * 10**scale)

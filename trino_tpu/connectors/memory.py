@@ -18,7 +18,9 @@ from trino_tpu.connectors.base import (
     Split,
     TableSchema,
     TableStats,
+    WriteSink,
     compute_column_stats,
+    handle_table_schema,
 )
 
 __all__ = ["MemoryConnector", "BlackholeConnector"]
@@ -142,6 +144,97 @@ class MemoryConnector(Connector):
             t.version += 1
         return n_new or 0
 
+    # ---- distributed write (TableWriter subsystem) -----------------------
+
+    def begin_insert(self, schema: str, table: str) -> dict:
+        ts = self.table_schema(schema, table)  # raises if missing
+        return {
+            "schema": schema, "table": table, "mode": "insert",
+            "columns": [[c, str(t)] for c, t in ts.columns],
+            "partition_by": [],
+        }
+
+    def begin_create(
+        self, schema: str, table: str, table_schema: TableSchema,
+        partition_by=None, properties=None,
+    ) -> dict:
+        if partition_by:
+            raise ValueError(
+                "memory connector does not support partitioned tables"
+            )
+        return {
+            "schema": schema, "table": table, "mode": "create",
+            "columns": [[c, str(t)] for c, t in table_schema.columns],
+            "partition_by": [],
+        }
+
+    def write_sink(self, handle: dict, ctx: dict | None = None) -> WriteSink:
+        return _MemorySink(handle)
+
+    def finish_write(
+        self, handle: dict, fragments: list[str], token: str = "",
+    ) -> int:
+        """Apply the winning fragments as ONE insert under the table
+        lock — the transactional swap: readers see either none or all
+        of the write. Idempotent in ``token`` (a replayed commit after
+        a coordinator crash observes the recorded row count)."""
+        import json
+
+        ts = handle_table_schema(handle)
+        schema, table = handle["schema"], handle["table"]
+        with self._lock:
+            applied = getattr(self, "_applied_tokens", None)
+            if applied is None:
+                applied = self._applied_tokens = {}
+            key = (schema, table, token)
+            if token and key in applied:
+                return applied[key]
+        if handle["mode"] == "create":
+            try:
+                self.create_table(schema, table, ts)
+            except ValueError:
+                # replayed create whose token record was lost with a
+                # restarted connector process: tolerate the existing
+                # table only when its schema matches the handle
+                if self.table_schema(schema, table).columns != ts.columns:
+                    raise
+        cols: dict[str, list] = {c: [] for c, _ in ts.columns}
+        vflags: dict[str, list] = {c: [] for c, _ in ts.columns}
+        total = 0
+        for frag in fragments:
+            d = json.loads(frag)
+            total += int(d["rows"])
+            for (c, t) in ts.columns:
+                vals, valid = d["columns"][c]
+                typ = ts.column_type(c)
+                cols[c].extend(_restore(v, typ) for v in vals)
+                vflags[c].extend(
+                    [True] * len(vals) if valid is None else valid
+                )
+        payload = {}
+        for c, t in ts.columns:
+            valid = np.asarray(vflags[c], dtype=bool)
+            if _storage_dtype(t) == object:
+                payload[c] = (cols[c], None if valid.all() else valid)
+            else:
+                payload[c] = (
+                    np.asarray(
+                        [0 if v is None else v for v in cols[c]],
+                        dtype=_storage_dtype(t),
+                    ),
+                    None if valid.all() else valid,
+                )
+        if total:
+            self.insert(schema, table, payload)
+        with self._lock:
+            if token:
+                self._applied_tokens[key] = total
+        return total
+
+    def abort_write(self, handle: dict, token: str = ""):
+        """Memory fragments hold their data inline (nothing staged in
+        the connector), so abort is a no-op."""
+
     def table_version(self, schema: str, table: str) -> int:
         t = self._table(schema, table)
         with self._lock:
@@ -221,6 +314,100 @@ class MemoryConnector(Connector):
         return out
 
 
+class _MemorySink(WriteSink):
+    """Memory write sink: fragments CARRY the row data (JSON storage
+    lists). Nothing touches the target table until finish_write — a
+    losing speculated attempt's fragments simply evaporate with its
+    spool partition."""
+
+    def __init__(self, handle: dict):
+        super().__init__(handle)
+        self._cols: dict[str, list] = {
+            c: [] for c, _t in handle["columns"]
+        }
+        self._valid: dict[str, list] = {
+            c: [] for c, _t in handle["columns"]
+        }
+
+    def append(self, columns: dict, n_rows: int):
+        for c, _t in self.handle["columns"]:
+            vals, valid = columns[c]
+            pyvals = (
+                vals.tolist() if isinstance(vals, np.ndarray) else list(vals)
+            )
+            self._cols[c].extend(_jsonable(v) for v in pyvals)
+            self._valid[c].extend(
+                [True] * n_rows if valid is None
+                else np.asarray(valid, dtype=bool).tolist()
+            )
+            self.buffered_bytes += _approx_bytes(vals)
+        self.rows_written += n_rows
+
+    def finish(self) -> list[str]:
+        import json
+
+        if not self.rows_written:
+            return []
+        payload = {
+            "rows": self.rows_written,
+            "columns": {
+                c: [
+                    self._cols[c],
+                    None if all(self._valid[c]) else self._valid[c],
+                ]
+                for c, _t in self.handle["columns"]
+            },
+        }
+        frag = json.dumps(payload)
+        self.bytes_written = len(frag)
+        self.files_written = 1
+        self.buffered_bytes = 0
+        return [frag]
+
+    def abort(self):
+        self._cols = {c: [] for c in self._cols}
+        self._valid = {c: [] for c in self._valid}
+        self.buffered_bytes = 0
+
+
+def _jsonable(v):
+    """One storage value -> a JSON-representable twin (numpy scalars
+    to python, tuples survive as lists and are restored by type; map
+    dicts flatten to [key, value] pair lists — the shape _restore
+    rebuilds MapType storage from)."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, dict):
+        return [[_jsonable(k), _jsonable(x)] for k, x in v.items()]
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+def _restore(v, t: T.DataType):
+    """Undo JSON's tuple->list flattening by column type (map pairs
+    and ROW values are tuples in memory-table storage)."""
+    if v is None:
+        return None
+    if isinstance(t, T.MapType):
+        return [
+            (_restore(k, t.key), _restore(x, t.value)) for k, x in v
+        ]
+    if isinstance(t, T.RowType):
+        return tuple(
+            _restore(x, ft) for x, (_fn, ft) in zip(v, t.fields)
+        )
+    if isinstance(t, T.ArrayType):
+        return [_restore(x, t.element) for x in v]
+    return v
+
+
+def _approx_bytes(vals) -> int:
+    if isinstance(vals, np.ndarray) and vals.dtype != object:
+        return int(vals.nbytes)
+    return sum(len(str(v)) + 8 for v in vals)
+
+
 class BlackholeConnector(Connector):
     """Null sink/source (plugin/trino-blackhole analog): accepts any
     DDL/insert, scans are empty — for perf isolation tests."""
@@ -261,3 +448,50 @@ class BlackholeConnector(Connector):
             c: np.empty((0,), dtype=_storage_dtype(ts.column_type(c)))
             for c in columns
         }
+
+    # ---- write SPI: data vanishes, counts stay honest --------------------
+
+    def begin_insert(self, schema: str, table: str) -> dict:
+        ts = self.table_schema(schema, table)
+        return {
+            "schema": schema, "table": table, "mode": "insert",
+            "columns": [[c, str(t)] for c, t in ts.columns],
+            "partition_by": [],
+        }
+
+    def begin_create(
+        self, schema: str, table: str, table_schema: TableSchema,
+        partition_by=None, properties=None,
+    ) -> dict:
+        return {
+            "schema": schema, "table": table, "mode": "create",
+            "columns": [[c, str(t)] for c, t in table_schema.columns],
+            "partition_by": list(partition_by or []),
+        }
+
+    def write_sink(self, handle: dict, ctx: dict | None = None):
+        return _BlackholeSink(handle)
+
+    def finish_write(
+        self, handle: dict, fragments: list[str], token: str = "",
+    ) -> int:
+        import json
+
+        if handle["mode"] == "create" and (
+            (handle["schema"], handle["table"]) not in self._tables
+        ):
+            self.create_table(
+                handle["schema"], handle["table"],
+                handle_table_schema(handle),
+            )
+        return sum(int(json.loads(f)["rows"]) for f in fragments)
+
+
+class _BlackholeSink(WriteSink):
+    def append(self, columns: dict, n_rows: int):
+        self.rows_written += n_rows
+
+    def finish(self) -> list[str]:
+        import json
+
+        return [json.dumps({"rows": self.rows_written})]

@@ -41,7 +41,7 @@ import numpy as np
 from trino_tpu import telemetry
 from trino_tpu import types as T
 from trino_tpu.connectors.base import (
-    ColumnStats, Connector, Split, TableSchema, TableStats,
+    ColumnStats, Connector, Split, TableSchema, TableStats, WriteSink,
 )
 
 __all__ = ["ParquetConnector", "write_parquet_table"]
@@ -153,6 +153,11 @@ class ParquetConnector(Connector):
         h = hashlib.blake2b(digest_size=12)
         try:
             for dirpath, dirnames, filenames in os.walk(root):
+                # uncommitted staging epochs are invisible to readers
+                # and must not bust reader caches while a CTAS runs
+                dirnames[:] = [
+                    d for d in dirnames if not d.startswith("_tmp")
+                ]
                 dirnames.sort()
                 for fn in sorted(filenames):
                     if not fn.endswith(".parquet"):
@@ -191,7 +196,10 @@ class ParquetConnector(Connector):
         for f in os.listdir(d):
             if f.endswith(".parquet"):
                 out.add(f[:-8])
-            elif os.path.isdir(os.path.join(d, f)):
+            elif os.path.isdir(os.path.join(d, f)) and not f.startswith(
+                "_tmp"
+            ):
+                # _tmp_{token} staging epochs are not tables
                 out.add(f)
         return sorted(out)
 
@@ -358,6 +366,171 @@ class ParquetConnector(Connector):
             if counted.get(c, 0) == m.row_count
         }
         return TableStats(float(m.row_count), cols)
+
+    # ---- distributed write (TableWriter subsystem) -----------------------
+    #
+    # Writers stage row-group-sized part files under
+    # ``root/schema/_tmp_{token}/table/[key=value/...]`` (a SIBLING of
+    # the table dir, so readers never walk uncommitted data); commit
+    # verifies each fragment's CRC, atomically renames winners into the
+    # Hive-style table tree, records ``_manifest.json`` (the idempotent
+    # commit marker), removes the whole staging epoch (loser-attempt
+    # orphans included) and invalidates cached metadata so splits()/
+    # table_stats see the new data immediately.
+
+    def _staging_dir(self, schema: str, table: str, token: str) -> str:
+        return os.path.join(
+            self.root, schema, f"_tmp_{token or 'local'}", table
+        )
+
+    def begin_insert(self, schema: str, table: str) -> dict:
+        ts = self.table_schema(schema, table)  # raises if missing
+        m = self._manifest(schema, table)
+        return {
+            "schema": schema, "table": table, "mode": "insert",
+            "columns": [[c, str(t)] for c, t in ts.columns],
+            "partition_by": [k for k, _t in m.partition_cols],
+            "row_group_size": None,
+        }
+
+    def begin_create(
+        self, schema: str, table: str, table_schema: TableSchema,
+        partition_by=None, properties=None,
+    ) -> dict:
+        partition_by = list(partition_by or [])
+        for k in partition_by:
+            t = table_schema.column_type(k)  # KeyError if unknown
+            if not (t.is_integer or isinstance(t, T.VarcharType)):
+                raise ValueError(
+                    f"partition column {k!r} must be integer or varchar"
+                )
+        rgs = (properties or {}).get("row_group_size")
+        return {
+            "schema": schema, "table": table, "mode": "create",
+            "columns": [[c, str(t)] for c, t in table_schema.columns],
+            "partition_by": partition_by,
+            "row_group_size": None if rgs is None else int(rgs),
+        }
+
+    def write_sink(self, handle: dict, ctx: dict | None = None):
+        return _ParquetSink(self.root, handle, ctx)
+
+    def finish_write(
+        self, handle: dict, fragments: list[str], token: str = "",
+    ) -> int:
+        import json
+        import shutil
+        import zlib
+
+        schema, table = handle["schema"], handle["table"]
+        tdir = self._dir_path(schema, table)
+        staging = self._staging_dir(schema, table, token)
+        manifest_path = os.path.join(tdir, "_manifest.json")
+        prior = None
+        if os.path.isfile(manifest_path):
+            with open(manifest_path) as f:
+                prior = json.load(f)
+            if token and prior.get("token") == token:
+                # replayed commit (coordinator crashed after commit,
+                # before the client saw the result): already applied
+                shutil.rmtree(
+                    os.path.dirname(staging), ignore_errors=True
+                )
+                return int(prior.get("rows", 0))
+        single = self._file_path(schema, table)
+        if handle["mode"] == "insert" and os.path.isfile(single):
+            # legacy single-file table gains part files: fold the
+            # original file into the directory layout first
+            os.makedirs(tdir, exist_ok=True)
+            os.replace(
+                single, os.path.join(tdir, "part-00000-legacy.parquet")
+            )
+        frags = [json.loads(s) for s in fragments]
+        total_rows = 0
+        entries = list(prior["files"]) if prior else []
+        # a fragment path already in the manifest belongs to COMMITTED
+        # data — renaming over it would silently destroy rows (part
+        # names carry the epoch precisely so this cannot happen; treat
+        # a collision as corruption, not as an update)
+        dup = {e["path"] for e in entries} & {fr["path"] for fr in frags}
+        if dup:
+            raise IOError(
+                f"write fragments collide with committed part files "
+                f"{sorted(dup)}; refusing to overwrite"
+            )
+        touched_dirs = set()
+        for fr in frags:
+            staged = os.path.join(staging, fr["path"])
+            dest = os.path.join(tdir, fr["path"])
+            if not os.path.isfile(staged):
+                if os.path.isfile(dest) and os.path.getsize(dest) == int(
+                    fr["bytes"]
+                ):
+                    # crashed between this rename and the manifest
+                    # write on a previous commit attempt
+                    total_rows += int(fr["rows"])
+                    entries.append(_manifest_entry(fr))
+                    continue
+                raise FileNotFoundError(
+                    f"staged write fragment missing: {staged}"
+                )
+            with open(staged, "rb") as f:
+                crc = zlib.crc32(f.read()) & 0xFFFFFFFF
+            if crc != int(fr["crc"]):
+                raise IOError(
+                    f"write fragment CRC mismatch for {fr['path']}: "
+                    f"staged file is corrupt, refusing to commit"
+                )
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            os.replace(staged, dest)
+            touched_dirs.add(os.path.dirname(dest))
+            total_rows += int(fr["rows"])
+            entries.append(_manifest_entry(fr))
+        for d in sorted(touched_dirs):
+            _fsync_dir(d)
+        os.makedirs(tdir, exist_ok=True)
+        if handle["mode"] == "create" and not frags:
+            # empty CTAS: the table must still be readable, so write
+            # one zero-row part file carrying the schema
+            from trino_tpu.connectors.base import (
+                handle_table_schema, rows_to_columns,
+            )
+
+            ts = handle_table_schema(handle)
+            fs = TableSchema(table, [
+                (c, t) for c, t in ts.columns
+                if c not in (handle.get("partition_by") or [])
+            ])
+            empty = rows_to_columns(fs, fs.column_names, [])
+            _write_file(
+                os.path.join(tdir, "part-empty-0000.parquet"),
+                fs, empty, fsync=True,
+            )
+        tmp = manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "token": token,
+                    "rows": total_rows,
+                    "files": entries,
+                },
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, manifest_path)
+        _fsync_dir(tdir)
+        # the epoch's staging root also holds losing speculated
+        # attempts' part files — drop them all (zero orphans)
+        shutil.rmtree(os.path.dirname(staging), ignore_errors=True)
+        self.invalidate(schema, table)
+        return total_rows
+
+    def abort_write(self, handle: dict, token: str = ""):
+        import shutil
+
+        staging = self._staging_dir(handle["schema"], handle["table"], token)
+        shutil.rmtree(os.path.dirname(staging), ignore_errors=True)
 
     # ---- splits ----------------------------------------------------------
 
@@ -783,6 +956,235 @@ def _columns_to_arrow(table_schema: TableSchema, columns: dict, sel=None):
     return arrays, names
 
 
+def _write_file(
+    path: str, file_schema: TableSchema, columns: dict,
+    row_group_size: int | None = None, sel=None, fsync: bool = False,
+):
+    """Encode host columns into ONE parquet file — the single encoder
+    shared by the legacy export helper and the WriteSink path."""
+    pa, pq = _arrow()
+    kw = {} if row_group_size is None else {"row_group_size": row_group_size}
+    arrays, names = _columns_to_arrow(file_schema, columns, sel=sel)
+    pq.write_table(pa.Table.from_arrays(arrays, names=names), path, **kw)
+    if fsync:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+class _ParquetSink(WriteSink):
+    """Per-task parquet page sink: buffers rows per partition tuple
+    and flushes part files under the staging epoch dir. Nothing lands
+    in the table tree until ``ParquetConnector.finish_write`` renames
+    the winning fragments in."""
+
+    #: buffered rows per partition tuple that trigger a part-file
+    #: flush (the "row-group-sized part files" unit; row_group_size,
+    #: when set, additionally shapes row groups INSIDE a file)
+    FLUSH_ROWS = 1 << 20
+
+    def __init__(self, root: str, handle: dict, ctx: dict | None = None):
+        super().__init__(handle)
+        ctx = ctx or {}
+        self.root = root
+        self.epoch = str(ctx.get("epoch") or "local")
+        self.task = str(ctx.get("task") or "t0")
+        self.attempt = int(ctx.get("attempt") or 0)
+        self.staging = os.path.join(
+            root, handle["schema"], f"_tmp_{self.epoch}", handle["table"]
+        )
+        pb = list(handle.get("partition_by") or [])
+        self.partition_by = pb
+        cols = [(c, T.type_from_name(t)) for c, t in handle["columns"]]
+        self.table_schema = TableSchema(handle["table"], cols)
+        self.file_schema = TableSchema(
+            handle["table"], [(c, t) for c, t in cols if c not in pb]
+        )
+        self.row_group_size = handle.get("row_group_size")
+        #: partition tuple -> {col: ([values], valid list)}
+        self._buf: dict[tuple, dict] = {}
+        self._buf_rows: dict[tuple, int] = {}
+        self._buf_bytes: dict[tuple, int] = {}
+        self._seq = 0
+        self._frags: list[dict] = []
+
+    def append(self, columns: dict, n_rows: int):
+        if not n_rows:
+            return
+        if self.partition_by:
+            pvals = []
+            for k in self.partition_by:
+                vals, valid = columns[k]
+                if valid is not None and not np.asarray(valid).all():
+                    raise ValueError(
+                        f"NULL value in partition column {k!r}"
+                    )
+                pvals.append(np.asarray(vals).tolist())
+            keys = list(zip(*pvals))
+        else:
+            keys = [()] * n_rows
+        for combo in dict.fromkeys(keys):
+            sel = np.fromiter(
+                (key == combo for key in keys), dtype=bool, count=n_rows
+            )
+            buf = self._buf.get(combo)
+            if buf is None:
+                buf = self._buf[combo] = {
+                    c: ([], []) for c, _t in self.file_schema.columns
+                }
+                self._buf_rows[combo] = 0
+                self._buf_bytes[combo] = 0
+            k = int(sel.sum())
+            for c, _t in self.file_schema.columns:
+                vals, valid = columns[c]
+                vals = np.asarray(vals)[sel]
+                buf[c][0].extend(vals.tolist())
+                buf[c][1].extend(
+                    [True] * k if valid is None
+                    else np.asarray(valid, dtype=bool)[sel].tolist()
+                )
+                b = _approx_col_bytes(vals)
+                self._buf_bytes[combo] += b
+                self.buffered_bytes += b
+            self._buf_rows[combo] += k
+            if self._buf_rows[combo] >= self.FLUSH_ROWS:
+                self._flush(combo)
+        self.rows_written += n_rows
+
+    def _flush(self, combo: tuple):
+        import zlib
+
+        buf = self._buf.pop(combo)
+        n = self._buf_rows.pop(combo)
+        self.buffered_bytes = max(
+            self.buffered_bytes - self._buf_bytes.pop(combo, 0), 0
+        )
+        if not n:
+            return
+        segs = [
+            f"{k}={v}" for k, v in zip(self.partition_by, combo)
+        ]
+        for s in segs:
+            if os.sep in s or s.startswith("_tmp"):
+                raise ValueError(f"unsafe partition path segment {s!r}")
+        d = os.path.join(self.staging, *segs)
+        os.makedirs(d, exist_ok=True)
+        # the epoch in the name keeps successive writes into one table
+        # from colliding (same task ids every statement); task+attempt
+        # keep speculated twins of one epoch apart
+        name = (
+            f"part-{self.epoch}-{self.task}-a{self.attempt}"
+            f"-{self._seq:04d}.parquet"
+        )
+        self._seq += 1
+        path = os.path.join(d, name)
+        cols = {
+            c: (buf[c][0], _valid_arr(buf[c][1]))
+            for c, _t in self.file_schema.columns
+        }
+        _write_file(
+            path, self.file_schema, cols,
+            row_group_size=self.row_group_size, fsync=True,
+        )
+        with open(path, "rb") as f:
+            data = f.read()
+        crc = zlib.crc32(data) & 0xFFFFFFFF
+        _, pq = _arrow()
+        md = pq.ParquetFile(path).metadata
+        stats = _footer_bounds(md, self.file_schema)
+        self._frags.append({
+            "path": os.path.join(*segs, name) if segs else name,
+            "rows": n,
+            "bytes": len(data),
+            "crc": crc,
+            "partition": dict(zip(self.partition_by, combo)),
+            "stats": stats,
+        })
+        self.bytes_written += len(data)
+        self.files_written += 1
+
+    def finish(self) -> list[str]:
+        import json
+
+        for combo in list(self._buf):
+            self._flush(combo)
+        self.buffered_bytes = 0
+        return [json.dumps(fr) for fr in self._frags]
+
+    def abort(self):
+        """Buffered pages drop here; already-staged part files are
+        swept with the epoch dir by finish_write/abort_write."""
+        self._buf.clear()
+        self._buf_rows.clear()
+        self.buffered_bytes = 0
+
+
+def _valid_arr(flags: list):
+    a = np.asarray(flags, dtype=bool)
+    return None if a.all() else a
+
+
+def _approx_col_bytes(vals: np.ndarray) -> int:
+    if vals.dtype != object:
+        return int(vals.nbytes)
+    return sum(len(str(v)) + 8 for v in vals.tolist())
+
+
+def _footer_bounds(md, file_schema: TableSchema) -> dict:
+    """Merged per-column (lo, hi) storage-domain bounds from the
+    footer of one written file (the fragment's stats payload)."""
+    out: dict[str, list] = {}
+    if not md.num_row_groups:
+        return out
+    name_to_idx = {
+        md.row_group(0).column(j).path_in_schema: j
+        for j in range(md.row_group(0).num_columns)
+    }
+    for i in range(md.num_row_groups):
+        rg = md.row_group(i)
+        for cname, j in name_to_idx.items():
+            st = rg.column(j).statistics
+            if st is None or not st.has_min_max:
+                continue
+            try:
+                t = file_schema.column_type(cname)
+            except KeyError:
+                continue
+            lo = _stat_to_storage(st.min, t)
+            hi = _stat_to_storage(st.max, t)
+            if isinstance(lo, bytes) or isinstance(hi, bytes):
+                continue  # keep fragments JSON-safe
+            cur = out.get(cname)
+            if cur is None:
+                out[cname] = [lo, hi]
+            else:
+                cur[0] = min(cur[0], lo)
+                cur[1] = max(cur[1], hi)
+    return out
+
+
+def _manifest_entry(fr: dict) -> dict:
+    return {
+        "path": fr["path"], "rows": int(fr["rows"]),
+        "bytes": int(fr["bytes"]), "crc": int(fr["crc"]),
+    }
+
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def write_parquet_table(
     root: str, schema: str, table: str, table_schema: TableSchema,
     columns: dict, row_group_size: int | None = None,
@@ -793,48 +1195,37 @@ def write_parquet_table(
 
     Without ``partition_by``: one file ``root/schema/table.parquet``.
     With it: a Hive-style tree ``root/schema/table/<key>=<value>/
-    part-<i>.parquet``, one file per distinct partition tuple, with the
-    partition columns elided from the files (they live in the path)."""
-    pa, pq = _arrow()
-    kw = {} if row_group_size is None else {"row_group_size": row_group_size}
+    part-*.parquet``, one file per distinct partition tuple, with the
+    partition columns elided from the files (they live in the path).
+    Both shapes route through the WriteSink encoder; the partitioned
+    shape additionally exercises the stage-then-commit path, so every
+    partitioned fixture in the tree is built by the same machinery a
+    distributed CTAS uses."""
     if not partition_by:
         os.makedirs(os.path.join(root, schema), exist_ok=True)
-        arrays, names = _columns_to_arrow(table_schema, columns)
-        pq.write_table(
-            pa.Table.from_arrays(arrays, names=names),
+        _write_file(
             os.path.join(root, schema, f"{table}.parquet"),
-            **kw,
+            table_schema, columns, row_group_size=row_group_size,
         )
         return
-    for k in partition_by:
-        t = table_schema.column_type(k)
-        if not (t.is_integer or isinstance(t, T.VarcharType)):
-            raise ValueError(
-                f"partition column {k!r} must be integer or varchar"
-            )
-    file_schema = TableSchema(table, [
-        (c, t) for c, t in table_schema.columns if c not in partition_by
-    ])
-    pvals = []
-    for k in partition_by:
-        v = columns[k]
-        if isinstance(v, tuple):
-            v = v[0]
-        pvals.append(np.asarray(v))
-    n = len(pvals[0])
-    keys = list(zip(*(v.tolist() for v in pvals)))
-    for i, combo in enumerate(sorted(set(keys))):
-        sel = np.fromiter(
-            (key == combo for key in keys), dtype=bool, count=n
-        )
-        d = os.path.join(
-            root, schema, table,
-            *(f"{k}={v}" for k, v in zip(partition_by, combo)),
-        )
-        os.makedirs(d, exist_ok=True)
-        arrays, names = _columns_to_arrow(file_schema, columns, sel=sel)
-        pq.write_table(
-            pa.Table.from_arrays(arrays, names=names),
-            os.path.join(d, f"part-{i:05d}.parquet"),
-            **kw,
-        )
+    conn = ParquetConnector(root)
+    handle = conn.begin_create(
+        schema, table, table_schema, partition_by=partition_by,
+        properties=(
+            None if row_group_size is None
+            else {"row_group_size": row_group_size}
+        ),
+    )
+    sink = conn.write_sink(
+        handle, {"epoch": "bootstrap", "task": "t0", "attempt": 0}
+    )
+    norm = {}
+    n = None
+    for c, _t in table_schema.columns:
+        v = columns[c]
+        vals, valid = v if isinstance(v, tuple) else (v, None)
+        vals = np.asarray(vals)
+        n = len(vals) if n is None else n
+        norm[c] = (vals, valid)
+    sink.append(norm, n or 0)
+    conn.finish_write(handle, sink.finish(), token="bootstrap")

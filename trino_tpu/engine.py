@@ -256,6 +256,11 @@ class QueryRunner:
             plan = add_exchanges(
                 plan, self.metadata,
                 n_shards=self.mesh.devices.size, session=self.session,
+                # writer fan-out needs host-side exchanges (the fleet
+                # spool); a real device mesh gathers below the writer
+                scaled_writers=bool(
+                    getattr(self.mesh, "host_exchange", False)
+                ),
             )
             if optimized and _validate.level(self.session) != "OFF":
                 _validate.validate_plan(plan, phase="add_exchanges")
@@ -265,7 +270,7 @@ class QueryRunner:
             plan = annotate(plan, self.metadata, self.session)
         if optimized and session_properties.get(
             self.session, "result_cache_enabled"
-        ):
+        ) and _write_handle(plan) is None:
             # semantic fingerprint of the OPTIMIZED tree (post-annotate,
             # so the hash covers what will actually execute); pure
             # read-side derivation, safe under plan_validation=FULL
@@ -842,28 +847,47 @@ class QueryRunner:
         conn.create_table(sch, tab, ts)
         return QueryResult(["result"], [("CREATE TABLE",)])
 
-    def _create_table_as(self, stmt: ast.CreateTableAs) -> QueryResult:
-        from trino_tpu.connectors.base import TableSchema
-
-        cat, sch, tab = self._qualify(stmt.name)
-        self.metadata.access_control.check_can_ddl(
-            self.session.user, cat, sch, tab
+    def _execute_write_stmt(self, stmt: ast.Statement) -> QueryResult:
+        """INSERT ... SELECT / CTAS through the TableWriter plan path:
+        the analyzer performs target resolution, access checks, and the
+        side-effect-free ``begin_*``; all mutation happens in the
+        TableFinish commit. The statement epoch tokens the write so a
+        replayed commit is idempotent."""
+        plan = self.plan_stmt(stmt)
+        handle = _write_handle(plan)
+        ex = self.executor
+        epoch = uuid.uuid4().hex[:12]
+        prev_ctx = getattr(ex, "write_ctx", None)
+        ex.write_ctx = {"epoch": epoch, "task": "t0", "attempt": 0}
+        try:
+            page = ex.execute(plan)
+            rows = page.to_pylist()
+        except BaseException:
+            if handle is not None:
+                try:
+                    self.metadata.connector(handle["catalog"]).abort_write(
+                        handle, token=epoch
+                    )
+                except Exception:
+                    pass
+            raise
+        finally:
+            ex.write_ctx = prev_ctx
+        return QueryResult(
+            names=list(page.names), rows=rows, plan=plan,
         )
-        conn = self.metadata.connector(cat)
-        if stmt.if_not_exists and tab in conn.list_tables(sch):
-            return QueryResult(["rows"], [(0,)])
-        plan = self.plan_stmt(stmt.query)
-        page = self.executor.execute(plan)
-        names = list(plan.names)
-        types = [plan.outputs[s] for s in plan.symbols]
-        ts = TableSchema(tab, list(zip(names, types)))
-        conn.create_table(sch, tab, ts)
-        cols = _rows_to_columns(ts, names, page.to_pylist())
-        n = conn.insert(sch, tab, cols)
-        self.executor.invalidate_scan(cat, sch, tab)
-        return QueryResult(["rows"], [(n,)])
+
+    def _create_table_as(self, stmt: ast.CreateTableAs) -> QueryResult:
+        return self._execute_write_stmt(stmt)
 
     def _insert(self, stmt: ast.InsertInto) -> QueryResult:
+        if stmt.rows is None:
+            return self._execute_write_stmt(stmt)
+        # VALUES fast path: literals evaluate host-side, but the
+        # mutation still flows begin_insert -> sink -> finish_write so
+        # every connector write shares one commit protocol
+        from trino_tpu.exec import write as W
+
         cat, sch, tab = self._qualify(stmt.name)
         self.metadata.access_control.check_can_insert(
             self.session.user, cat, sch, tab
@@ -871,29 +895,19 @@ class QueryRunner:
         conn = self.metadata.connector(cat)
         ts = conn.table_schema(sch, tab)
         target_cols = stmt.columns or ts.column_names
-        if stmt.rows is not None:
-            for row in stmt.rows:
-                if len(row) != len(target_cols):
-                    raise ValueError(
-                        f"INSERT row has {len(row)} values but "
-                        f"{len(target_cols)} target columns"
-                    )
-            rows = [
-                tuple(
-                    _literal_value(e, ts.column_type(c))
-                    for e, c in zip(row, target_cols)
-                )
-                for row in stmt.rows
-            ]
-        else:
-            plan = self.plan_stmt(stmt.query)
-            if len(plan.symbols) != len(target_cols):
+        for row in stmt.rows:
+            if len(row) != len(target_cols):
                 raise ValueError(
-                    f"INSERT query has {len(plan.symbols)} columns but "
+                    f"INSERT row has {len(row)} values but "
                     f"{len(target_cols)} target columns"
                 )
-            page = self.executor.execute(plan)
-            rows = page.to_pylist()
+        rows = [
+            tuple(
+                _literal_value(e, ts.column_type(c))
+                for e, c in zip(row, target_cols)
+            )
+            for row in stmt.rows
+        ]
         # align to the table's column order, NULL-filling the rest
         idx = {c: i for i, c in enumerate(target_cols)}
         full_rows = [
@@ -904,7 +918,26 @@ class QueryRunner:
             for row in rows
         ]
         cols = _rows_to_columns(ts, ts.column_names, full_rows)
-        n = conn.insert(sch, tab, cols)
+        handle = conn.begin_insert(sch, tab)
+        handle["catalog"] = cat
+        epoch = uuid.uuid4().hex[:12]
+        sink = conn.write_sink(
+            handle, {"epoch": epoch, "task": "t0", "attempt": 0}
+        )
+        try:
+            if full_rows:
+                sink.append(cols, len(full_rows))
+            res = W.finish_sink(sink)
+            n, _secs = W.commit_write(
+                self.metadata, handle, res["fragments"], token=epoch
+            )
+        except BaseException:
+            sink.abort()
+            try:
+                conn.abort_write(handle, token=epoch)
+            except Exception:
+                pass
+            raise
         self.executor.invalidate_scan(cat, sch, tab)
         return QueryResult(["rows"], [(n,)])
 
@@ -1030,6 +1063,15 @@ class QueryRunner:
 
             ex.profiler = own_prof = OperatorProfiler()
         scan0 = len(getattr(ex, "scan_log", None) or [])
+        # EXPLAIN ANALYZE executes for real; a write plan needs the
+        # same commit token scoping (and failure abort) as execute()
+        wh = _write_handle(plan)
+        w_epoch = None
+        if wh is not None:
+            w_epoch = uuid.uuid4().hex[:12]
+            ex.write_ctx = {"epoch": w_epoch, "task": "t0", "attempt": 0}
+            ex.last_write_stats = None
+            ex.last_commit_stats = None
         kp_cap = None
         try:
             t0 = time.perf_counter()
@@ -1045,8 +1087,19 @@ class QueryRunner:
                 page = ex.execute(plan)
                 rows = page.to_pylist()
             total_ms = (time.perf_counter() - t0) * 1e3
+        except BaseException:
+            if wh is not None:
+                try:
+                    self.metadata.connector(wh["catalog"]).abort_write(
+                        wh, token=w_epoch
+                    )
+                except Exception:
+                    pass
+            raise
         finally:
             del ex.execute
+            if wh is not None:
+                ex.write_ctx = None
         # seal records now (costs resolve through the persistent XLA
         # cache) and key them by plan node for the annotated tree;
         # EXPLAIN ANALYZE is an explicit profile request, so eager
@@ -1091,6 +1144,15 @@ class QueryRunner:
                 f"Peak memory: {_fmt_bytes(peak_bytes)} "
                 f"({ex.memory_pool.node_id}: "
                 f"{_fmt_bytes(peak_bytes)})"
+            )
+        cw = getattr(ex, "last_commit_stats", None)
+        if wh is not None and cw is not None:
+            # writer summary (rows/files/bytes from the committed
+            # fragments; commit latency is the finish_write wall time)
+            lines.append(
+                f"TableWriter: {cw['rows']} rows, {cw['files']} files, "
+                f"{_fmt_bytes(cw['bytes'])} "
+                f"(commit {cw['commit_seconds'] * 1000.0:.1f} ms)"
             )
         _cs = getattr(self, "_cache_stats", None)
         if _cs is not None and (
@@ -1402,106 +1464,15 @@ def _bind_parameters(stmt, args: list) -> "ast.Statement":
     return xform(copy.deepcopy(stmt))
 
 
-def _rows_to_columns(ts, names: list[str], rows: list[tuple]) -> dict:
-    """Python result rows -> host storage columns (values, valid)."""
-    import numpy as np
-
-    from trino_tpu import types as T
-
-    out = {}
-    for i, (c, t) in enumerate(zip(names, [ts.column_type(n) for n in names])):
-        raw = [r[i] for r in rows]
-        valid = np.array([v is not None for v in raw], dtype=bool)
-        if isinstance(t, T.ArrayType):
-            vals = np.empty(len(raw), dtype=object)
-            for j, v in enumerate(raw):
-                vals[j] = None if v is None else [
-                    _elem_storage(x, t.element) for x in v
-                ]
-        elif isinstance(t, T.MapType):
-            vals = np.empty(len(raw), dtype=object)
-            for j, v in enumerate(raw):
-                vals[j] = None if v is None else [
-                    (_elem_storage(k, t.key),
-                     None if x is None else _elem_storage(x, t.value))
-                    for k, x in (
-                        v.items() if isinstance(v, dict) else v
-                    )
-                ]
-        elif isinstance(t, T.RowType):
-            vals = np.empty(len(raw), dtype=object)
-            for j, v in enumerate(raw):
-                vals[j] = None if v is None else tuple(
-                    None if x is None else _elem_storage(x, ft)
-                    for x, (_fn, ft) in zip(v, t.fields)
-                )
-        elif isinstance(t, T.VarcharType):
-            vals = np.array(
-                ["" if v is None else str(v) for v in raw], dtype=object
-            )
-        elif isinstance(t, T.DecimalType):
-            vals = np.array(
-                [
-                    0 if v is None else _to_unscaled(v, t.scale)
-                    for v in raw
-                ],
-                dtype=np.int64,
-            )
-        elif isinstance(t, T.DateType):
-            vals = np.array(
-                [
-                    0 if v is None else (
-                        T.parse_date(v) if isinstance(v, str) else int(v)
-                    )
-                    for v in raw
-                ],
-                dtype=t.np_dtype,
-            )
-        elif isinstance(t, T.TimestampType):
-            vals = np.array(
-                [
-                    0 if v is None else (
-                        T.parse_timestamp(v) if isinstance(v, str) else int(v)
-                    )
-                    for v in raw
-                ],
-                dtype=t.np_dtype,
-            )
-        else:
-            vals = np.array(
-                [0 if v is None else v for v in raw], dtype=t.np_dtype
-            )
-        out[c] = (vals, None if valid.all() else valid)
-    return out
-
-
-def _elem_storage(v, t):
-    """One array ELEMENT -> the element type's storage form (mirrors
-    the scalar branches of _rows_to_columns: days for dates, unscaled
-    ints for decimals, micros for timestamps)."""
-    from trino_tpu import types as T
-
-    if isinstance(t, T.DecimalType):
-        return _to_unscaled(v, t.scale)
-    if isinstance(t, T.DateType):
-        return T.parse_date(v) if isinstance(v, str) else int(v)
-    if isinstance(t, T.TimestampType):
-        return T.parse_timestamp(v) if isinstance(v, str) else int(v)
-    if isinstance(t, T.VarcharType):
-        return str(v)
-    return v
-
-
-def _to_unscaled(v, scale: int) -> int:
-    from decimal import Decimal
-
-    if isinstance(v, Decimal):
-        return int(v.scaleb(scale))
-    if isinstance(v, int):
-        return v * 10**scale
-    if isinstance(v, str):
-        return int(Decimal(v).scaleb(scale))
-    return round(float(v) * 10**scale)
+# the host storage codec moved to connectors.base so the write path
+# (exec/write.py, WriteSink implementations) shares one encoder with
+# the legacy host-side VALUES path; these aliases keep engine-internal
+# call sites and test imports stable
+from trino_tpu.connectors.base import (  # noqa: E402
+    _elem_storage,
+    rows_to_columns as _rows_to_columns,
+    to_unscaled as _to_unscaled,
+)
 
 
 def _literal_value(e: ast.Expr, t):
@@ -1575,3 +1546,15 @@ def _has_order(plan: P.PlanNode) -> bool:
     while isinstance(node, (P.Output, P.Limit, P.Project)):
         node = node.sources[0]
     return isinstance(node, (P.Sort, P.TopN))
+
+
+def _write_handle(plan: P.PlanNode) -> dict | None:
+    """The write handle of a TableFinish-rooted (DML) plan, else None.
+    Write plans are never result-cached and commit with the statement
+    epoch as idempotency token."""
+    node = plan
+    while isinstance(node, (P.Output, P.Exchange)):
+        node = node.sources[0]
+    if isinstance(node, P.TableFinish):
+        return node.handle
+    return None

@@ -1199,6 +1199,69 @@ class LocalExecutor:
             known_rows=n, packed=True,
         )
 
+    # ---- write path ------------------------------------------------------
+
+    def _TableWriter(self, node: "P.TableWriter") -> Page:
+        """Drain the upstream subtree into a connector WriteSink
+        (MAIN/operator/TableWriterOperator.java analog). Emits one row
+        per sealed fragment — ($rows, $bytes, $fragment) — with the
+        task totals on the first row; an empty input emits zero rows
+        (TableFinish still commits, so an empty CTAS creates the
+        table)."""
+        from trino_tpu.exec import write as W
+        from trino_tpu.exec.spool import page_to_host
+
+        page = self.execute(node.source)
+        handle = node.handle
+        conn = self.metadata.connector(handle["catalog"])
+        sink = conn.write_sink(handle, getattr(self, "write_ctx", None))
+        mctx = self.memory_ctx.child("table-writer")
+        try:
+            W.write_through_sink(
+                sink, handle, page_to_host(page), node.columns, mctx,
+            )
+            res = W.finish_sink(sink, mctx)
+        except BaseException:
+            sink.abort()
+            raise
+        #: harvested into task stats by the worker / EXPLAIN ANALYZE
+        self.last_write_stats = res
+        rows = [
+            (
+                res["rows_written"] if i == 0 else 0,
+                res["bytes_written"] if i == 0 else 0,
+                f,
+            )
+            for i, f in enumerate(res["fragments"])
+        ]
+        return self._Values(P.Values(dict(node.outputs), rows=rows))
+
+    def _TableFinish(self, node: "P.TableFinish") -> Page:
+        """Single-task atomic commit (TableFinishOperator analog):
+        collect the gathered fragment rows and hand them to
+        Connector.finish_write exactly once."""
+        from trino_tpu.exec import write as W
+        from trino_tpu.exec.spool import page_to_host
+
+        page = self.execute(node.source)
+        frags = W.fragment_rows(page_to_host(page))
+        token = str(
+            (getattr(self, "write_ctx", None) or {}).get("epoch", "")
+        )
+        rows, secs = W.commit_write(
+            self.metadata, node.handle, frags, token=token,
+        )
+        h = node.handle
+        self.invalidate_scan(h["catalog"], h["schema"], h["table"])
+        summary = W.fragments_summary(frags)
+        self.last_commit_stats = {
+            "rows": rows,
+            "bytes": summary["bytes"],
+            "files": summary["files"],
+            "commit_seconds": secs,
+        }
+        return self._Values(P.Values(dict(node.outputs), rows=[(rows,)]))
+
     # ---- row-level nodes -------------------------------------------------
 
     def _Output(self, node: P.Output) -> Page:

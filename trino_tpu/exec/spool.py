@@ -522,6 +522,9 @@ def write_task_output(
         parts = partition_ids(
             [payload["cols"][i] for i in idx], n, n_parts
         )
+    elif partitioning == "round_robin":
+        # scaled-writer fan-out: even row spread, no key
+        parts = np.arange(n, dtype=np.int64) % max(int(n_parts), 1)
     else:
         parts = np.zeros(n, dtype=np.int64)
     written = []

@@ -1,0 +1,141 @@
+"""TableWriter/TableFinish execution helpers.
+
+The host-side half of the write path (MAIN/operator/
+TableWriterOperator.java + TableFinishOperator.java analog): device
+pages materialize to host storage columns, stream through a connector
+``WriteSink``, and the sealed fragments ride the exchange fabric to a
+single TableFinish task whose ``commit_write`` is the one atomic
+mutation of the whole statement.
+
+Deliberately free of engine imports — the fleet worker and the local
+executor both drive it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from trino_tpu import telemetry
+from trino_tpu import types as T
+
+__all__ = [
+    "sink_columns_from_payload",
+    "fragment_rows",
+    "write_through_sink",
+    "finish_sink",
+    "commit_write",
+    "fragments_summary",
+]
+
+
+def sink_columns_from_payload(
+    handle: dict, payload: dict, writer_columns: list[str]
+) -> tuple[dict, int]:
+    """Map a ``page_to_host`` payload onto sink storage columns.
+
+    ``writer_columns`` is the TableWriter's symbol list, positionally
+    aligned with ``handle["columns"]`` (the target column order).
+    Long decimals collapse from the device two-limb ``[n, 2]`` layout
+    to unscaled int64 — the storage form every sink expects."""
+    idx = {n: i for i, n in enumerate(payload["names"])}
+    cols: dict = {}
+    n_rows = len(payload["cols"][0][0]) if payload["cols"] else 0
+    for (cname, _tstr), sym in zip(handle["columns"], writer_columns):
+        vals, valid = payload["cols"][idx[sym]]
+        t = payload["types"][idx[sym]]
+        if (
+            isinstance(t, T.DecimalType)
+            and getattr(vals, "ndim", 1) == 2
+        ):
+            vals = (
+                vals[:, 0].astype(np.int64) << np.int64(32)
+            ) + vals[:, 1].astype(np.int64)
+        cols[cname] = (vals, valid)
+    return cols, n_rows
+
+
+def fragment_rows(payload: dict) -> list[str]:
+    """Extract the fragment strings from a TableWriter output payload
+    (the ``$fragment`` column; NULL rows — writer tasks that produced
+    no fragments — are dropped)."""
+    idx = {n: i for i, n in enumerate(payload["names"])}
+    vals, valid = payload["cols"][idx["$fragment"]]
+    out = []
+    for i, v in enumerate(vals):
+        if valid is not None and not valid[i]:
+            continue
+        s = str(v)
+        if s:
+            out.append(s)
+    return out
+
+
+def write_through_sink(sink, handle, payload, writer_columns, memory_ctx=None):
+    """Append one host payload to ``sink``, accounting the sink's
+    buffered-bytes delta against ``memory_ctx`` (task MemoryContext)
+    so buffered writes obey query_max_memory_per_node."""
+    cols, n = sink_columns_from_payload(handle, payload, writer_columns)
+    if n == 0:
+        return
+    before = sink.buffered_bytes
+    sink.append(cols, n)
+    if memory_ctx is not None:
+        delta = sink.buffered_bytes - before
+        if delta > 0:
+            memory_ctx.reserve(delta)
+        elif delta < 0:
+            memory_ctx.free(-delta)
+
+
+def finish_sink(sink, memory_ctx=None) -> dict:
+    """Seal the sink; release any remaining buffered-byte reservation;
+    emit write telemetry. Returns the per-task writer result:
+    ``{"fragments", "rows_written", "bytes_written", "files"}``."""
+    held = sink.buffered_bytes
+    try:
+        frags = sink.finish()
+    finally:
+        if memory_ctx is not None and held > 0:
+            memory_ctx.free(held)
+    telemetry.WRITE_ROWS.inc(sink.rows_written)
+    telemetry.WRITE_BYTES.inc(sink.bytes_written)
+    telemetry.WRITE_FILES.inc(sink.files_written)
+    return {
+        "fragments": list(frags),
+        "rows_written": int(sink.rows_written),
+        "bytes_written": int(sink.bytes_written),
+        "files": int(sink.files_written),
+    }
+
+
+def commit_write(
+    metadata, handle: dict, fragments: list[str], token: str = "",
+) -> tuple[int, float]:
+    """TableFinish commit: hand the winning attempts' fragment set to
+    the connector's atomic ``finish_write``. Returns
+    ``(rows_committed, commit_seconds)``."""
+    conn = metadata.connector(handle["catalog"])
+    t0 = time.monotonic()
+    rows = conn.finish_write(handle, list(fragments), token=token)
+    dt = time.monotonic() - t0
+    telemetry.WRITE_COMMIT_SECONDS.observe(dt)
+    return int(rows), dt
+
+
+def fragments_summary(fragments: list[str]) -> dict:
+    """Fold a fragment set into display stats for EXPLAIN ANALYZE:
+    total rows / bytes / file count (memory fragments count as one
+    file each)."""
+    rows = files = bytes_ = 0
+    for f in fragments:
+        try:
+            d = json.loads(f)
+        except (ValueError, TypeError):
+            continue
+        rows += int(d.get("rows", 0))
+        bytes_ += int(d.get("bytes", 0))
+        files += 1
+    return {"rows": rows, "bytes": bytes_, "files": files}

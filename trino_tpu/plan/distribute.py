@@ -119,6 +119,10 @@ class _Ctx:
             SP.get(session, "broadcast_join_row_limit")
         )
         self.stats_cache: dict = {}
+        #: writer stages may fan out (hash / round-robin) only when the
+        #: executor can run non-single exchanges host-side (fleet); a
+        #: real device mesh gathers below the writer instead
+        self.scaled_writers = False
 
     def rows(self, node: P.PlanNode) -> float:
         return S.estimate(node, self.md, self.stats_cache).rows
@@ -167,8 +171,10 @@ def add_exchanges(
     metadata: Metadata,
     n_shards: int = 8,
     session: Session | None = None,
+    scaled_writers: bool = False,
 ) -> P.PlanNode:
     ctx = _Ctx(metadata, n_shards, session)
+    ctx.scaled_writers = bool(scaled_writers)
     node, _ = _walk(plan, ctx)
     return node
 
@@ -254,6 +260,43 @@ def _walk(node: P.PlanNode, ctx: _Ctx) -> tuple[P.PlanNode, str]:
             input_dist=fd,
         )
         return dc_replace(node, source=src, filter_source=bcast), "dist"
+
+    if isinstance(node, P.TableWriter):
+        # TableWriterNode placement (MAIN/sql/planner/
+        # AddExchanges.java visitTableWriter analog): with scaled
+        # writers, partitioned targets hash-exchange on the partition
+        # columns so each writer owns whole partitions (one file set
+        # per partition per writer); unpartitioned targets round-robin
+        # across task_writer_count writers. On a real device mesh the
+        # writer runs host-side, so gather the child and write single.
+        src, d = _walk(node.source, ctx)
+        if d == "dist" and ctx.scaled_writers:
+            pb = [str(k) for k in node.handle.get("partition_by") or []]
+            if pb:
+                ts_cols = [c for c, _ in node.handle["columns"]]
+                pos = {c: i for i, c in enumerate(ts_cols)}
+                hash_syms = [node.columns[pos[k]] for k in pb]
+                ex = P.Exchange(
+                    dict(src.outputs), source=src,
+                    partitioning="hash", hash_symbols=hash_syms,
+                )
+            else:
+                ex = P.Exchange(
+                    dict(src.outputs), source=src,
+                    partitioning="round_robin",
+                )
+            return dc_replace(node, source=ex), "dist"
+        if d == "dist":
+            src = _gather(src)
+        return dc_replace(node, source=src), "single"
+
+    if isinstance(node, P.TableFinish):
+        # single coordinator-side commit task over the gathered
+        # fragment stream
+        src, d = _walk(node.source, ctx)
+        if d == "dist":
+            src = _gather(src)
+        return dc_replace(node, source=src), "single"
 
     # unknown nodes: force single execution of every source
     srcs = []

@@ -128,6 +128,11 @@ class _Fragmenter:
             if node.partitioning == "hash":
                 child = self.build(node.source, "hash", node.hash_symbols)
                 return self._remote(stage, child, node.outputs, "aligned")
+            if node.partitioning == "round_robin":
+                # scaled unpartitioned writers: rows spread evenly
+                # across task_writer_count tasks, no key
+                child = self.build(node.source, "round_robin", [])
+                return self._remote(stage, child, node.outputs, "aligned")
             # single (gather) and broadcast both spool to one bucket;
             # the consumer-side difference is only which tasks read it
             child = self.build(node.source, "single", [])

@@ -20,7 +20,7 @@ __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "Aggregate", "Join",
     "SemiJoin", "Sort", "TopN", "Limit", "Output", "Values", "Exchange",
     "SortKey", "Window", "WindowCall", "Union", "Unnest", "RemoteSource",
-    "GroupId",
+    "GroupId", "TableWriter", "TableFinish",
 ]
 
 
@@ -328,6 +328,47 @@ class Output(PlanNode):
         return [self.source]
 
 
+@dataclass
+class TableWriter(PlanNode):
+    """Drains its source into a connector WriteSink
+    (MAIN/sql/planner/plan/TableWriterNode.java /
+    MAIN/operator/TableWriterOperator.java analog). Emits one row per
+    sealed fragment: ($rows, $bytes, $fragment) — the fragment strings
+    ride the exchange fabric up to TableFinish, so a distributed write
+    is just another stage whose (tiny) output spools with first-commit-
+    wins attempt dedup, giving exactly-once fragment selection for
+    free."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    #: JSON-safe connector write handle: {catalog, schema, table, mode,
+    #: columns: [[name, type_str], ...], partition_by, ...} produced by
+    #: Connector.begin_insert/begin_create (side-effect free)
+    handle: dict = field(default_factory=dict)
+    #: source symbols in target-table column order (position i feeds
+    #: handle["columns"][i])
+    columns: list[str] = field(default_factory=list)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclass
+class TableFinish(PlanNode):
+    """Single-task commit stage above the writers
+    (MAIN/sql/planner/plan/TableFinishNode.java /
+    MAIN/operator/TableFinishOperator.java analog): gathers the winning
+    attempts' fragment rows and calls Connector.finish_write exactly
+    once. Output: a single-row ($written) count."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    handle: dict = field(default_factory=dict)
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
 def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
     """EXPLAIN-style rendering (MAIN/sql/planner/planprinter analog)."""
     pad = "  " * indent
@@ -371,6 +412,14 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
         detail = f"[{node.scope} {node.partitioning} {node.hash_symbols}]"
     elif isinstance(node, Output):
         detail = f"[{node.names}]"
+    elif isinstance(node, (TableWriter, TableFinish)):
+        h = node.handle
+        pb = h.get("partition_by") or []
+        detail = (
+            f"[{h.get('catalog', '')}.{h.get('schema', '')}."
+            f"{h.get('table', '')} {h.get('mode', '')}"
+            + (f" partition_by={pb}" if pb else "") + "]"
+        )
     lines = [f"{pad}{name}{detail} -> {list(node.outputs)}"]
     for s in node.sources:
         lines.append(plan_tree_str(s, indent + 1))

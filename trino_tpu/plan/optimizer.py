@@ -238,7 +238,8 @@ def _or_all(parts: list[RowExpression]) -> RowExpression:
 def _replace_sources(node: P.PlanNode, new_sources: list[P.PlanNode]) -> P.PlanNode:
     if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
                          P.Limit, P.Output, P.Exchange, P.Window,
-                         P.Unnest, P.GroupId)):
+                         P.Unnest, P.GroupId, P.TableWriter,
+                         P.TableFinish)):
         return dc_replace(node, source=new_sources[0])
     if isinstance(node, P.Union):
         return dc_replace(node, all_sources=list(new_sources))
@@ -912,4 +913,12 @@ def _prune(node: P.PlanNode, needed: set[str] | None) -> P.PlanNode:
         )
     if isinstance(node, P.Values):
         return node
+    if isinstance(node, P.TableWriter):
+        # the writer consumes exactly its column list — everything the
+        # source produces beyond it is prunable
+        src = _prune(node.source, set(node.columns))
+        return dc_replace(node, source=src)
+    if isinstance(node, P.TableFinish):
+        src = _prune(node.source, set(node.source.outputs))
+        return dc_replace(node, source=src)
     return node

@@ -241,6 +241,17 @@ def plan_to_json(node: P.PlanNode) -> dict:
             symbols=list(node.symbols),
         )
         return d
+    if isinstance(node, P.TableWriter):
+        d.update(
+            source=plan_to_json(node.source), handle=dict(node.handle),
+            columns=list(node.columns),
+        )
+        return d
+    if isinstance(node, P.TableFinish):
+        d.update(
+            source=plan_to_json(node.source), handle=dict(node.handle),
+        )
+        return d
     raise TypeError(f"unserializable plan node {type(node).__name__}")
 
 
@@ -371,6 +382,16 @@ def plan_from_json(d: dict) -> P.PlanNode:
         return P.Output(
             outputs, source=plan_from_json(d["source"]),
             names=list(d["names"]), symbols=list(d["symbols"]),
+        )
+    if kind == "TableWriter":
+        return P.TableWriter(
+            outputs, source=plan_from_json(d["source"]),
+            handle=dict(d["handle"]), columns=list(d["columns"]),
+        )
+    if kind == "TableFinish":
+        return P.TableFinish(
+            outputs, source=plan_from_json(d["source"]),
+            handle=dict(d["handle"]),
         )
     raise ValueError(f"bad plan node kind {kind!r}")
 

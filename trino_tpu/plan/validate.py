@@ -184,6 +184,8 @@ def _consumed(node: P.PlanNode) -> list[tuple[str, set[str]]]:
         return [("any", used)]
     if isinstance(node, P.Output):
         return [("any", set(node.symbols))]
+    if isinstance(node, P.TableWriter):
+        return [("any", set(node.columns))]
     return []
 
 
@@ -202,6 +204,10 @@ def _introduced(node: P.PlanNode) -> set[str]:
         return set(node.element_symbols)
     if isinstance(node, P.GroupId):
         return {node.id_symbol}
+    if isinstance(node, (P.TableWriter, P.TableFinish)):
+        # generator nodes: fragment rows / the commit count are
+        # manufactured, not passed through
+        return set(node.outputs)
     return set()
 
 
@@ -421,6 +427,120 @@ def _check_dynamic_filters(root: P.PlanNode, fail) -> None:
     walk(root)
 
 
+def _check_writers(root: P.PlanNode, fail) -> None:
+    """Write-path invariants (the TableWriter half of the reference's
+    ValidateDependenciesChecker):
+
+    - ``writer-schema``: the writer's column list matches its handle's
+      target-table schema positionally, and the source produces each
+      column symbol with exactly the declared type;
+    - ``writer-fragments``: fragment rows flow only to TableFinish
+      (possibly through Exchanges) — any other consumer would read
+      uncommitted write metadata as query data;
+    - ``writer-partitioning``: a hash exchange feeding a partitioned
+      write partitions on exactly the declared partition-column
+      symbols, so co-located rows land in one writer's part file."""
+    from trino_tpu import types as TT
+
+    parents: dict[int, list[P.PlanNode]] = {}
+    nodes: dict[int, P.PlanNode] = {}
+    seen: set[int] = set()
+
+    def walk(n: P.PlanNode) -> None:
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        nodes[id(n)] = n
+        for s in n.sources:
+            parents.setdefault(id(s), []).append(n)
+            walk(s)
+
+    walk(root)
+
+    for n in nodes.values():
+        if isinstance(n, P.TableWriter):
+            h = n.handle
+            hcols = list(h.get("columns") or [])
+            if len(n.columns) != len(hcols):
+                fail(
+                    "writer-schema",
+                    f"TableWriter for {h.get('schema')}.{h.get('table')}"
+                    f" feeds {len(n.columns)} columns into a "
+                    f"{len(hcols)}-column target",
+                )
+            else:
+                src_out = n.source.outputs
+                for sym, (cname, tstr) in zip(n.columns, hcols):
+                    want = TT.type_from_name(tstr)
+                    got = src_out.get(sym)
+                    if got is None:
+                        continue  # symbol closure already reported
+                    if got != want:
+                        fail(
+                            "writer-schema",
+                            f"TableWriter column {cname!r} declared "
+                            f"{want} in the target table but source "
+                            f"symbol {sym!r} produces {got}",
+                        )
+            # fragments reach TableFinish and nothing else
+            cur = n
+            while True:
+                ps = parents.get(id(cur), [])
+                if not ps and cur is root:
+                    # fragment root: the consumer is the parent
+                    # stage's TableFinish (via RemoteSource) — its
+                    # stage re-validates the TableFinish half below
+                    break
+                if len(ps) != 1:
+                    fail(
+                        "writer-fragments",
+                        f"TableWriter fragments have {len(ps)} "
+                        f"consumers; exactly one TableFinish expected",
+                    )
+                    break
+                parent = ps[0]
+                if isinstance(parent, P.TableFinish):
+                    break
+                if not isinstance(parent, P.Exchange):
+                    fail(
+                        "writer-fragments",
+                        f"{type(parent).__name__} consumes TableWriter "
+                        f"fragments; only TableFinish (via Exchanges) "
+                        f"may read them",
+                    )
+                    break
+                cur = parent
+            # partitioned writes hash on the partition columns
+            pb = list(h.get("partition_by") or [])
+            below = n.source
+            if pb and isinstance(below, P.Exchange) and (
+                below.partitioning == "hash"
+            ):
+                pos = {c: i for i, (c, _t) in enumerate(hcols)}
+                want_syms = [
+                    n.columns[pos[k]] for k in pb
+                    if k in pos and pos[k] < len(n.columns)
+                ]
+                if list(below.hash_symbols) != want_syms:
+                    fail(
+                        "writer-partitioning",
+                        f"partitioned write into {h.get('table')!r} "
+                        f"exchanges on {list(below.hash_symbols)} but "
+                        f"the declared partition columns {pb} map to "
+                        f"{want_syms}",
+                    )
+        if isinstance(n, P.TableFinish):
+            below = n.source
+            while isinstance(below, P.Exchange):
+                below = below.source
+            if not isinstance(below, (P.TableWriter, P.RemoteSource)):
+                fail(
+                    "writer-fragments",
+                    f"TableFinish reads {type(below).__name__}; its "
+                    f"input must be TableWriter fragments",
+                )
+
+
 def validate_plan(plan: P.PlanNode, phase: str) -> P.PlanNode:
     """Run every plan-level invariant; raise :class:`PlanSanityError`
     attributing the first violation to ``phase``. Returns the plan so
@@ -445,6 +565,7 @@ def validate_plan(plan: P.PlanNode, phase: str) -> P.PlanNode:
         walk(plan)
         _check_exchanges(plan, fail)
         _check_dynamic_filters(plan, fail)
+        _check_writers(plan, fail)
     if failures:
         check, message = failures[0]
         if len(failures) > 1:
@@ -638,7 +759,9 @@ def validate_stages(stages, phase: str = "fragment_plan"):
                 f"stage {stage.stage_id}: negative output partition "
                 f"override {op}",
             )
-        if op and stage.partitioning != "hash":
+        if op and stage.partitioning not in ("hash", "round_robin"):
+            # hash: runtime-adaptive repartitioning; round_robin: the
+            # scaled-writer fan-out (task_writer_count writer tasks)
             fail(
                 "adaptive-repartition",
                 f"stage {stage.stage_id}: output partition override "

@@ -142,6 +142,21 @@ def _query_tier_retryable(e: BaseException) -> bool:
     return False
 
 
+def _write_finish_of(stages: list[Stage]) -> dict | None:
+    """If the fragmented plan ends in a coordinator-side TableFinish
+    (Output -> TableFinish -> RemoteSource), return its commit spec.
+    The fleet strips that root stage and performs the commit itself:
+    worker connector instances are per-process, so only the
+    coordinator's connector sees the authoritative catalog state."""
+    root = stages[-1].root
+    if not isinstance(root, P.Output):
+        return None
+    fin = root.sources[0]
+    if not isinstance(fin, P.TableFinish):
+        return None
+    return {"handle": fin.handle, "names": list(root.names)}
+
+
 class _FleetParallelism:
     """Duck-typed mesh stand-in for plan_stmt: the fleet's TOTAL
     parallelism (spool partitions x per-worker device count, the
@@ -523,6 +538,8 @@ class FleetRunner:
         self._last_stages = None
         self._last_plan = None
         self._plan_digest = None
+        self._write_finish = None
+        self._last_commit_stats = None
         self._task_stats = []
         metrics_before = telemetry.REGISTRY.snapshot()
         try:
@@ -783,6 +800,13 @@ class FleetRunner:
                 device_bytes=res.cache_stats["device"]["bytes"],
             )
             lines.append(cs.explain_line())
+        cw = getattr(self, "_last_commit_stats", None)
+        if cw is not None:
+            lines.append(
+                f"TableWriter: {cw['rows']} rows, {cw['files']} files, "
+                f"{_fmt_bytes(cw['bytes'])} "
+                f"(commit {cw['commit_seconds'] * 1000.0:.1f} ms)"
+            )
         ops_by_stage: dict[str, dict] = {}
         for ts in res.task_stats:
             if ts.get("state") != "FINISHED":
@@ -1065,6 +1089,15 @@ class FleetRunner:
                         validate.validate_stages(
                             stages, phase="fragment_plan"
                         )
+                    # DML: the TableFinish-rooted output stage never
+                    # dispatches to a worker — connector metadata
+                    # state lives in THIS process, and exactly-once
+                    # wants the single atomic commit to happen after
+                    # the coordinator gathers the winning fragments
+                    self._write_finish = _write_finish_of(stages)
+                    if self._write_finish is not None:
+                        stages = stages[:-1]
+                        self._scale_writer_stages(stages)
                     self._plan_ms = (
                         (time.perf_counter() - t_plan) * 1e3
                     )
@@ -1105,6 +1138,10 @@ class FleetRunner:
                     result.cache_stats = cs.as_dict()
                 return result
             except Exception as e:
+                # the failed attempt's spool epoch is its write token:
+                # un-stage anything its writers left behind before the
+                # retry (or the caller) re-enters under a fresh epoch
+                self._abort_write_epoch()
                 if policy != "QUERY" or not _query_tier_retryable(e):
                     raise
                 last_exc = e
@@ -1186,6 +1223,11 @@ class FleetRunner:
                 validate.check_edge_coverage(stages, self._task_stats)
             with tracer.span("read-root", "spool"):
                 payload = self._read_root(stages, qroot, tasks_by_stage)
+            if getattr(self, "_write_finish", None) is not None:
+                # the gathered root is the writer fragment stream;
+                # commit it HERE, exactly once, tokened by the spool
+                # epoch so a journal-resumed replay is idempotent
+                payload = self._commit_write(payload, query_id)
             page = spool.host_to_page(payload)
             rows = page.to_pylist()
             res = QueryResult(
@@ -1211,7 +1253,9 @@ class FleetRunner:
                 1 for s in stages if getattr(s, "salt_plan", None)
             )
             res.adaptive_repartitions = sum(
-                1 for s in stages if getattr(s, "out_partitions", 0)
+                1 for s in stages
+                if getattr(s, "out_partitions", 0)
+                and s.partitioning == "hash"
             )
             trace = tracer.finish()
             for spn in trace.root.walk():
@@ -1319,6 +1363,22 @@ class FleetRunner:
             )
             st["direct_bytes"] += int(ts.get("direct_bytes", 0) or 0)
             st["spooled_bytes"] += int(ts.get("spooled_bytes", 0) or 0)
+            if ts.get("rows_written") is not None:
+                # TableWriter stages: committed write volume, summed
+                # over winning attempts (system.runtime.tasks +
+                # EXPLAIN ANALYZE writer line)
+                st["rows_written"] = (
+                    st.get("rows_written", 0)
+                    + int(ts.get("rows_written", 0) or 0)
+                )
+                st["bytes_written"] = (
+                    st.get("bytes_written", 0)
+                    + int(ts.get("bytes_written", 0) or 0)
+                )
+                st["files_written"] = (
+                    st.get("files_written", 0)
+                    + int(ts.get("files_written", 0) or 0)
+                )
             # per-partition exchange histograms: the stage's output
             # edge, summed over its committed tasks (deliverable (a)
             # of the ROADMAP skew item)
@@ -1340,7 +1400,10 @@ class FleetRunner:
                 st["salted"] = dict(s.salt_plan)
             if getattr(s, "out_partitions", 0):
                 st["out_partitions"] = int(s.out_partitions)
-                st["adaptive_repartitions"] = 1
+                # scaled-writer round_robin stages set out_partitions
+                # by PLAN (task_writer_count), not by runtime adaption
+                if s.partitioning == "hash":
+                    st["adaptive_repartitions"] = 1
         for sid, st in by_stage.items():
             st["partition_skew"] = telemetry_analysis.partition_skew(
                 st["partition_rows"]
@@ -1357,6 +1420,68 @@ class FleetRunner:
             )
         order = [s.stage_id for s in stages]
         return [by_stage[sid] for sid in order if sid in by_stage]
+
+    def _scale_writer_stages(self, stages: list[Stage]) -> None:
+        """Round-robin writer fan-out: the stage feeding an
+        unpartitioned scaled TableWriter spools into
+        ``task_writer_count`` partitions, so the aligned writer stage
+        runs that many tasks (``writer_scaling=false`` collapses to
+        one). Hash-partitioned writes keep the fleet's default
+        fan-out."""
+        n = (
+            int(sp.get(self.session, "task_writer_count"))
+            if bool(sp.get(self.session, "writer_scaling")) else 1
+        )
+        for s in stages:
+            if s.partitioning == "round_robin":
+                s.out_partitions = max(n, 1)
+
+    def _commit_write(self, payload: dict, epoch: str) -> dict:
+        """Coordinator-side TableFinish: fold the gathered writer
+        fragments into one atomic ``finish_write`` (tokened by the
+        spool epoch — replays after a crash-recovery resume observe
+        the committed result, never a double apply). Returns the
+        statement's result payload."""
+        import numpy as np
+
+        from trino_tpu import types as T
+        from trino_tpu.exec import write as W
+
+        wf = self._write_finish
+        handle = wf["handle"]
+        frags = W.fragment_rows(payload)
+        rows, secs = W.commit_write(
+            self._planner.metadata, handle, frags, token=epoch,
+        )
+        self._planner.executor.invalidate_scan(
+            handle["catalog"], handle["schema"], handle["table"]
+        )
+        summary = W.fragments_summary(frags)
+        self._last_commit_stats = {
+            "rows": rows,
+            "bytes": summary["bytes"],
+            "files": summary["files"],
+            "commit_seconds": secs,
+        }
+        return {
+            "names": list(wf["names"]),
+            "types": [T.BIGINT],
+            "cols": [(np.asarray([rows], dtype=np.int64), None)],
+        }
+
+    def _abort_write_epoch(self) -> None:
+        """Discard the failed attempt's staged write artifacts (QUERY
+        retry / terminal failure). Best-effort by SPI contract."""
+        wf = getattr(self, "_write_finish", None)
+        epoch = getattr(self, "_query_id", None)
+        if wf is None or not epoch:
+            return
+        try:
+            self._planner.metadata.connector(
+                wf["handle"]["catalog"]
+            ).abort_write(wf["handle"], token=epoch)
+        except Exception:
+            pass
 
     def _read_root(
         self, stages: list[Stage], qroot: str,
@@ -2629,6 +2754,22 @@ class FleetRunner:
                         "direct_bytes": tstats.get("direct_bytes", 0),
                         "spooled_bytes": tstats.get(
                             "spooled_bytes", 0
+                        ),
+                        # writer tasks report their sink totals; the
+                        # per-stage aggregate and EXPLAIN ANALYZE's
+                        # TableWriter line render from these
+                        **(
+                            {
+                                "rows_written": tstats["rows_written"],
+                                "bytes_written": tstats[
+                                    "bytes_written"
+                                ],
+                                "files_written": tstats[
+                                    "files_written"
+                                ],
+                            }
+                            if tstats.get("rows_written") is not None
+                            else {}
                         ),
                         # per-edge consumer row counts (source_id ->
                         # rows read) — the exchange-coverage debug

@@ -928,6 +928,7 @@ class WorkerServer:
             )
             rows_in = 0
             out_stats = {"rows": 0, "bytes": 0}
+            write_stats = None
             peak_bytes = 0
             op_stats: list = []
             col_ranges: dict = {}
@@ -1074,6 +1075,16 @@ class WorkerServer:
                             qid
                         ).child(tkey)
                         ex.memory_ctx = task_ctx
+                        # writer-task identity: the spool epoch + task
+                        # + attempt key staged write artifacts so
+                        # speculated attempts never collide on part
+                        # file names
+                        ex.write_ctx = {
+                            "epoch": os.path.basename(root),
+                            "task": req["task_id"],
+                            "attempt": int(req["attempt"]),
+                        }
+                        ex.last_write_stats = None
                         from trino_tpu.profiler import OperatorProfiler
 
                         ex.profiler = prof = OperatorProfiler()
@@ -1169,6 +1180,10 @@ class WorkerServer:
                             jit_cache.set_active_span(None)
                             ex.profiler = None
                             peak_bytes = task_ctx.peak_bytes
+                            write_stats = getattr(
+                                ex, "last_write_stats", None
+                            )
+                            ex.write_ctx = None
                             ex.cancel_event = None
                             ex.remote_pages = {}
                             ex.remote_hash_keys = {}
@@ -1220,6 +1235,20 @@ class WorkerServer:
                             **(
                                 {"col_ranges": col_ranges}
                                 if col_ranges else {}
+                            ),
+                            **(
+                                {
+                                    "rows_written": int(
+                                        write_stats["rows_written"]
+                                    ),
+                                    "bytes_written": int(
+                                        write_stats["bytes_written"]
+                                    ),
+                                    "files_written": int(
+                                        write_stats["files"]
+                                    ),
+                                }
+                                if write_stats else {}
                             ),
                         }
                         task.spans = tspan.finish().to_dict()
@@ -1422,6 +1451,23 @@ def main():
             else QueryRunner.tpch
         )
         runner = factory(args.schema, mesh=mesh)
+    if "memory" not in runner.metadata.catalogs():
+        # memory-table writer fragments only BUFFER on workers (all
+        # mutation happens in the coordinator-side TableFinish), but
+        # the fragment's write handle still resolves its catalog here
+        from trino_tpu.connectors.memory import MemoryConnector
+
+        runner.metadata.register_catalog("memory", MemoryConnector())
+    extra_pq = os.environ.get("TRINO_TPU_WORKER_EXTRA_PARQUET", "")
+    if extra_pq:
+        # writable lakehouse catalog on a shared filesystem: mount
+        # "name=/path" (default name "hive") so writer tasks stage
+        # part files into the SAME tree the coordinator commits
+        from trino_tpu.connectors.parquet import ParquetConnector
+
+        name, _, proot = extra_pq.rpartition("=")
+        name = name or "hive"
+        runner.metadata.register_catalog(name, ParquetConnector(proot))
     if os.environ.get("TRINO_TPU_PREWARM", "") not in ("", "0"):
         # trace-compile the canonical bucket set before accepting
         # tasks (cheap against a warm persistent cache; off by default

@@ -395,6 +395,22 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "(QUERY_MAX_QUEUED_TIME analog)",
             "varchar", "5m", _duration("query_max_queued_time"),
         ),
+        # ---- write path ------------------------------------------------
+        _P(
+            "task_writer_count",
+            "Writer tasks an unpartitioned INSERT/CTAS fans out to in "
+            "fleet mode (round-robin page routing; task_writer_count "
+            "analog). Partitioned writes partition on the target's "
+            "partition keys instead",
+            "bigint", 4, _positive("task_writer_count"),
+        ),
+        _P(
+            "writer_scaling",
+            "Scale unpartitioned writes across task_writer_count "
+            "writer tasks; false pins a single writer task "
+            "(scale_writers analog)",
+            "boolean", True,
+        ),
         # ---- fleet / fault tolerance ----------------------------------
         _P(
             "retry_policy",

@@ -452,6 +452,9 @@ class CreateTableAs(Statement):
     name: tuple[str, ...]
     query: "Query"
     if_not_exists: bool = False
+    #: WITH (k = literal, ...) table properties — e.g.
+    #: partitioned_by = ARRAY['k'], row_group_size = 1000
+    properties: "list[tuple[str, Expr]]" = field(default_factory=list)
 
 
 @dataclass

@@ -178,8 +178,24 @@ class _Parser:
                 self.expect_kw("exists")
                 if_not_exists = True
             name = self.qualified_name()
+            properties = []
+            if self.accept_kw("with"):
+                self.expect_op("(")
+                while True:
+                    k = self.ident()
+                    self.expect_op("=")
+                    properties.append((k, self.expr()))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
             if self.accept_kw("as"):
-                return ast.CreateTableAs(name, self.query(), if_not_exists)
+                return ast.CreateTableAs(
+                    name, self.query(), if_not_exists, properties
+                )
+            if properties:
+                raise SqlSyntaxError(
+                    "WITH (...) table properties require CREATE TABLE AS"
+                )
             self.expect_op("(")
             columns = []
             while True:

@@ -708,6 +708,23 @@ ANOMALIES = REGISTRY.counter(
     "Completion-time anomaly verdicts emitted by the performance "
     "sentry, by driver bucket (xla_compile / scan / exchange / "
     "straggler_slack / cache_miss_expected_hit / ...)")
+WRITE_ROWS = REGISTRY.counter(
+    "trino_write_rows_total",
+    "Rows appended through TableWriter sinks (counted at the writing "
+    "task, before commit)")
+WRITE_BYTES = REGISTRY.counter(
+    "trino_write_bytes_total",
+    "Bytes written by TableWriter sinks into staged / committed "
+    "storage artifacts")
+WRITE_FILES = REGISTRY.counter(
+    "trino_write_files_total",
+    "Storage files sealed by TableWriter sinks (parquet part files; "
+    "memory fragments count as one each)")
+WRITE_COMMIT_SECONDS = REGISTRY.histogram(
+    "trino_write_commit_seconds",
+    "TableFinish commit latency: Connector.finish_write wall time "
+    "(CRC verify + atomic renames + manifest publish)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0))
 PROCESS_RSS = REGISTRY.gauge(
     "trino_process_rss_bytes",
     "Resident set size of this node process")

@@ -48,7 +48,7 @@ __all__ = [
     "CHAOS_BASE_PORT", "spawn_workers", "stop_workers",
     "make_fleet", "make_serving", "run_chaos_soak", "fired_sites",
     "run_storage_chaos", "run_skew_chaos", "run_elastic_chaos",
-    "run_cache_chaos", "run_recovery_chaos",
+    "run_cache_chaos", "run_recovery_chaos", "run_write_chaos",
 ]
 
 CHAOS_BASE_PORT = 18960
@@ -1059,6 +1059,180 @@ def run_recovery_chaos(
     finally:
         if coord_proc is not None and coord_proc.poll() is None:
             coord_proc.kill()
+        stop_workers(procs)
+    return record
+
+
+#: distributed CTAS under chaos: partitioned so the writer stage is
+#: hash-distributed (every worker writes), deterministic content so
+#: the committed table can be diffed row-for-row against a clean twin
+_WRITE_SQL = (
+    "create table hive.chaos.{table} "
+    "with (partitioned_by = array['o_orderpriority']) as "
+    "select o_orderkey, o_totalprice, o_orderpriority from orders"
+)
+
+
+def run_write_chaos(
+    seed: int = 0, base_port: int = 19720, spool_root: str | None = None,
+) -> dict:
+    """Write-path chaos: the exactly-once commit contract under the
+    same fault model as reads. Spawns its own 2-worker fleets (hive
+    catalog shipped via ``TRINO_TPU_WORKER_EXTRA_PARQUET``) at
+    ``base_port``+ (recovery chaos owns 19520+, bench recovery
+    19680+, tests/test_write_path.py 19760+).
+
+    A clean partitioned CTAS off TPC-H tiny establishes the twin.
+    Scenario ``staged-faults`` re-runs it with every writer task's
+    attempt-0 failing at ``spool-write`` and ``task-exec``; scenario
+    ``worker-kill`` SIGKILLs a worker the moment a writer-stage task
+    lands on it, mid-write by construction. Both must commit a table
+    that is ROW-IDENTICAL to the clean twin — retried attempts stage
+    under their own (epoch, task, attempt) part names, losers never
+    reach the manifest, and the commit token makes the coordinator's
+    finish_write idempotent. The audit additionally proves zero
+    orphans: every committed part file is in the manifest, no
+    duplicate manifest paths, and the staging epoch dir is gone.
+
+    Requires pyarrow (the caller gates)."""
+    import tempfile
+
+    from trino_tpu.connectors.parquet import ParquetConnector
+
+    hive_root = tempfile.mkdtemp(prefix="chaos-write-hive")
+    record: dict = {"seed": seed, "runs": []}
+
+    def write_fleet(worker_uris, root):
+        md = Metadata()
+        md.register_catalog("tpch", TpchConnector())
+        md.register_catalog("hive", ParquetConnector(hive_root))
+        fleet = FleetRunner(
+            list(worker_uris), md,
+            Session(catalog="tpch", schema="tiny"),
+            spool_root=root, n_partitions=4,
+        )
+        p = fleet.session.properties
+        p["speculation_enabled"] = False
+        p["retry_backoff_seed"] = seed
+        p["retry_initial_delay_ms"] = 5
+        p["retry_max_delay_ms"] = 20
+        return fleet
+
+    def table_rows(table):
+        md = Metadata()
+        md.register_catalog("hive", ParquetConnector(hive_root))
+        local = QueryRunner(md, Session(catalog="hive", schema="chaos"))
+        return local.execute(
+            f"select o_orderkey, o_totalprice, o_orderpriority "
+            f"from {table} order by o_orderkey"
+        ).rows
+
+    def audit(table):
+        """Exactly-once on disk: manifest == directory tree, no
+        duplicate part paths, no staging residue."""
+        tdir = os.path.join(hive_root, "chaos", table)
+        with open(os.path.join(tdir, "_manifest.json")) as f:
+            man = json.load(f)
+        listed = [e["path"] for e in man["files"]]
+        assert len(listed) == len(set(listed)), (
+            f"duplicate part paths committed: {sorted(listed)}"
+        )
+        on_disk = set()
+        for dirpath, _dirs, files in os.walk(tdir):
+            for name in files:
+                if name.endswith(".parquet"):
+                    on_disk.add(os.path.relpath(
+                        os.path.join(dirpath, name), tdir
+                    ))
+        assert on_disk == set(listed), (
+            f"orphan/missing part files: disk-only "
+            f"{sorted(on_disk - set(listed))}, manifest-only "
+            f"{sorted(set(listed) - on_disk)}"
+        )
+        staging = [
+            d for d in os.listdir(os.path.join(hive_root, "chaos"))
+            if d.startswith("_tmp_")
+        ]
+        assert not staging, f"staging dirs survived commit: {staging}"
+        return {"files": len(listed), "rows": int(man["rows"])}
+
+    extra_env = {
+        "TRINO_TPU_WORKER_EXTRA_PARQUET": f"hive={hive_root}",
+    }
+    procs, uris = spawn_workers(
+        2, base_port=base_port, extra_env=extra_env
+    )
+    try:
+        root = spool_root or tempfile.mkdtemp(prefix="chaos-write")
+        fleet = write_fleet(uris, root)
+        clean_res = fleet.execute(_WRITE_SQL.format(table="clean"))
+        clean = table_rows("clean")
+        assert clean_res.rows[0][0] == len(clean)
+        audit("clean")
+
+        # scenario 1: every writer attempt-0 dies staged (the staged
+        # part files of failed attempts must never reach the manifest)
+        fleet = write_fleet(uris, root)
+        inj = fault.FaultInjector(
+            seed=seed, max_attempts=fleet.max_attempts
+        )
+        inj.arm("spool-write", times=1)
+        inj.arm("task-exec", times=1)
+        fault.activate(inj)
+        try:
+            res = fleet.execute(_WRITE_SQL.format(table="faulted"))
+        finally:
+            fault.deactivate()
+        assert res.tasks_retried >= 1, "write chaos never fired"
+        assert table_rows("faulted") == clean, (
+            "faulted CTAS committed different rows than the clean twin"
+        )
+        record["runs"].append({
+            "scenario": "staged-faults",
+            "tasks_retried": res.tasks_retried,
+            **audit("faulted"),
+        })
+    finally:
+        stop_workers(procs)
+
+    # scenario 2: SIGKILL a worker as a writer-stage task lands on it
+    procs, uris = spawn_workers(
+        2, base_port=base_port + 4, extra_env=extra_env
+    )
+    try:
+        root = spool_root or tempfile.mkdtemp(prefix="chaos-write")
+        fleet = write_fleet(uris, root)
+        sql = _WRITE_SQL.format(table="killed")
+        stages = fragment_plan(fleet._planner.plan_sql(sql))
+        writer_sid = stages[-2].stage_id  # stages[-1] is TableFinish
+        target, target_proc = uris[-1], procs[-1]
+        killed: list = []
+
+        def kill_on_writer_post(stage_id, task_id, worker):
+            if (
+                stage_id == writer_sid and worker.uri == target
+                and not killed
+            ):
+                killed.append(task_id)
+                target_proc.kill()
+
+        fleet.post_hook = kill_on_writer_post
+        res = fleet.execute(sql)
+        assert killed, "no writer task ever landed on the kill target"
+        assert res.tasks_retried >= 1, (
+            "killing a worker mid-write must surface as an FTE retry"
+        )
+        assert table_rows("killed") == clean, (
+            "post-kill CTAS committed different rows than the clean "
+            "twin (duplicate or lost fragments)"
+        )
+        record["runs"].append({
+            "scenario": "worker-kill",
+            "killed_task": killed[0],
+            "tasks_retried": res.tasks_retried,
+            **audit("killed"),
+        })
+    finally:
         stop_workers(procs)
     return record
 
